@@ -1,0 +1,29 @@
+"""TPU-native shuffling data loader.
+
+A brand-new framework with the capabilities of
+``ray-project/ray_shuffling_data_loader`` (reference exports:
+``__init__.py:1-7``): per-epoch distributed map/reduce shuffle over Parquet,
+epoch pipelining with consumer-driven backpressure, and delivery of
+exact-size training batches to data-parallel trainers — built TPU-first:
+
+* shuffle stages run on TPU-VM host CPUs over a shared-memory columnar
+  object store (:mod:`.runtime`);
+* batches are staged into HBM through an async double-buffered
+  ``jax.device_put`` prefetch ring and yielded as pod-sharded ``jax.Array``
+  batches (:class:`JaxShufflingDataset`);
+* gradient exchange is ``jax.lax.psum`` over ICI inside ``pjit``/``shard_map``
+  (:mod:`.parallel`), not NCCL.
+
+Heavy adapters (jax / torch) are imported lazily so that CPU-side worker
+processes never pay for them.
+"""
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.shuffle import shuffle
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ShufflingDataset",
+    "shuffle",
+]

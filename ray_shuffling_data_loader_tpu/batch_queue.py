@@ -1,0 +1,334 @@
+"""Epoch-windowed batch delivery queue.
+
+The delivery transport between the shuffle engine (producer) and trainer
+ranks (consumers). Functional parity with the reference's
+``BatchQueue``/``_QueueActor`` pair (``batch_queue.py:24-355`` client,
+``batch_queue.py:383-509`` actor), rebuilt on this framework's actor runtime:
+
+* one named async actor process holds a ``num_epochs × num_trainers`` grid of
+  ``asyncio.Queue``;
+* the queue carries only :class:`~.runtime.ObjectRef` handles (or small test
+  payloads) — bulk reducer outputs stay in the shared-memory store
+  (the refs-in-queue design, reference ``dataset.py:195-196``);
+* **epoch-window backpressure**: ``new_epoch`` admits a new epoch only after
+  the oldest in-flight epoch's producers have signalled done AND trainers
+  have ``task_done``-acked every batch (reference ``batch_queue.py:395-418``);
+* ``producer_done`` enqueues a ``None`` in-band sentinel per (epoch, rank)
+  (reference ``batch_queue.py:420-422``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from collections.abc import Iterable
+from typing import Any, Dict, List, Optional
+
+from ray_shuffling_data_loader_tpu import runtime
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+DEFAULT_QUEUE_NAME = "BatchQueue"
+
+
+class _QueueActor:
+    """Server side. Runs on a single-threaded asyncio loop inside its own
+    process — the same concurrency model as the reference's Ray async actor,
+    so no locks are needed."""
+
+    def __init__(self, max_epochs, num_epochs, num_trainers, maxsize):
+        self.max_epochs = max_epochs
+        self.num_epochs = num_epochs
+        self.num_trainers = num_trainers
+        self.maxsize = maxsize
+        self.curr_epochs = collections.deque()
+        self.queues: List[List[asyncio.Queue]] = [
+            [asyncio.Queue(maxsize) for _ in range(num_trainers)]
+            for _ in range(num_epochs)
+        ]
+        self.producer_done_events: List[List[asyncio.Event]] = [
+            [asyncio.Event() for _ in range(num_trainers)]
+            for _ in range(num_epochs)
+        ]
+
+    async def new_epoch(self, epoch: int):
+        # Admission control: with max_epochs epochs in flight, wait for the
+        # oldest to fully drain — producers signalled done (no more batches
+        # can appear) and trainers acked every delivered batch. This is the
+        # sole source of backpressure (per-queue maxsize defaults to
+        # unbounded), matching reference batch_queue.py:395-418.
+        if len(self.curr_epochs) == self.max_epochs:
+            first_epoch = self.curr_epochs.popleft()
+            await asyncio.gather(
+                *(e.wait() for e in self.producer_done_events[first_epoch])
+            )
+            await asyncio.gather(
+                *(q.join() for q in self.queues[first_epoch])
+            )
+        self.curr_epochs.append(epoch)
+
+    async def producer_done(self, rank: int, epoch: int):
+        await self.queues[epoch][rank].put(None)
+        self.producer_done_events[epoch][rank].set()
+
+    async def wait_until_all_epochs_done(self):
+        last = self.num_epochs - 1
+        await asyncio.gather(
+            *(e.wait() for e in self.producer_done_events[last])
+        )
+        await asyncio.gather(*(q.join() for q in self.queues[last]))
+
+    def size(self) -> int:
+        return sum(q.qsize() for row in self.queues for q in row)
+
+    def qsize(self, rank: int, epoch: int) -> int:
+        return self.queues[epoch][rank].qsize()
+
+    def empty(self, rank: int, epoch: int) -> bool:
+        return self.queues[epoch][rank].empty()
+
+    def full(self, rank: int, epoch: int) -> bool:
+        return self.queues[epoch][rank].full()
+
+    async def put(self, rank, epoch, item, timeout=None):
+        try:
+            await asyncio.wait_for(self.queues[epoch][rank].put(item), timeout)
+        except asyncio.TimeoutError:
+            raise Full from None
+
+    async def put_batch(self, rank, epoch, items, timeout=None):
+        for item in items:
+            try:
+                await asyncio.wait_for(
+                    self.queues[epoch][rank].put(item), timeout
+                )
+            except asyncio.TimeoutError:
+                raise Full from None
+
+    async def get(self, rank, epoch, timeout=None):
+        try:
+            return await asyncio.wait_for(
+                self.queues[epoch][rank].get(), timeout
+            )
+        except asyncio.TimeoutError:
+            raise Empty from None
+
+    async def get_batch(self, rank, epoch):
+        # Block for one item, then opportunistically drain whatever else has
+        # already arrived (reference batch_queue.py:468-475).
+        queue = self.queues[epoch][rank]
+        batch = [await queue.get()]
+        while True:
+            try:
+                batch.append(queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        return batch
+
+    def put_nowait(self, rank, epoch, item):
+        self.queues[epoch][rank].put_nowait(item)
+
+    def put_nowait_batch(self, rank, epoch, items):
+        if (
+            self.maxsize > 0
+            and len(items) + self.qsize(rank, epoch) > self.maxsize
+        ):
+            raise Full(
+                f"Cannot add {len(items)} items to queue of size "
+                f"{self.qsize(rank, epoch)} and maxsize {self.maxsize}."
+            )
+        for item in items:
+            self.queues[epoch][rank].put_nowait(item)
+
+    def get_nowait(self, rank, epoch):
+        return self.queues[epoch][rank].get_nowait()
+
+    def get_nowait_batch(self, rank, epoch, num_items=None):
+        if num_items is None:
+            num_items = self.qsize(rank, epoch)
+        if num_items > self.qsize(rank, epoch):
+            raise Empty(
+                f"Cannot get {num_items} items from queue of size "
+                f"{self.qsize(rank, epoch)}."
+            )
+        return [self.queues[epoch][rank].get_nowait() for _ in range(num_items)]
+
+    def task_done(self, rank, epoch, num_items: int = 1):
+        for _ in range(num_items):
+            self.queues[epoch][rank].task_done()
+
+
+class BatchQueue:
+    """Client-side handle; sync and async, single and batched operations.
+
+    API parity with reference ``BatchQueue`` (``batch_queue.py:24-355``),
+    with the Ray actor replaced by a named runtime actor. Create on rank 0
+    with ``connect=False``; other ranks discover it by name with
+    exponential-backoff retry (``connect=True``).
+    """
+
+    def __init__(
+        self,
+        num_epochs: int,
+        num_trainers: int,
+        max_concurrent_epochs: int,
+        maxsize: int = 0,
+        name: Optional[str] = None,
+        connect: bool = False,
+        connect_retries: int = 5,
+    ) -> None:
+        runtime.ensure_initialized()
+        if connect:
+            assert name is not None
+            self.actor = runtime.connect_actor(name, num_retries=connect_retries)
+        else:
+            self.actor = runtime.spawn_actor(
+                _QueueActor,
+                max_concurrent_epochs,
+                num_epochs,
+                num_trainers,
+                maxsize,
+                name=name,
+            )
+
+    def __getstate__(self):
+        return {"actor": self.actor}
+
+    def __setstate__(self, state):
+        self.actor = state["actor"]
+
+    def ready(self) -> None:
+        """Block until the queue actor is up (reference ``batch_queue.py:67``)."""
+        self.actor.wait_ready()
+
+    def new_epoch(self, epoch: int) -> None:
+        """Admit a new epoch, blocking on the epoch window."""
+        self.actor.call("new_epoch", epoch)
+
+    def producer_done(self, rank: int, epoch: int) -> None:
+        """Fire-and-forget, like the un-``ray.get``-ed call at reference
+        ``batch_queue.py:94``."""
+        self.actor.call_oneway("producer_done", rank, epoch)
+
+    def task_done(self, rank: int, epoch: int, num_items: int = 1) -> None:
+        self.actor.call_oneway("task_done", rank, epoch, num_items)
+
+    def wait_until_all_epochs_done(self) -> None:
+        self.actor.call("wait_until_all_epochs_done")
+
+    def __len__(self) -> int:
+        return self.actor.call("size")
+
+    def size(self, rank: int, epoch: int) -> int:
+        return self.actor.call("qsize", rank, epoch)
+
+    def qsize(self, rank: int, epoch: int) -> int:
+        return self.size(rank, epoch)
+
+    def empty(self, rank: int, epoch: int) -> bool:
+        return self.actor.call("empty", rank, epoch)
+
+    def full(self, rank: int, epoch: int) -> bool:
+        return self.actor.call("full", rank, epoch)
+
+    def put(self, rank, epoch, item, block=True, timeout=None) -> None:
+        if not block:
+            try:
+                self.actor.call("put_nowait", rank, epoch, item)
+            except asyncio.QueueFull:
+                raise Full from None
+        else:
+            if timeout is not None and timeout < 0:
+                raise ValueError("'timeout' must be a non-negative number")
+            self.actor.call("put", rank, epoch, item, timeout)
+
+    def put_batch(self, rank, epoch, items, block=True, timeout=None) -> None:
+        if not block:
+            try:
+                self.actor.call("put_nowait_batch", rank, epoch, list(items))
+            except asyncio.QueueFull:
+                raise Full from None
+        else:
+            if timeout is not None and timeout < 0:
+                raise ValueError("'timeout' must be a non-negative number")
+            self.actor.call("put_batch", rank, epoch, list(items), timeout)
+
+    async def put_async(self, rank, epoch, item, block=True, timeout=None):
+        if not block:
+            try:
+                await self.actor.call_async("put_nowait", rank, epoch, item)
+            except asyncio.QueueFull:
+                raise Full from None
+        else:
+            if timeout is not None and timeout < 0:
+                raise ValueError("'timeout' must be a non-negative number")
+            await self.actor.call_async("put", rank, epoch, item, timeout)
+
+    def get(self, rank, epoch, block=True, timeout=None) -> Any:
+        if not block:
+            try:
+                return self.actor.call("get_nowait", rank, epoch)
+            except asyncio.QueueEmpty:
+                raise Empty from None
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        return self.actor.call("get", rank, epoch, timeout)
+
+    async def get_async(self, rank, epoch, block=True, timeout=None) -> Any:
+        if not block:
+            try:
+                return await self.actor.call_async("get_nowait", rank, epoch)
+            except asyncio.QueueEmpty:
+                raise Empty from None
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        return await self.actor.call_async("get", rank, epoch, timeout)
+
+    def get_batch(self, rank: int, epoch: int) -> List[Any]:
+        return self.actor.call("get_batch", rank, epoch)
+
+    def put_nowait(self, rank, epoch, item) -> None:
+        return self.put(rank, epoch, item, block=False)
+
+    def put_nowait_batch(self, rank, epoch, items) -> None:
+        if not isinstance(items, Iterable):
+            raise TypeError("Argument 'items' must be an Iterable")
+        try:
+            self.actor.call("put_nowait_batch", rank, epoch, list(items))
+        except asyncio.QueueFull:
+            raise Full from None
+
+    def get_nowait(self, rank, epoch) -> Any:
+        return self.get(rank, epoch, block=False)
+
+    def get_nowait_batch(self, rank, epoch, num_items=None) -> List[Any]:
+        if num_items is not None:
+            if not isinstance(num_items, int):
+                raise TypeError("Argument 'num_items' must be an int")
+            if num_items < 0:
+                raise ValueError("'num_items' must be nonnegative")
+        try:
+            return self.actor.call("get_nowait_batch", rank, epoch, num_items)
+        except asyncio.QueueEmpty:
+            raise Empty from None
+
+    def shutdown(self, force: bool = False, grace_period_s: int = 5) -> None:
+        """Graceful-then-forceful actor termination (reference
+        ``batch_queue.py:333-355``)."""
+        if self.actor:
+            self.actor.terminate(force=force, grace_period_s=grace_period_s)
+        self.actor = None
+
+
+def connect_queue(name: str = DEFAULT_QUEUE_NAME, num_retries: int = 5):
+    """Discover an existing queue by name (reference
+    ``connect_queue_actor``, ``batch_queue.py:358-380``)."""
+    runtime.ensure_initialized()
+    return runtime.connect_actor(name, num_retries=num_retries)

@@ -1,0 +1,151 @@
+"""Synthetic Parquet training-data generation.
+
+Parity with the reference generator (``data_generation.py:13-93``): a
+DLRM-like tabular schema — 17 int64 embedding-index columns with the same
+cardinalities, 2 int64 one-hot columns, a float64 ``labels`` column, and a
+``key`` row-id column — written as snappy-compressed Parquet with
+controllable row-group size. The ``key`` column makes exactly-once shuffle
+tests possible.
+
+TPU-first differences: files are built column-at-a-time as numpy arrays and
+written through Arrow directly (no pandas round-trip), and file tasks run on
+the runtime worker pool instead of Ray tasks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ray_shuffling_data_loader_tpu import runtime
+
+# Schema parity with reference ``DATA_SPEC`` (``data_generation.py:56-77``):
+# column name -> (low, high, dtype).
+DATA_SPEC = {
+    "embeddings_name0": (0, 2385, np.int64),
+    "embeddings_name1": (0, 201, np.int64),
+    "embeddings_name2": (0, 201, np.int64),
+    "embeddings_name3": (0, 6, np.int64),
+    "embeddings_name4": (0, 19, np.int64),
+    "embeddings_name5": (0, 1441, np.int64),
+    "embeddings_name6": (0, 201, np.int64),
+    "embeddings_name7": (0, 22, np.int64),
+    "embeddings_name8": (0, 156, np.int64),
+    "embeddings_name9": (0, 1216, np.int64),
+    "embeddings_name10": (0, 9216, np.int64),
+    "embeddings_name11": (0, 88999, np.int64),
+    "embeddings_name12": (0, 941792, np.int64),
+    "embeddings_name13": (0, 9405, np.int64),
+    "embeddings_name14": (0, 83332, np.int64),
+    "embeddings_name15": (0, 828767, np.int64),
+    "embeddings_name16": (0, 945195, np.int64),
+    "one_hot0": (0, 3, np.int64),
+    "one_hot1": (0, 50, np.int64),
+    "labels": (0, 1, np.float64),
+}
+
+EMBEDDING_COLUMNS = [c for c in DATA_SPEC if c.startswith("embeddings_")]
+ONE_HOT_COLUMNS = [c for c in DATA_SPEC if c.startswith("one_hot")]
+LABEL_COLUMN = "labels"
+KEY_COLUMN = "key"
+
+
+def generate_row_group(
+    group_index: int, global_row_index: int, num_rows_in_group: int, seed: int = 0
+) -> Dict[str, np.ndarray]:
+    """One row group as a dict of numpy columns (reference
+    ``generate_row_group``, ``data_generation.py:80-93``)."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(group_index, global_row_index))
+    )
+    buffer: Dict[str, np.ndarray] = {
+        KEY_COLUMN: np.arange(
+            global_row_index,
+            global_row_index + num_rows_in_group,
+            dtype=np.int64,
+        )
+    }
+    for col, (low, high, dtype) in DATA_SPEC.items():
+        if np.issubdtype(dtype, np.integer):
+            buffer[col] = rng.integers(low, high, num_rows_in_group, dtype=dtype)
+        else:
+            buffer[col] = (high - low) * rng.random(
+                num_rows_in_group, dtype=np.float64
+            ) + low
+    return buffer
+
+
+def generate_file(
+    file_index: int,
+    global_row_index: int,
+    num_rows_in_file: int,
+    num_row_groups_per_file: int,
+    data_dir: str,
+    seed: int = 0,
+) -> Tuple[str, int]:
+    """Generate one Parquet file (reference ``generate_file``,
+    ``data_generation.py:30-53``). Returns (filename, in-memory bytes)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    group_size = max(1, num_rows_in_file // num_row_groups_per_file)
+    groups = []
+    for group_index, group_row_index in enumerate(
+        range(0, num_rows_in_file, group_size)
+    ):
+        n = min(group_size, num_rows_in_file - group_row_index)
+        groups.append(
+            generate_row_group(
+                group_index, global_row_index + group_row_index, n, seed
+            )
+        )
+    columns = {
+        name: np.concatenate([g[name] for g in groups])
+        for name in groups[0]
+    }
+    data_size = sum(v.nbytes for v in columns.values())
+    table = pa.table({k: pa.array(v) for k, v in columns.items()})
+    filename = os.path.join(
+        data_dir, f"input_data_{file_index}.parquet.snappy"
+    )
+    pq.write_table(
+        table, filename, compression="snappy", row_group_size=group_size
+    )
+    return filename, data_size
+
+
+def generate_data(
+    num_rows: int,
+    num_files: int,
+    num_row_groups_per_file: int,
+    max_row_group_skew: float,
+    data_dir: str,
+    seed: int = 0,
+) -> Tuple[List[str], int]:
+    """Generate the synthetic dataset across the worker pool (reference
+    ``generate_data``, ``data_generation.py:13-27``)."""
+    assert max_row_group_skew == 0.0, "row-group skew not implemented"
+    ctx = runtime.ensure_initialized()
+    os.makedirs(data_dir, exist_ok=True)
+    futures = []
+    rows_per_file = max(1, num_rows // num_files)
+    for file_index, global_row_index in enumerate(
+        range(0, num_rows, rows_per_file)
+    ):
+        num_rows_in_file = min(rows_per_file, num_rows - global_row_index)
+        futures.append(
+            ctx.pool.submit(
+                generate_file,
+                file_index,
+                global_row_index,
+                num_rows_in_file,
+                num_row_groups_per_file,
+                data_dir,
+                seed,
+            )
+        )
+    results = [f.result() for f in futures]
+    filenames, data_sizes = zip(*results)
+    return list(filenames), int(sum(data_sizes))

@@ -1,0 +1,236 @@
+"""Framework-agnostic shuffling dataset API.
+
+Parity with the reference ``ShufflingDataset`` (``dataset.py:15-188``):
+rank 0 creates the named batch queue and kicks off the multi-epoch shuffle;
+every rank iterates exact-``batch_size`` batches re-cut from streamed
+reducer outputs with a carry-over buffer, and acks consumption back to the
+queue to drive the epoch-window backpressure.
+
+Differences from the reference (TPU-first, not a port):
+
+* Batches are :class:`~.runtime.ColumnBatch` (named contiguous numpy
+  columns, zero-copy views over shared memory) instead of pandas
+  DataFrames — the layout the JAX/HBM staging path consumes directly.
+  Use ``batch.to_pandas()`` where a DataFrame is wanted.
+* The shuffle driver runs on a daemon thread in the rank-0 process,
+  submitting stage tasks to the runtime's worker pool (the reference runs it
+  as a detached Ray task, ``dataset.py:68-74``).
+* Reducer-output segments are freed as soon as they have been sliced into
+  training batches; on Linux the pages live until the last view drops.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterator, List, Optional
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.batch_queue import (
+    BatchQueue,
+    DEFAULT_QUEUE_NAME,
+)
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+# Default reducer share of cluster cores (reference ``dataset.py:12``).
+REDUCER_CLUSTER_CORE_SHARE = 0.6
+
+
+def default_num_reducers(num_trainers: int) -> int:
+    return max(
+        1,
+        int(num_trainers * (os.cpu_count() or 1) * REDUCER_CLUSTER_CORE_SHARE),
+    )
+
+
+class _ShuffleResult:
+    """Holds the background shuffle driver's outcome (the analog of the
+    detached-task ref the reference ``ray.get``s at ``dataset.py:186-188``)."""
+
+    def __init__(self):
+        self.duration: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+    def join(self):
+        self.thread.join()
+        if self.error is not None:
+            raise self.error
+
+
+class ShufflingDataset:
+    """A shuffling dataset that yields batches upon iteration.
+
+    Constructing this on rank 0 kicks off shuffling for up to
+    ``max_concurrent_epochs`` epochs. Constructor signature matches the
+    reference (``dataset.py:37-48``) plus a deterministic ``seed``.
+
+    Args:
+        filenames: Paths to input Parquet files.
+        num_epochs: Number of training epochs.
+        num_trainers: Number of trainer workers.
+        batch_size: Rows per yielded batch.
+        rank: This trainer's rank.
+        drop_last: Drop the final incomplete batch. Default False.
+        num_reducers: Shuffler reducer count. Default
+            ``num_trainers × cores × 0.6`` (reference ``dataset.py:46-48``).
+        max_concurrent_epochs: Epoch pipelining window. Default 2.
+        seed: Root seed for the per-epoch shuffle permutations.
+        queue_name: Name of the shared batch-queue endpoint.
+    """
+
+    def __init__(
+        self,
+        filenames: List[str],
+        num_epochs: int,
+        num_trainers: int,
+        batch_size: int,
+        rank: int,
+        drop_last: bool = False,
+        num_reducers: Optional[int] = None,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        queue_name: str = DEFAULT_QUEUE_NAME,
+    ):
+        runtime.ensure_initialized()
+        if num_reducers is None:
+            num_reducers = default_num_reducers(num_trainers)
+        self._batch_size = batch_size
+
+        if rank == 0:
+            # Master: create the queue, then kick off the shuffle driver.
+            self._batch_queue = BatchQueue(
+                num_epochs,
+                num_trainers,
+                max_concurrent_epochs,
+                name=queue_name,
+                connect=False,
+            )
+            self._consumer = BatchConsumerQueue(self._batch_queue)
+            self._batch_queue.ready()
+            self._shuffle_result = _ShuffleResult()
+
+            def _drive(result=self._shuffle_result):
+                try:
+                    result.duration = shuffle(
+                        filenames,
+                        self._consumer,
+                        num_epochs,
+                        num_reducers,
+                        num_trainers,
+                        seed=seed,
+                    )
+                except BaseException as exc:  # surfaced at iterator end
+                    result.error = exc
+
+            self._shuffle_result.thread = threading.Thread(
+                target=_drive, name="shuffle-driver", daemon=True
+            )
+            self._shuffle_result.thread.start()
+        else:
+            # Worker: connect to the named queue with retry.
+            self._batch_queue = BatchQueue(
+                num_epochs,
+                num_trainers,
+                max_concurrent_epochs,
+                name=queue_name,
+                connect=True,
+            )
+            self._shuffle_result = None
+
+        self._num_epochs = num_epochs
+        self._num_trainers = num_trainers
+        self._rank = rank
+        self._epoch: Optional[int] = None
+        self._last_epoch: Optional[int] = None
+        self._drop_last = drop_last
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """Must be called before each epoch's iteration (reference
+        ``dataset.py:96-106``)."""
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[ColumnBatch]:
+        if self._epoch is None or self._epoch == self._last_epoch:
+            raise ValueError(
+                "You must set the epoch on this dataset via set_epoch() at "
+                "the beginning of each epoch, before iterating over this "
+                "dataset."
+            )
+        store = runtime.get_context().store
+        buf: Optional[ColumnBatch] = None
+        is_done = False
+        while not is_done:
+            pending = self._batch_queue.get_batch(self._rank, self._epoch)
+            if pending and pending[-1] is None:
+                # Trailing producer-done sentinel; drain the rest first.
+                is_done = True
+                pending.pop()
+            num_outstanding = len(pending)
+
+            for ref in pending:
+                cb = store.get_columns(ref)
+                # Segment pages outlive the unlink until views drop.
+                store.free(ref)
+                offset = self._batch_size - (buf.num_rows if buf else 0)
+                # Top up the carry buffer with a front slice.
+                buf = ColumnBatch.concat([buf, cb.slice(0, offset)])
+                if buf.num_rows == self._batch_size:
+                    yield buf
+                    buf = None
+                # Whole batches straight from this reducer output, then the
+                # short tail into the carry buffer. (The reference's pointer
+                # arithmetic drops the tail whenever a reducer output yields
+                # zero full batches after the buffer top-up —
+                # ``dataset.py:160-168``; fixed here, covered by the
+                # exactly-once tests.)
+                start = min(offset, cb.num_rows)
+                num_full = (cb.num_rows - start) // self._batch_size
+                for i in range(num_full):
+                    lo = start + i * self._batch_size
+                    yield cb.slice(lo, lo + self._batch_size)
+                tail = start + num_full * self._batch_size
+                if tail < cb.num_rows:
+                    buf = cb.slice(tail, cb.num_rows)
+                del cb
+
+            if num_outstanding > 0:
+                self._batch_queue.task_done(
+                    self._rank, self._epoch, num_outstanding
+                )
+
+        if buf is not None and buf.num_rows > 0 and not self._drop_last:
+            yield buf
+        # Ack the producer-done sentinel itself (reference dataset.py:184).
+        self._batch_queue.task_done(self._rank, self._epoch, 1)
+        self._last_epoch = self._epoch
+        if (
+            self._epoch == self._num_epochs - 1
+            and self._shuffle_result is not None
+        ):
+            self._shuffle_result.join()
+
+
+class BatchConsumerQueue(BatchConsumer):
+    """Adapts the shuffle engine's consumer interface onto a BatchQueue
+    (reference ``dataset.py:191-205``)."""
+
+    def __init__(self, batch_queue: BatchQueue):
+        self._batch_queue = batch_queue
+
+    def consume(self, rank: int, epoch: int, batches: List[ObjectRef]):
+        self._batch_queue.put_batch(rank, epoch, batches)
+
+    def producer_done(self, rank: int, epoch: int):
+        self._batch_queue.producer_done(rank, epoch)
+
+    def wait_until_ready(self, epoch: int):
+        self._batch_queue.new_epoch(epoch)
+
+    def wait_until_all_epochs_done(self):
+        self._batch_queue.wait_until_all_epochs_done()

@@ -1,0 +1,194 @@
+"""Host runtime substrate: the TPU-native replacement for Ray core.
+
+The reference (ray-project/ray_shuffling_data_loader) is pure Python on top of
+Ray's C++ runtime — tasks/actors, plasma object store, named actors
+(SURVEY.md §2b). This package provides the equivalent substrate for TPU-VM
+hosts:
+
+* :mod:`.store` — shared-memory columnar object store (data plane).
+* :mod:`.actor` — named async actor endpoints over unix/TCP sockets
+  (control plane; ``ray.get_actor`` ≙ :func:`connect_actor`).
+* :mod:`.tasks` — spawned worker pool with futures and ``wait``
+  (``@ray.remote`` tasks ≙ :func:`submit`).
+
+``init()`` creates (or joins, via the ``RSDL_RUNTIME_DIR`` env var or an
+explicit ``address=``) a *session*: a runtime directory holding the actor
+registry plus a session id that prefixes every shared-memory segment. This
+mirrors ``ray.init(address=...)`` joining an existing cluster
+(reference ``benchmarks/benchmark.py:216-256``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import secrets
+import shutil
+import tempfile
+import threading
+from typing import Callable, Optional
+
+from .actor import (  # noqa: F401
+    ActorDiedError,
+    ActorHandle,
+    RemoteError,
+    connect_actor as _connect_actor,
+    resolve_actor as _resolve_actor,
+    spawn_actor as _spawn_actor,
+)
+from .store import ColumnBatch, ObjectRef, ObjectStore, StoreStats  # noqa: F401
+from .tasks import TaskError, TaskFuture, WorkerPool, wait  # noqa: F401
+
+_ENV_DIR = "RSDL_RUNTIME_DIR"
+
+
+class RuntimeContext:
+    def __init__(self, runtime_dir: str, owner: bool, num_workers: int):
+        self.runtime_dir = runtime_dir
+        self.owner = owner
+        self.session = os.path.basename(runtime_dir)
+        self.store = ObjectStore(self.session)
+        self._pool: Optional[WorkerPool] = None
+        self._pool_lock = threading.Lock()
+        self._num_workers = num_workers
+        self._owned_actors = []
+
+    @property
+    def pool(self) -> WorkerPool:
+        # Lazy: pure consumers (worker trainer ranks) never pay for a pool.
+        with self._pool_lock:
+            if self._pool is None:
+                # Workers must join THIS session (not create orphan ones),
+                # even when the driver joined via init(address=...) with no
+                # env var exported.
+                self._pool = WorkerPool(
+                    self._num_workers,
+                    env={_ENV_DIR: self.runtime_dir},
+                )
+            return self._pool
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+        for handle in self._owned_actors:
+            try:
+                handle.terminate(grace_period_s=2.0)
+            except Exception:
+                pass
+        self._owned_actors.clear()
+        if self.owner:
+            self.store.cleanup()
+            shutil.rmtree(self.runtime_dir, ignore_errors=True)
+
+
+_context: Optional[RuntimeContext] = None
+_context_lock = threading.Lock()
+
+
+def init(
+    address: Optional[str] = None,
+    num_workers: Optional[int] = None,
+) -> RuntimeContext:
+    """Create or join a runtime session.
+
+    Args:
+        address: Path of an existing session's runtime directory to join
+            (also read from ``$RSDL_RUNTIME_DIR``). ``None`` creates a new
+            session owned by this process.
+        num_workers: Size of the lazy task worker pool. Defaults to
+            ``os.cpu_count()``.
+    """
+    global _context
+    with _context_lock:
+        if _context is not None:
+            return _context
+        if num_workers is None:
+            num_workers = max(1, os.cpu_count() or 1)
+        address = address or os.environ.get(_ENV_DIR)
+        if address:
+            if not os.path.isdir(address):
+                raise ValueError(f"no runtime session at {address!r}")
+            ctx = RuntimeContext(address, owner=False, num_workers=num_workers)
+        else:
+            # Keep the path short: unix socket paths are capped at ~107 chars.
+            base = tempfile.gettempdir()
+            runtime_dir = os.path.join(
+                base, f"rsdl-{secrets.token_hex(4)}"
+            )
+            os.makedirs(os.path.join(runtime_dir, "actors"))
+            os.environ[_ENV_DIR] = runtime_dir
+            ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
+        _context = ctx
+        atexit.register(shutdown)
+        return ctx
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def get_context() -> RuntimeContext:
+    if _context is None:
+        raise RuntimeError(
+            "runtime not initialized; call "
+            "ray_shuffling_data_loader_tpu.runtime.init() first"
+        )
+    return _context
+
+
+def ensure_initialized() -> RuntimeContext:
+    return _context if _context is not None else init()
+
+
+def shutdown() -> None:
+    global _context
+    with _context_lock:
+        if _context is None:
+            return
+        ctx, _context = _context, None
+    if os.environ.get(_ENV_DIR) == ctx.runtime_dir and ctx.owner:
+        del os.environ[_ENV_DIR]
+    ctx.shutdown()
+
+
+# -- convenience wrappers bound to the current session ----------------------
+
+
+def submit(fn: Callable, *args, **kwargs) -> TaskFuture:
+    return get_context().pool.submit(fn, *args, **kwargs)
+
+
+def spawn_actor(cls, *args, name: Optional[str] = None, **kwargs) -> ActorHandle:
+    ctx = get_context()
+    handle = _spawn_actor(
+        cls, *args, name=name, runtime_dir=ctx.runtime_dir, **kwargs
+    )
+    ctx._owned_actors.append(handle)
+    return handle
+
+
+def connect_actor(name: str, num_retries: int = 5) -> ActorHandle:
+    return _connect_actor(
+        name, get_context().runtime_dir, num_retries=num_retries
+    )
+
+
+def resolve_actor(name: str) -> Optional[ActorHandle]:
+    return _resolve_actor(name, get_context().runtime_dir)
+
+
+def put_columns(columns) -> ObjectRef:
+    return get_context().store.put_columns(columns)
+
+
+def get_columns(ref: ObjectRef) -> ColumnBatch:
+    return get_context().store.get_columns(ref)
+
+
+def free(refs) -> None:
+    get_context().store.free(refs)
+
+
+def store_stats() -> StoreStats:
+    return get_context().store.store_stats()

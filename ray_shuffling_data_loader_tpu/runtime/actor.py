@@ -1,0 +1,477 @@
+"""Actor runtime: named async endpoints in their own processes.
+
+The TPU-native replacement for Ray's named-actor machinery that the reference
+builds its delivery layer on: ``ray.remote(_QueueActor).options(name=...)``
+(reference ``batch_queue.py:63-65``) and ``ray.get_actor(name)`` discovery
+with exponential-backoff retry (``batch_queue.py:358-380``).
+
+Model:
+
+* ``spawn_actor(cls, *args, name=..)`` starts a **spawned** process hosting one
+  instance of ``cls`` behind an asyncio socket server. ``async def`` methods
+  run as event-loop tasks, so a blocked ``get`` never stalls a concurrent
+  ``put`` — the same single-threaded-asyncio concurrency model as a Ray async
+  actor (reference ``batch_queue.py:383-509``).
+* Named actors register a JSON record (address + pid) in the session registry
+  directory; ``connect_actor(name)`` resolves it with exponential backoff.
+* Clients hold one blocking connection per calling thread. Fire-and-forget
+  calls (``oneway=True``) get no reply — the analog of not ``ray.get``-ing a
+  Ray call (reference ``batch_queue.py:94,108``).
+
+The wire protocol is scheme-agnostic (unix socket on-host, TCP across hosts),
+so the same actor code serves as the multi-host control plane over DCN.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import multiprocessing as mp
+import os
+import secrets
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from . import transport
+from .transport import Address
+
+
+class ActorDiedError(Exception):
+    """Raised when calling an actor whose process has exited."""
+
+
+class RemoteError(Exception):
+    """An exception raised inside an actor method, re-raised at the caller.
+
+    Picklable exceptions are re-raised directly (so callers can except
+    concrete types); ``RemoteError`` is the fallback carrying the remote
+    traceback text when the original instance could not cross the wire."""
+
+
+def _registry_dir(runtime_dir: str) -> str:
+    return os.path.join(runtime_dir, "actors")
+
+
+def _registry_path(runtime_dir: str, name: str) -> str:
+    return os.path.join(_registry_dir(runtime_dir), f"{name}.json")
+
+
+# ---------------------------------------------------------------------------
+# Server side
+# ---------------------------------------------------------------------------
+
+
+class _ActorHost:
+    """Runs inside the actor process: serves method calls on an asyncio loop."""
+
+    def __init__(self, instance, address: Address):
+        self.instance = instance
+        self.address = address
+        self._shutdown = None  # asyncio.Event, created on the loop
+
+    async def _handle_client(self, reader, writer):
+        try:
+            while True:
+                try:
+                    frame = await transport.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                req_id, method, args, kwargs, oneway = frame
+                # Dispatch as a task: requests on one connection must not
+                # head-of-line-block each other (a blocked queue.get would
+                # otherwise deadlock the producer's puts).
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(writer, req_id, method, args, kwargs, oneway)
+                )
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, req_id, method, args, kwargs, oneway):
+        try:
+            if method == "__ping__":
+                result = "pong"
+            elif method == "__terminate__":
+                result = None
+                self._shutdown.set()
+            else:
+                fn = getattr(self.instance, method)
+                result = fn(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            if not oneway:
+                transport.write_frame(writer, (req_id, "ok", result))
+                await writer.drain()
+        except Exception as exc:  # noqa: BLE001 — propagate to caller
+            if not oneway:
+                tb = traceback.format_exc()
+                try:
+                    transport.write_frame(writer, (req_id, "err", (exc, tb)))
+                    await writer.drain()
+                except Exception:
+                    # The exception itself didn't pickle; the caller still
+                    # needs a reply frame or it blocks forever. Send just
+                    # the traceback text.
+                    try:
+                        transport.write_frame(writer, (req_id, "err", (None, tb)))
+                        await writer.drain()
+                    except Exception:
+                        pass
+
+    async def start(self):
+        """Bind the server socket; returns once the actor is reachable."""
+        self._shutdown = asyncio.Event()
+        self._server = await transport.start_server(
+            self.address, self._handle_client
+        )
+        setup = getattr(self.instance, "setup", None)
+        if setup is not None:
+            result = setup()
+            if asyncio.iscoroutine(result):
+                await result
+
+    async def wait_shutdown(self):
+        async with self._server:
+            await self._shutdown.wait()
+
+
+def _actor_main(cls, args, kwargs, address: Address, registry_path, ready_q):
+    # Child process entrypoint (spawned: fresh interpreter, no inherited
+    # TPU/JAX state).
+    try:
+        instance = cls(*args, **kwargs)
+        host = _ActorHost(instance, address)
+    except Exception:
+        ready_q.put(("err", traceback.format_exc()))
+        return
+
+    async def run():
+        # Bind strictly before announcing readiness: callers may issue a
+        # method call the moment spawn_actor returns.
+        await host.start()
+        if registry_path is not None:
+            tmp = registry_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"address": list(address), "pid": os.getpid()}, f)
+            os.replace(tmp, registry_path)
+        ready_q.put(("ok", None))
+        await host.wait_shutdown()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if registry_path is not None:
+            try:
+                os.unlink(registry_path)
+            except FileNotFoundError:
+                pass
+        if address[0] == "unix":
+            try:
+                os.unlink(address[1])
+            except FileNotFoundError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+
+class ActorHandle:
+    """Client-side proxy. ``handle.call("method", ...)`` blocks for the
+    result; ``call_oneway`` is fire-and-forget; ``call_async`` awaits on an
+    asyncio loop."""
+
+    def __init__(self, address: Address, pid: Optional[int] = None, name=None):
+        self.address = tuple(address)
+        self.pid = pid
+        self.name = name
+        self._local = threading.local()
+        self._async_clients: Dict[Any, "_AsyncActorClient"] = {}
+        self._req_counter = 0
+        self._counter_lock = threading.Lock()
+
+    # pickling: handles travel inside task args across processes
+    def __getstate__(self):
+        return {"address": self.address, "pid": self.pid, "name": self.name}
+
+    def __setstate__(self, state):
+        self.__init__(state["address"], state["pid"], state["name"])
+
+    def _conn(self) -> transport.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            try:
+                conn = transport.Connection(self.address)
+            except (ConnectionError, FileNotFoundError, OSError) as e:
+                raise ActorDiedError(
+                    f"cannot connect to actor {self.name or self.address}: {e}"
+                ) from e
+            self._local.conn = conn
+        return conn
+
+    def _next_id(self) -> int:
+        with self._counter_lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    def call(self, method: str, *args, **kwargs):
+        conn = self._conn()
+        req_id = self._next_id()
+        try:
+            conn.send((req_id, method, args, kwargs, False))
+            while True:
+                resp_id, status, payload = conn.recv()
+                if resp_id == req_id:
+                    break
+        except (ConnectionError, OSError) as e:
+            self._local.conn = None
+            raise ActorDiedError(
+                f"actor {self.name or self.address} died mid-call: {e}"
+            ) from e
+        if status == "ok":
+            return payload
+        exc, tb = payload
+        if isinstance(exc, Exception):
+            raise exc
+        raise RemoteError(f"remote call {method} failed:\n{tb}")
+
+    def call_oneway(self, method: str, *args, **kwargs) -> None:
+        conn = self._conn()
+        try:
+            conn.send((self._next_id(), method, args, kwargs, True))
+        except (ConnectionError, OSError) as e:
+            self._local.conn = None
+            raise ActorDiedError(
+                f"actor {self.name or self.address} died: {e}"
+            ) from e
+
+    async def call_async(self, method: str, *args, **kwargs):
+        loop = asyncio.get_running_loop()
+        client = self._async_clients.get(loop)
+        if client is None or client.closed:
+            client = _AsyncActorClient(self.address)
+            await client.connect()
+            self._async_clients[loop] = client
+        return await client.call(method, *args, **kwargs)
+
+    def ping(self, timeout: float = None) -> bool:
+        # A dedicated short-lived connection with a socket timeout: the
+        # regular per-thread connection has no timeout, and a wedged (alive
+        # but non-responsive) actor must not hang wait_ready's deadline.
+        try:
+            conn = transport.Connection(self.address, timeout=timeout)
+        except (ConnectionError, FileNotFoundError, OSError):
+            return False
+        try:
+            conn.send((0, "__ping__", (), {}, False))
+            _, status, payload = conn.recv()
+            return status == "ok" and payload == "pong"
+        except Exception:
+            return False
+        finally:
+            conn.close()
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block until the actor answers a ping (reference
+        ``BatchQueue.ready``, ``batch_queue.py:67-71``)."""
+        deadline = time.monotonic() + timeout
+        delay = 0.005
+        while True:
+            if self.ping(timeout=min(2.0, timeout)):
+                return
+            if time.monotonic() > deadline:
+                raise ActorDiedError(
+                    f"actor {self.name or self.address} not ready "
+                    f"after {timeout}s"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+
+    def terminate(self, force: bool = False, grace_period_s: float = 5.0):
+        """Graceful-then-forceful shutdown (reference
+        ``BatchQueue.shutdown``, ``batch_queue.py:333-355``)."""
+        if not force:
+            try:
+                self.call("__terminate__")
+            except (ActorDiedError, RemoteError, ConnectionError):
+                pass
+            deadline = time.monotonic() + grace_period_s
+            while time.monotonic() < deadline:
+                if self.pid is None or not _pid_alive(self.pid):
+                    return
+                time.sleep(0.02)
+        if self.pid is not None and _pid_alive(self.pid):
+            try:
+                os.kill(self.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+class _AsyncActorClient:
+    """Asyncio client with request/response demultiplexing."""
+
+    def __init__(self, address: Address):
+        self.address = address
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._req = 0
+        self.closed = False
+        self._reader = self._writer = self._reader_task = None
+
+    async def connect(self):
+        self._reader, self._writer = await transport.open_connection(
+            self.address
+        )
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop()
+        )
+
+    async def _read_loop(self):
+        try:
+            while True:
+                resp_id, status, payload = await transport.read_frame(
+                    self._reader
+                )
+                fut = self._pending.pop(resp_id, None)
+                if fut is None or fut.done():
+                    continue
+                if status == "ok":
+                    fut.set_result(payload)
+                else:
+                    exc, tb = payload
+                    fut.set_exception(
+                        exc
+                        if isinstance(exc, Exception)
+                        else RemoteError(f"remote failure:\n{tb}")
+                    )
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ActorDiedError(f"actor died: {e}"))
+            self._pending.clear()
+
+    async def call(self, method, *args, **kwargs):
+        self._req += 1
+        req_id = self._req
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        transport.write_frame(self._writer, (req_id, method, args, kwargs, False))
+        await self._writer.drain()
+        return await fut
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Spawning and discovery
+# ---------------------------------------------------------------------------
+
+
+def spawn_actor(
+    cls,
+    *args,
+    name: Optional[str] = None,
+    runtime_dir: str,
+    host: Optional[str] = None,
+    port: int = 0,
+    **kwargs,
+) -> ActorHandle:
+    """Start an actor process and return a connected handle.
+
+    With ``host`` set, the actor listens on TCP (multi-host control plane);
+    otherwise on a unix socket under ``runtime_dir``.
+    """
+    os.makedirs(_registry_dir(runtime_dir), exist_ok=True)
+    token = secrets.token_hex(4)
+    if host is not None:
+        if port == 0:
+            import socket as _socket
+
+            s = _socket.socket()
+            s.bind((host, 0))
+            port = s.getsockname()[1]
+            s.close()
+        address: Address = ("tcp", host, port)
+    else:
+        address = ("unix", os.path.join(runtime_dir, f"a-{token}.sock"))
+    registry_path = (
+        _registry_path(runtime_dir, name) if name is not None else None
+    )
+    if registry_path is not None and os.path.exists(registry_path):
+        raise ValueError(f"actor name {name!r} already registered")
+
+    ctx = mp.get_context("spawn")
+    ready_q = ctx.Queue()
+    proc = ctx.Process(
+        target=_actor_main,
+        args=(cls, args, kwargs, address, registry_path, ready_q),
+        daemon=True,
+    )
+    proc.start()
+    while True:
+        try:
+            status, err = ready_q.get(timeout=0.2)
+            break
+        except Exception:  # queue.Empty
+            if not proc.is_alive():
+                raise RuntimeError(
+                    f"actor {cls.__name__} process exited during startup "
+                    f"(exitcode={proc.exitcode})"
+                ) from None
+    if status != "ok":
+        raise RuntimeError(f"actor {cls.__name__} failed to start:\n{err}")
+    handle = ActorHandle(address, pid=proc.pid, name=name)
+    handle._process = proc  # keep a reference for join/cleanup by the owner
+    return handle
+
+
+def resolve_actor(name: str, runtime_dir: str) -> Optional[ActorHandle]:
+    path = _registry_path(runtime_dir, name)
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    return ActorHandle(
+        tuple(record["address"]), pid=record.get("pid"), name=name
+    )
+
+
+def connect_actor(
+    name: str, runtime_dir: str, num_retries: int = 5
+) -> ActorHandle:
+    """Discover a named actor, retrying with exponential backoff (parity with
+    reference ``connect_queue_actor``, ``batch_queue.py:358-380``)."""
+    retries = 0
+    sleep_dur = 1.0
+    last_exc: Optional[Exception] = None
+    while retries < num_retries:
+        handle = resolve_actor(name, runtime_dir)
+        if handle is not None and handle.ping():
+            return handle
+        retries += 1
+        last_exc = ActorDiedError(f"no live actor registered as {name!r}")
+        if retries < num_retries:
+            time.sleep(sleep_dur)
+            sleep_dur *= 2
+    raise ValueError(
+        f"Unable to connect to actor {name} after {num_retries} retries. "
+        f"Last error: {last_exc!s}"
+    )

@@ -1,0 +1,294 @@
+"""Host-side shared-memory object store.
+
+This is the data plane of the runtime: the TPU-native replacement for Ray's
+plasma object store (used by the reference for every shuffle intermediate and
+for batch delivery — reference ``dataset.py:136-139``, ``shuffle.py:112-124``).
+Bulk data never transits the control-plane sockets; producers write columnar
+buffers into per-object shared-memory segments and ship only small
+:class:`ObjectRef` handles (the reference ships ``ray.ObjectRef`` lists through
+its queue actor, ``dataset.py:195-196``).
+
+Design (TPU-first, not a port):
+
+* Objects are **columnar**: a batch is a set of named, dtype-tagged,
+  contiguous 64-byte-aligned buffers. This is the layout ``jax.device_put``
+  wants — a reducer output can be staged into HBM without any row-wise
+  re-packing (the reference instead passes pandas DataFrames and pays
+  ``pd.concat``/``torch.as_tensor`` copies, ``torch_dataset.py:223``).
+* Segments are plain files in ``/dev/shm`` mapped with ``mmap`` — the same
+  mechanism a C++ store would use (``shm_open``), zero-copy across processes,
+  and free of the CPython ``resource_tracker`` bookkeeping that
+  ``multiprocessing.shared_memory`` imposes.
+* Reads return **zero-copy numpy views** over the mapping; the mapping is kept
+  alive by the returned :class:`ColumnBatch`.
+
+The store has no server process: the filesystem is the index. Utilization
+introspection (`store_stats`) replaces the reference's raylet
+``FormatGlobalMemoryInfo`` gRPC probe (``stats.py:675-683``).
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import secrets
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = b"RSDL1\x00"
+_ALIGN = 64
+_HEADER = struct.Struct("<6sI")  # magic, json length
+
+
+def _default_shm_dir() -> str:
+    d = os.environ.get("RSDL_SHM_DIR")
+    if d:
+        return d
+    if os.path.isdir("/dev/shm"):
+        return "/dev/shm"
+    import tempfile
+
+    return tempfile.gettempdir()
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """A small, picklable handle to a shared-memory object.
+
+    The control-plane analog of ``ray.ObjectRef``: queues and RPC messages
+    carry these, never the underlying buffers.
+    """
+
+    object_id: str
+    nbytes: int
+    session: str = ""
+
+
+class ColumnBatch(Mapping[str, np.ndarray]):
+    """A named collection of equal-length columns backed by one mapping.
+
+    Zero-copy view over a store segment (or plain in-memory arrays when
+    constructed directly). Mapping protocol yields column name -> ndarray.
+    """
+
+    def __init__(self, columns: Dict[str, np.ndarray], _keepalive=None):
+        self._columns = columns
+        self._keepalive = _keepalive
+        lengths = {len(v) for v in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: {lengths}")
+        self._num_rows = lengths.pop() if lengths else 0
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return self._columns[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._columns)
+
+    def __len__(self) -> int:
+        return len(self._columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def columns(self) -> Dict[str, np.ndarray]:
+        return self._columns
+
+    @property
+    def nbytes(self) -> int:
+        return sum(v.nbytes for v in self._columns.values())
+
+    def take(self, indices: np.ndarray) -> "ColumnBatch":
+        """Row gather: the core shuffle primitive (one gather per column)."""
+        return ColumnBatch({k: v[indices] for k, v in self._columns.items()})
+
+    def slice(self, start: int, stop: int) -> "ColumnBatch":
+        """Zero-copy row slice."""
+        return ColumnBatch(
+            {k: v[start:stop] for k, v in self._columns.items()},
+            _keepalive=self._keepalive,
+        )
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({k: v for k, v in self._columns.items()})
+
+    @staticmethod
+    def from_pandas(df) -> "ColumnBatch":
+        return ColumnBatch(
+            {str(c): np.ascontiguousarray(df[c].to_numpy()) for c in df.columns}
+        )
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        batches = [b for b in batches if b is not None and b.num_rows > 0]
+        if not batches:
+            return ColumnBatch({})
+        if len(batches) == 1:
+            return batches[0]
+        keys = list(batches[0])
+        return ColumnBatch(
+            {k: np.concatenate([b[k] for b in batches]) for k in keys}
+        )
+
+
+@dataclass
+class StoreStats:
+    num_objects: int = 0
+    total_bytes: int = 0
+
+
+class ObjectStore:
+    """Session-scoped object store over ``/dev/shm`` files.
+
+    All objects created under one session share an id prefix so that
+    ``cleanup()`` can reclaim everything the session produced, and
+    ``store_stats()`` can report utilization for just this session.
+    """
+
+    def __init__(self, session: str, shm_dir: Optional[str] = None):
+        self.session = session
+        self.shm_dir = shm_dir or _default_shm_dir()
+
+    # -- write path ---------------------------------------------------------
+
+    def put_columns(self, columns: Mapping[str, np.ndarray]) -> ObjectRef:
+        """Write a columnar batch as one aligned segment; return its ref."""
+        cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
+        meta: List[dict] = []
+        offset = 0
+        # Header is written first; buffer offsets are relative to payload
+        # start, which is itself aligned.
+        for name, arr in cols.items():
+            offset = _align(offset)
+            meta.append(
+                {
+                    "name": name,
+                    "dtype": arr.dtype.str,
+                    "shape": list(arr.shape),
+                    "offset": offset,
+                    "nbytes": arr.nbytes,
+                }
+            )
+            offset += arr.nbytes
+        payload_bytes = _align(offset)
+        meta_blob = json.dumps({"columns": meta}).encode()
+        payload_start = _align(_HEADER.size + len(meta_blob))
+        total = payload_start + payload_bytes
+
+        object_id = f"{self.session}-{secrets.token_hex(8)}"
+        path = os.path.join(self.shm_dir, object_id)
+        tmp = path + ".tmp"
+        fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, max(total, 1))
+            mm = mmap.mmap(fd, max(total, 1))
+            try:
+                mm[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
+                mm[_HEADER.size : _HEADER.size + len(meta_blob)] = meta_blob
+                for m, arr in zip(meta, cols.values()):
+                    start = payload_start + m["offset"]
+                    dst = np.frombuffer(
+                        mm, dtype=np.uint8, count=arr.nbytes, offset=start
+                    )
+                    dst[:] = arr.reshape(-1).view(np.uint8)
+                    # Drop the exported buffer before close, else mmap.close
+                    # raises BufferError.
+                    del dst
+            finally:
+                mm.close()
+        finally:
+            os.close(fd)
+        os.rename(tmp, path)  # atomic publish
+        return ObjectRef(object_id=object_id, nbytes=total, session=self.session)
+
+    def put_bytes(self, data: bytes) -> ObjectRef:
+        return self.put_columns({"__bytes__": np.frombuffer(data, np.uint8)})
+
+    # -- read path ----------------------------------------------------------
+
+    def get_columns(self, ref: ObjectRef) -> ColumnBatch:
+        """Open a segment and return zero-copy column views onto it."""
+        path = os.path.join(self.shm_dir, ref.object_id)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        magic, meta_len = _HEADER.unpack_from(mm, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"corrupt object segment {ref.object_id!r}")
+        meta = json.loads(bytes(mm[_HEADER.size : _HEADER.size + meta_len]))
+        payload_start = _align(_HEADER.size + meta_len)
+        cols: Dict[str, np.ndarray] = {}
+        for m in meta["columns"]:
+            arr = np.frombuffer(
+                mm,
+                dtype=np.dtype(m["dtype"]),
+                count=int(np.prod(m["shape"])) if m["shape"] else 1,
+                offset=payload_start + m["offset"],
+            ).reshape(m["shape"])
+            cols[m["name"]] = arr
+        return ColumnBatch(cols, _keepalive=mm)
+
+    def get_bytes(self, ref: ObjectRef) -> bytes:
+        return self.get_columns(ref)["__bytes__"].tobytes()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def free(self, refs) -> None:
+        if isinstance(refs, ObjectRef):
+            refs = [refs]
+        for ref in refs:
+            try:
+                os.unlink(os.path.join(self.shm_dir, ref.object_id))
+            except FileNotFoundError:
+                pass
+
+    def exists(self, ref: ObjectRef) -> bool:
+        return os.path.exists(os.path.join(self.shm_dir, ref.object_id))
+
+    def store_stats(self) -> StoreStats:
+        """Utilization for this session (replaces the reference's raylet
+        ``FormatGlobalMemoryInfo`` probe, ``stats.py:675-683``)."""
+        stats = StoreStats()
+        prefix = f"{self.session}-"
+        try:
+            names = os.listdir(self.shm_dir)
+        except FileNotFoundError:
+            return stats
+        for name in names:
+            if name.startswith(prefix) and not name.endswith(".tmp"):
+                try:
+                    stats.total_bytes += os.stat(
+                        os.path.join(self.shm_dir, name)
+                    ).st_size
+                    stats.num_objects += 1
+                except FileNotFoundError:
+                    pass
+        return stats
+
+    def cleanup(self) -> None:
+        prefix = f"{self.session}-"
+        try:
+            names = os.listdir(self.shm_dir)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name.startswith(prefix):
+                try:
+                    os.unlink(os.path.join(self.shm_dir, name))
+                except FileNotFoundError:
+                    pass

@@ -1,0 +1,210 @@
+"""Task execution: a process pool with futures and a ``wait`` primitive.
+
+Replaces Ray's ``@ray.remote`` task layer that the reference uses for its
+shuffle map/reduce stages (``shuffle.py:129,171``) and data generation
+(``data_generation.py:30``). Tasks are plain importable functions; arguments
+and results that are bulk data travel through the shared-memory
+:mod:`.store` as :class:`~.store.ObjectRef` — the worker pool only moves
+pickled control messages.
+
+Workers are **spawned** (fresh interpreters): they never inherit JAX/TPU
+state from the driver, so shuffle CPU work cannot corrupt the TPU client.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class TaskError(Exception):
+    """A task raised; carries the remote traceback."""
+
+
+class TaskFuture:
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[str] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"task {self.task_id} not done after {timeout}s")
+        if self._error is not None:
+            raise TaskError(self._error)
+        return self._result
+
+    def _fulfill(self, result, error):
+        self._result = result
+        self._error = error
+        self._event.set()
+
+
+def wait(
+    futures: Sequence[TaskFuture],
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[TaskFuture], List[TaskFuture]]:
+    """``ray.wait`` analog: block until ``num_returns`` futures complete;
+    return (done, pending) preserving submission order."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        done = [f for f in futures if f.done()]
+        if len(done) >= num_returns:
+            pending = [f for f in futures if not f.done()]
+            return done, pending
+        if deadline is not None and _time.monotonic() > deadline:
+            pending = [f for f in futures if not f.done()]
+            return done, pending
+        _time.sleep(0.001)
+
+
+def _worker_main(task_q, result_q, env: Dict[str, str]):
+    import pickle
+
+    os.environ.update(env)
+    pid = os.getpid()
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        # Announce task start so the driver can attribute in-flight tasks
+        # to this worker if it dies mid-task.
+        task_id, blob = item
+        result_q.put(("start", task_id, pid))
+        try:
+            fn, args, kwargs = pickle.loads(blob)
+            result = fn(*args, **kwargs)
+            result_q.put(("done", task_id, result, None))
+        except Exception:
+            result_q.put(("done", task_id, None, traceback.format_exc()))
+
+
+class WorkerPool:
+    """Fixed pool of spawned worker processes with a shared task queue."""
+
+    def __init__(self, num_workers: int, env: Optional[Dict[str, str]] = None):
+        self.num_workers = num_workers
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        env = dict(env or {})
+        # Workers are CPU-side shuffle executors; keep them off the TPU.
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self._procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(self._task_q, self._result_q, env),
+                daemon=True,
+            )
+            for _ in range(num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._futures: Dict[int, TaskFuture] = {}
+        self._futures_lock = threading.Lock()
+        self._running_on: Dict[int, int] = {}  # task_id -> worker pid
+        self._next_id = 0
+        self._closed = False
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._collector.start()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
+
+    def _collect(self):
+        while True:
+            try:
+                item = self._result_q.get()
+            except (EOFError, OSError):
+                break
+            if item is None:
+                break
+            if item[0] == "start":
+                _, task_id, pid = item
+                with self._futures_lock:
+                    self._running_on[task_id] = pid
+                continue
+            _, task_id, result, error = item
+            with self._futures_lock:
+                fut = self._futures.pop(task_id, None)
+                self._running_on.pop(task_id, None)
+            if fut is not None:
+                fut._fulfill(result, error)
+
+    def _watch(self):
+        # Fail in-flight tasks whose worker died (e.g. OOM-killed) so
+        # callers get a TaskError instead of hanging forever.
+        import time as _time
+
+        while not self._closed:
+            _time.sleep(0.5)
+            dead = [
+                p.pid for p in self._procs if not p.is_alive() and p.exitcode
+            ]
+            if not dead:
+                continue
+            with self._futures_lock:
+                lost = [
+                    (tid, pid)
+                    for tid, pid in self._running_on.items()
+                    if pid in dead
+                ]
+                futs = []
+                for tid, pid in lost:
+                    fut = self._futures.pop(tid, None)
+                    self._running_on.pop(tid, None)
+                    if fut is not None:
+                        futs.append((fut, pid))
+            for fut, pid in futs:
+                fut._fulfill(
+                    None, f"worker process {pid} died while running this task"
+                )
+
+    def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
+        import pickle
+
+        if self._closed:
+            raise RuntimeError("worker pool is shut down")
+        # Pickle eagerly: mp.Queue pickles in a background feeder thread
+        # where a PicklingError would be swallowed and the future never
+        # fulfilled; raising here puts the error in the caller's lap.
+        blob = pickle.dumps((fn, args, kwargs))
+        with self._futures_lock:
+            task_id = self._next_id
+            self._next_id += 1
+            fut = TaskFuture(task_id)
+            self._futures[task_id] = fut
+        self._task_q.put((task_id, blob))
+        return fut
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except Exception:
+                pass
+        for p in self._procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        try:
+            self._result_q.put(None)
+        except Exception:
+            pass
+        # Fail any outstanding futures so waiters don't hang forever.
+        with self._futures_lock:
+            for fut in self._futures.values():
+                fut._fulfill(None, "worker pool shut down")
+            self._futures.clear()

@@ -1,0 +1,102 @@
+"""Control-plane message transport.
+
+Length-prefixed pickle frames over stream sockets. Addresses are tagged
+tuples so the same protocol runs over unix-domain sockets on one host and
+over TCP between TPU-VM hosts (the DCN control path) — replacing Ray's gRPC
+control plane (reference depends on Ray core for all RPC, ``setup.py:14-20``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+_LEN = struct.Struct("<Q")
+
+# Address = ("unix", path) | ("tcp", host, port)
+Address = Tuple
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+loads = pickle.loads
+
+
+# -- sync client side -------------------------------------------------------
+
+
+class Connection:
+    """A blocking framed connection (one per calling thread)."""
+
+    def __init__(self, address: Address, timeout: float = None):
+        self.address = address
+        if address[0] == "unix":
+            self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self.sock.connect(address[1])
+        elif address[0] == "tcp":
+            self.sock = socket.create_connection((address[1], address[2]))
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        else:
+            raise ValueError(f"unknown address scheme: {address!r}")
+        if timeout is not None:
+            self.sock.settimeout(timeout)
+
+    def send(self, obj: Any) -> None:
+        payload = dumps(obj)
+        self.sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def recv(self) -> Any:
+        header = self._recv_exact(_LEN.size)
+        (length,) = _LEN.unpack(header)
+        return loads(self._recv_exact(length))
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("connection closed by peer")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- asyncio side (used by actor servers and async clients) -----------------
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    return loads(await reader.readexactly(length))
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    payload = dumps(obj)
+    writer.write(_LEN.pack(len(payload)) + payload)
+
+
+async def open_connection(address: Address):
+    if address[0] == "unix":
+        return await asyncio.open_unix_connection(address[1])
+    elif address[0] == "tcp":
+        return await asyncio.open_connection(address[1], address[2])
+    raise ValueError(f"unknown address scheme: {address!r}")
+
+
+async def start_server(address: Address, handler):
+    if address[0] == "unix":
+        return await asyncio.start_unix_server(handler, path=address[1])
+    elif address[0] == "tcp":
+        return await asyncio.start_server(handler, address[1], address[2])
+    raise ValueError(f"unknown address scheme: {address!r}")

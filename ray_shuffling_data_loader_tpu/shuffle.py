@@ -1,0 +1,302 @@
+"""Per-epoch distributed map/reduce shuffle over Parquet.
+
+Capability parity with the reference shuffle engine (``shuffle.py:51-219``),
+re-designed columnar/TPU-first instead of DataFrame-at-a-time:
+
+* **map** (one task per input file): decode Parquet straight to contiguous
+  numpy columns via Arrow, draw a seeded random reducer assignment, and
+  partition rows with a *single stable argsort + one gather per column*
+  (the reference builds ``num_reducers`` boolean masks over a DataFrame —
+  O(R·N) row scans, ``shuffle.py:156-161``). Partitions are published to the
+  shared-memory store; only refs travel.
+* **reduce** (one task per reducer): concatenate its partition from every
+  mapper and apply a seeded full permutation — again one gather per column
+  (the reference pays ``pd.concat`` + ``DataFrame.sample(frac=1)``,
+  ``shuffle.py:192-194``). The output segment is column-contiguous and
+  64-byte aligned: exactly the layout ``jax.device_put`` stages from, so
+  the delivery layer never re-packs rows.
+* **delivery**: reducer outputs are assigned to trainer ranks by contiguous
+  split (reference ``np.array_split``, ``shuffle.py:125``) and pushed to the
+  consumer *as each reducer finishes* (the reference enqueues Ray futures
+  upfront and lets ``ray.wait`` block; here completed refs stream out, which
+  is strictly earlier availability).
+* **epoch pipelining**: ``shuffle`` admits epoch ``e`` only when the
+  consumer's epoch window allows (``wait_until_ready``), then kicks off the
+  epoch's tasks and moves on — up to ``max_concurrent_epochs`` epochs of
+  shuffle work overlap training, throttled by consumer ``task_done`` acks
+  (reference ``shuffle.py:72-79`` + ``batch_queue.py:395-418``).
+
+Determinism: all randomness derives from ``np.random.SeedSequence(seed,
+epoch, stage, index)``, so a given ``(seed, epoch)`` yields a reproducible
+global permutation — a property the reference lacks (it uses the global
+numpy RNG, ``shuffle.py:156,194``) and which the exactly-once tests rely on.
+"""
+
+from __future__ import annotations
+
+import threading
+import timeit
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
+from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture, wait
+
+
+class BatchConsumer:
+    """Interface for consumers of shuffle outputs (reference
+    ``shuffle.py:11-43``)."""
+
+    def consume(self, rank: int, epoch: int, batches: List[ObjectRef]):
+        """Consume the provided batches for the given trainer and epoch."""
+        raise NotImplementedError
+
+    def producer_done(self, rank: int, epoch: int):
+        """All batches for (epoch, rank) have been produced."""
+        raise NotImplementedError
+
+    def wait_until_ready(self, epoch: int):
+        """Block until the consumer can admit this epoch."""
+        raise NotImplementedError
+
+    def wait_until_all_epochs_done(self):
+        """Block until every batch of every epoch has been consumed."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Map / reduce tasks (run in spawned pool workers; no JAX, no TPU)
+# ---------------------------------------------------------------------------
+
+
+def read_parquet_columns(filename: str) -> ColumnBatch:
+    """Decode a Parquet file to contiguous numpy columns (Arrow C++ decode
+    stays on host CPUs, per SURVEY §2b)."""
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(filename)
+    cols = {}
+    for name, col in zip(table.column_names, table.columns):
+        arr = col.to_numpy(zero_copy_only=False)
+        cols[name] = np.ascontiguousarray(arr)
+    return ColumnBatch(cols)
+
+
+def _map_seed(seed: int, epoch: int, file_index: int) -> np.random.Generator:
+    return np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(0, epoch, file_index))
+    )
+
+def _reduce_seed(seed: int, epoch: int, reducer: int) -> np.random.Generator:
+    return np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(1, epoch, reducer))
+    )
+
+
+def shuffle_map(
+    filename: str,
+    file_index: int,
+    num_reducers: int,
+    epoch: int,
+    seed: int,
+    stats_collector=None,
+) -> List[ObjectRef]:
+    """Map stage: load one file, randomly partition its rows across reducers.
+
+    Returns ``num_reducers`` store refs (reference ``shuffle_map`` returns
+    ``num_returns=num_reducers`` object refs, ``shuffle.py:129-168``).
+    """
+    if stats_collector is not None:
+        stats_collector.call_oneway("map_start", epoch)
+    start = timeit.default_timer()
+    ctx = runtime.ensure_initialized()
+    batch = read_parquet_columns(filename)
+    end_read = timeit.default_timer()
+
+    n = batch.num_rows
+    assert n > num_reducers, (n, num_reducers)
+    rng = _map_seed(seed, epoch, file_index)
+    assignment = rng.integers(num_reducers, size=n)
+    # Stable counting sort: rows grouped by reducer with one gather/column.
+    order = np.argsort(assignment, kind="stable")
+    counts = np.bincount(assignment, minlength=num_reducers)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    grouped = batch.take(order)
+    refs = [
+        ctx.store.put_columns(
+            grouped.slice(int(offsets[i]), int(offsets[i + 1])).columns
+        )
+        for i in range(num_reducers)
+    ]
+    duration = timeit.default_timer() - start
+    if stats_collector is not None:
+        stats_collector.call_oneway(
+            "map_done", epoch, duration, end_read - start
+        )
+    return refs
+
+
+def shuffle_reduce(
+    reduce_index: int,
+    epoch: int,
+    seed: int,
+    part_refs: Sequence[ObjectRef],
+    stats_collector=None,
+) -> ObjectRef:
+    """Reduce stage: concat this reducer's partition from every mapper and
+    fully permute it (reference ``shuffle_reduce``, ``shuffle.py:171-200``).
+
+    Frees the consumed mapper partitions (the Ray build gets this from
+    distributed ref-counting GC).
+    """
+    if stats_collector is not None:
+        stats_collector.call_oneway("reduce_start", epoch)
+    start = timeit.default_timer()
+    ctx = runtime.ensure_initialized()
+    parts = [ctx.store.get_columns(r) for r in part_refs]
+    merged = ColumnBatch.concat(parts)
+    rng = _reduce_seed(seed, epoch, reduce_index)
+    perm = rng.permutation(merged.num_rows)
+    shuffled = merged.take(perm)
+    out_ref = ctx.store.put_columns(shuffled.columns)
+    del parts, merged, shuffled  # drop mmap views before unlinking
+    ctx.store.free(list(part_refs))
+    duration = timeit.default_timer() - start
+    if stats_collector is not None:
+        stats_collector.call_oneway("reduce_done", epoch, duration)
+    return out_ref
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+
+def shuffle_epoch(
+    epoch: int,
+    filenames: List[str],
+    batch_consumer: BatchConsumer,
+    num_reducers: int,
+    num_trainers: int,
+    seed: int = 0,
+    stats_collector=None,
+) -> threading.Thread:
+    """Kick off one epoch's shuffle; returns the delivery thread.
+
+    Submits all map tasks, then all reduce tasks (each gated on its mapper
+    inputs), and streams completed reducer outputs to the consumer in
+    reducer order. Calls ``producer_done`` per rank once that rank's last
+    reducer output is delivered (reference ``shuffle_epoch`` +
+    ``consume``, ``shuffle.py:89-126,203-219``).
+    """
+    if stats_collector is not None:
+        stats_collector.call_oneway("epoch_start", epoch)
+    pool = runtime.get_context().pool
+    map_futs: List[TaskFuture] = [
+        pool.submit(
+            shuffle_map, fname, i, num_reducers, epoch, seed, stats_collector
+        )
+        for i, fname in enumerate(filenames)
+    ]
+
+    # Rank assignment: contiguous split of reducer indices across trainers
+    # (reference np.array_split, shuffle.py:125).
+    rank_of = np.concatenate(
+        [
+            np.full(len(chunk), rank, dtype=np.int64)
+            for rank, chunk in enumerate(
+                np.array_split(np.arange(num_reducers), num_trainers)
+            )
+        ]
+    )
+
+    def deliver():
+        done_ranks = set()
+        try:
+            # Wait for all maps (reduce needs one partition per mapper).
+            per_file_refs = [f.result() for f in map_futs]
+            reduce_futs = [
+                pool.submit(
+                    shuffle_reduce,
+                    r,
+                    epoch,
+                    seed,
+                    [refs[r] for refs in per_file_refs],
+                    stats_collector,
+                )
+                for r in range(num_reducers)
+            ]
+            # Stream each reducer's output to its rank as soon as it
+            # completes, preserving reducer order within a rank for
+            # determinism.
+            for r, fut in enumerate(reduce_futs):
+                out_ref = fut.result()
+                rank = int(rank_of[r])
+                batch_consumer.consume(rank, epoch, [out_ref])
+                if r + 1 == num_reducers or rank_of[r + 1] != rank:
+                    batch_consumer.producer_done(rank, epoch)
+                    done_ranks.add(rank)
+        except BaseException as exc:
+            thread.error = exc
+        finally:
+            # Every rank gets its done sentinel even on failure (or when it
+            # was assigned zero reducers): consumers must unblock; the
+            # driver re-raises the stored error after joining.
+            for rank in range(num_trainers):
+                if rank not in done_ranks:
+                    try:
+                        batch_consumer.producer_done(rank, epoch)
+                    except Exception:
+                        pass
+
+    thread = threading.Thread(
+        target=deliver, name=f"shuffle-deliver-e{epoch}", daemon=True
+    )
+    thread.error = None
+    thread.start()
+    return thread
+
+
+def shuffle(
+    filenames: List[str],
+    batch_consumer: BatchConsumer,
+    num_epochs: int,
+    num_reducers: int,
+    num_trainers: int,
+    seed: int = 0,
+    stats_collector=None,
+) -> float:
+    """Shuffle the dataset every epoch; returns total wall-clock duration.
+
+    The top-level driver (reference ``shuffle``, ``shuffle.py:51-86``): for
+    each epoch, block until the consumer's epoch window admits it, then
+    launch that epoch's map/reduce/delivery pipeline.
+    """
+    runtime.ensure_initialized()
+    start = timeit.default_timer()
+    threads = []
+    for epoch in range(num_epochs):
+        batch_consumer.wait_until_ready(epoch)
+        threads.append(
+            shuffle_epoch(
+                epoch,
+                filenames,
+                batch_consumer,
+                num_reducers,
+                num_trainers,
+                seed=seed,
+                stats_collector=stats_collector,
+            )
+        )
+    for t in threads:
+        t.join()
+    batch_consumer.wait_until_all_epochs_done()
+    for t in threads:
+        if t.error is not None:
+            raise t.error
+    duration = timeit.default_timer() - start
+    if stats_collector is not None:
+        stats_collector.call_oneway("trial_done", duration)
+    return duration

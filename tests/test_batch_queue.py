@@ -1,0 +1,294 @@
+"""Batch-queue semantics tests.
+
+Mirrors the reference's queue coverage (``tests/test_batch_queue.py:23-288``):
+FIFO, blocking/non-blocking/timeout get/put, sync + async, batched ops,
+size tracking, shutdown, concurrency, and end-to-end streaming consumption
+with the producer-done sentinel."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue, Empty, Full
+
+
+@pytest.fixture
+def make_queue(local_runtime):
+    queues = []
+
+    def factory(**kwargs):
+        kwargs.setdefault("num_epochs", 1)
+        kwargs.setdefault("num_trainers", 1)
+        kwargs.setdefault("max_concurrent_epochs", 1)
+        q = BatchQueue(**kwargs)
+        q.ready()
+        queues.append(q)
+        return q
+
+    yield factory
+    for q in queues:
+        if q.actor is not None:
+            q.shutdown(force=True, grace_period_s=1)
+
+
+def test_simple_usage(make_queue):
+    q = make_queue()
+    items = list(range(10))
+    for item in items:
+        q.put(rank=0, epoch=0, item=item)
+    for item in items:
+        assert item == q.get(rank=0, epoch=0)
+
+
+def test_get(make_queue):
+    q = make_queue()
+    q.put(rank=0, epoch=0, item=0)
+    assert q.get(rank=0, epoch=0, block=False) == 0
+
+    q.put(rank=0, epoch=0, item=1)
+    assert q.get(rank=0, epoch=0, timeout=0.2) == 1
+
+    with pytest.raises(ValueError):
+        q.get(rank=0, epoch=0, timeout=-1)
+
+    with pytest.raises(Empty):
+        q.get_nowait(rank=0, epoch=0)
+
+    with pytest.raises(Empty):
+        q.get(rank=0, epoch=0, timeout=0.2)
+
+
+def test_get_async(make_queue):
+    q = make_queue()
+
+    async def scenario():
+        await q.put_async(rank=0, epoch=0, item=0)
+        assert await q.get_async(rank=0, epoch=0, block=False) == 0
+
+        await q.put_async(rank=0, epoch=0, item=1)
+        assert await q.get_async(rank=0, epoch=0, timeout=0.2) == 1
+
+        with pytest.raises(ValueError):
+            await q.get_async(rank=0, epoch=0, timeout=-1)
+
+        with pytest.raises(Empty):
+            await q.get_async(rank=0, epoch=0, block=False)
+
+        with pytest.raises(Empty):
+            await q.get_async(rank=0, epoch=0, timeout=0.2)
+
+    asyncio.run(scenario())
+
+
+def test_put(make_queue):
+    q = make_queue(maxsize=1)
+
+    q.put(rank=0, epoch=0, item=0, block=False)
+    assert q.get(rank=0, epoch=0) == 0
+
+    q.put(rank=0, epoch=0, item=1, timeout=0.2)
+    assert q.get(rank=0, epoch=0) == 1
+
+    with pytest.raises(ValueError):
+        q.put(rank=0, epoch=0, item=0, timeout=-1)
+
+    q.put(rank=0, epoch=0, item=0)
+    with pytest.raises(Full):
+        q.put_nowait(rank=0, epoch=0, item=1)
+
+    with pytest.raises(Full):
+        q.put(rank=0, epoch=0, item=1, timeout=0.2)
+
+
+def test_put_async(make_queue):
+    q = make_queue(maxsize=1)
+
+    async def scenario():
+        await q.put_async(rank=0, epoch=0, item=0, block=False)
+        assert await q.get_async(rank=0, epoch=0) == 0
+
+        await q.put_async(rank=0, epoch=0, item=1, timeout=0.2)
+        assert await q.get_async(rank=0, epoch=0) == 1
+
+        with pytest.raises(ValueError):
+            await q.put_async(rank=0, epoch=0, item=0, timeout=-1)
+
+        await q.put_async(rank=0, epoch=0, item=0)
+        with pytest.raises(Full):
+            await q.put_async(rank=0, epoch=0, item=1, block=False)
+
+        with pytest.raises(Full):
+            await q.put_async(rank=0, epoch=0, item=1, timeout=0.2)
+
+    asyncio.run(scenario())
+
+
+def test_concurrent_get(make_queue):
+    # A blocked get in another thread is fulfilled by a later put
+    # (reference uses a remote task, ``test_batch_queue.py:131-142``).
+    q = make_queue()
+    result = {}
+
+    def getter():
+        result["value"] = q.get(rank=0, epoch=0)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    with pytest.raises(Empty):
+        q.get_nowait(rank=0, epoch=0)
+    time.sleep(0.1)
+    assert t.is_alive()  # still blocked
+    q.put(rank=0, epoch=0, item=1)
+    t.join(timeout=5)
+    assert result["value"] == 1
+
+
+def test_concurrent_put(make_queue):
+    q = make_queue(maxsize=1)
+    q.put(rank=0, epoch=0, item=1)
+
+    t = threading.Thread(target=lambda: q.put(rank=0, epoch=0, item=2))
+    t.start()
+    with pytest.raises(Full):
+        q.put_nowait(rank=0, epoch=0, item=3)
+    time.sleep(0.1)
+    assert t.is_alive()  # blocked on full queue
+    assert q.get(rank=0, epoch=0) == 1
+    t.join(timeout=5)
+    assert q.get(rank=0, epoch=0) == 2
+
+
+def test_batch(make_queue):
+    q = make_queue(maxsize=1)
+
+    with pytest.raises(Full):
+        q.put_nowait_batch(rank=0, epoch=0, items=[1, 2])
+
+    with pytest.raises(Empty):
+        q.get_nowait_batch(rank=0, epoch=0, num_items=1)
+
+    big_q = make_queue(maxsize=100)
+    big_q.put_nowait_batch(rank=0, epoch=0, items=list(range(100)))
+    assert big_q.get_nowait_batch(rank=0, epoch=0, num_items=100) == list(
+        range(100)
+    )
+
+
+def test_qsize(make_queue):
+    q = make_queue()
+    items = list(range(10))
+    size = 0
+    assert q.qsize(rank=0, epoch=0) == size
+    for item in items:
+        q.put(rank=0, epoch=0, item=item)
+        size += 1
+        assert q.qsize(rank=0, epoch=0) == size
+    for item in items:
+        assert q.get(rank=0, epoch=0) == item
+        size -= 1
+        assert q.qsize(rank=0, epoch=0) == size
+    assert len(q) == 0
+
+
+def test_shutdown(make_queue):
+    q = make_queue()
+    actor = q.actor
+    q.shutdown()
+    assert q.actor is None
+    with pytest.raises(runtime.ActorDiedError):
+        actor.call("empty", 0, 0)
+
+
+def test_epoch_window_backpressure(make_queue):
+    # new_epoch blocks until the oldest epoch's producers are done AND all
+    # its items are task_done-acked (reference ``batch_queue.py:395-418``).
+    q = make_queue(num_epochs=3, num_trainers=1, max_concurrent_epochs=1)
+    q.new_epoch(0)
+    q.put(rank=0, epoch=0, item="a")
+    q.producer_done(rank=0, epoch=0)
+
+    admitted = threading.Event()
+
+    def admit_next():
+        q.new_epoch(1)
+        admitted.set()
+
+    t = threading.Thread(target=admit_next)
+    t.start()
+    time.sleep(0.3)
+    assert not admitted.is_set()  # epoch 0 not drained yet
+
+    assert q.get(rank=0, epoch=0) == "a"
+    assert q.get(rank=0, epoch=0) is None  # producer-done sentinel
+    q.task_done(rank=0, epoch=0, num_items=2)
+    t.join(timeout=5)
+    assert admitted.is_set()
+
+
+def test_producer_done_sentinel_via_get_batch(make_queue):
+    q = make_queue()
+    q.put_batch(rank=0, epoch=0, items=["x", "y"])
+    q.producer_done(rank=0, epoch=0)
+    time.sleep(0.1)
+    batch = q.get_batch(rank=0, epoch=0)
+    assert batch == ["x", "y", None]
+
+
+def test_connect_by_name(make_queue):
+    q = make_queue(name="bq-test-connect")
+    q.put(rank=0, epoch=0, item=42)
+    q2 = BatchQueue(
+        num_epochs=1,
+        num_trainers=1,
+        max_concurrent_epochs=1,
+        name="bq-test-connect",
+        connect=True,
+    )
+    assert q2.get(rank=0, epoch=0) == 42
+
+
+def test_pull_from_streaming_batch_queue(local_runtime, make_queue):
+    """End-to-end streaming consumption across epochs with refs through the
+    store (miniature of ``ShufflingDataset.__iter__``; reference
+    ``test_batch_queue.py:231-288``)."""
+    import numpy as np
+
+    store = local_runtime.store
+    num_epochs = 5
+    batch_size = 4
+    q = make_queue(
+        num_epochs=num_epochs, num_trainers=1, max_concurrent_epochs=num_epochs
+    )
+    consumed = []
+    done = threading.Event()
+
+    def consume():
+        for epoch in range(num_epochs):
+            is_done = False
+            while not is_done:
+                for item in q.get_batch(rank=0, epoch=epoch):
+                    if item is None:
+                        is_done = True
+                    else:
+                        consumed.extend(
+                            store.get_columns(item)["v"].tolist()
+                        )
+                        time.sleep(0.05)
+        done.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    data = list(range(batch_size * num_epochs))
+    for epoch, idx in enumerate(range(0, len(data), batch_size)):
+        refs = [
+            store.put_columns({"v": np.array([item])})
+            for item in data[idx : idx + batch_size]
+        ]
+        q.put_nowait_batch(rank=0, epoch=epoch, items=refs)
+        q.put_nowait(rank=0, epoch=epoch, item=None)
+    assert done.wait(timeout=30)
+    t.join()
+    assert sorted(consumed) == data

@@ -1,0 +1,166 @@
+"""Tests for the runtime substrate: store, actors, worker pool.
+
+The reference has no equivalent (it leans on Ray core); these cover the
+replacement layer (SURVEY.md §2b)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+from ray_shuffling_data_loader_tpu.runtime.tasks import TaskError, wait
+
+
+# -- object store -----------------------------------------------------------
+
+
+def test_store_roundtrip(local_runtime):
+    store = local_runtime.store
+    cols = {
+        "a": np.arange(100, dtype=np.int64),
+        "b": np.random.default_rng(0).random(100),
+    }
+    ref = store.put_columns(cols)
+    out = store.get_columns(ref)
+    assert list(out) == ["a", "b"]
+    np.testing.assert_array_equal(out["a"], cols["a"])
+    np.testing.assert_array_equal(out["b"], cols["b"])
+    assert out.num_rows == 100
+    stats = store.store_stats()
+    assert stats.num_objects >= 1
+    assert stats.total_bytes > 0
+    store.free(ref)
+    assert not store.exists(ref)
+
+
+def test_store_views_survive_free(local_runtime):
+    # The iterator frees segments while still holding views; pages must
+    # stay valid until the last view drops (POSIX unlink semantics).
+    store = local_runtime.store
+    ref = store.put_columns({"x": np.arange(1000)})
+    batch = store.get_columns(ref)
+    store.free(ref)
+    np.testing.assert_array_equal(batch["x"], np.arange(1000))
+
+
+def test_column_batch_ops():
+    cb = ColumnBatch({"a": np.arange(10), "b": np.arange(10) * 2.0})
+    taken = cb.take(np.array([3, 1, 4]))
+    np.testing.assert_array_equal(taken["a"], [3, 1, 4])
+    sliced = cb.slice(2, 5)
+    assert sliced.num_rows == 3
+    cat = ColumnBatch.concat([cb.slice(0, 4), cb.slice(4, 10)])
+    np.testing.assert_array_equal(cat["a"], np.arange(10))
+    with pytest.raises(ValueError):
+        ColumnBatch({"a": np.arange(3), "b": np.arange(4)})
+
+
+# -- worker pool ------------------------------------------------------------
+
+
+def _square(x):
+    return x * x
+
+
+def _boom():
+    raise RuntimeError("boom")
+
+
+def _slow(x, delay):
+    time.sleep(delay)
+    return x
+
+
+def test_pool_submit(local_runtime):
+    futs = [runtime.submit(_square, i) for i in range(8)]
+    assert [f.result(timeout=30) for f in futs] == [i * i for i in range(8)]
+
+
+def test_pool_error(local_runtime):
+    fut = runtime.submit(_boom)
+    with pytest.raises(TaskError, match="boom"):
+        fut.result(timeout=30)
+
+
+def test_pool_wait(local_runtime):
+    futs = [runtime.submit(_slow, i, 0.05 * i) for i in range(3)]
+    done, pending = wait(futs, num_returns=1, timeout=30)
+    assert len(done) >= 1
+
+
+# -- actors -----------------------------------------------------------------
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    async def slow_get(self, delay):
+        import asyncio
+
+        await asyncio.sleep(delay)
+        return self.value
+
+    def fail(self):
+        raise ValueError("actor failure")
+
+
+def test_actor_call(local_runtime):
+    h = runtime.spawn_actor(Counter, 10)
+    assert h.call("incr") == 11
+    assert h.call("incr", by=5) == 16
+    assert h.call("get") == 16
+    with pytest.raises(ValueError, match="actor failure"):
+        h.call("fail")
+    h.terminate()
+
+
+def test_actor_named_discovery(local_runtime):
+    h = runtime.spawn_actor(Counter, 7, name="counter-disco")
+    h2 = runtime.connect_actor("counter-disco")
+    assert h2.call("get") == 7
+    h2.call("incr")
+    assert h.call("get") == 8
+    h.terminate()
+
+
+def test_actor_concurrent_async_methods(local_runtime):
+    # A blocked async method must not stall other calls (the queue relies
+    # on this: a blocked `get` with a concurrent `put`).
+    h = runtime.spawn_actor(Counter, 1)
+    results = {}
+
+    def slow():
+        results["slow"] = h.call("slow_get", 0.8)
+
+    t = threading.Thread(target=slow)
+    t.start()
+    time.sleep(0.1)
+    start = time.monotonic()
+    assert h.call("get") == 1  # must return before slow_get completes
+    assert time.monotonic() - start < 0.6
+    t.join()
+    assert results["slow"] == 1
+    h.terminate()
+
+
+def test_actor_terminate_then_call_raises(local_runtime):
+    h = runtime.spawn_actor(Counter, 0)
+    h.terminate()
+    with pytest.raises(runtime.ActorDiedError):
+        h.call("get")
+
+
+def test_connect_unknown_actor_fails(local_runtime):
+    with pytest.raises(ValueError, match="Unable to connect"):
+        runtime.connect_actor("no-such-actor", num_retries=1)
