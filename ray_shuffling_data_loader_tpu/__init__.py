@@ -26,4 +26,21 @@ __version__ = "0.1.0"
 __all__ = [
     "ShufflingDataset",
     "shuffle",
+    "JaxShufflingDataset",
+    "TorchShufflingDataset",
 ]
+
+
+def __getattr__(name):
+    # Lazy: keep jax/torch imports out of CPU-side worker processes.
+    if name == "JaxShufflingDataset":
+        from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+
+        return JaxShufflingDataset
+    if name == "TorchShufflingDataset":
+        from ray_shuffling_data_loader_tpu.torch_dataset import (
+            TorchShufflingDataset,
+        )
+
+        return TorchShufflingDataset
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

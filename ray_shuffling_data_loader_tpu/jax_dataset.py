@@ -1,0 +1,316 @@
+"""JAX/TPU batch delivery: HBM-resident, mesh-sharded training batches.
+
+This layer replaces the reference's framework adapter + CUDA staging path
+(``torch_dataset.py:95-236`` DataFrame→CPU-tensor conversion, with the
+``.cuda()`` copy left to the user loop, ``ray_torch_shuffle.py:204-207``)
+with the TPU-native design from BASELINE.json's north star:
+
+* a background **stager thread** pulls exact-size columnar batches from
+  :class:`~.dataset.ShufflingDataset`, converts columns to device dtypes,
+  and dispatches **async ``jax.device_put``** onto the mesh — the transfer
+  of batch ``t+k`` overlaps the training step on batch ``t``;
+* a bounded ring (``prefetch_depth``, default 2 = double buffering)
+  applies backpressure so at most ``prefetch_depth`` batches are in flight
+  to HBM — the analog of the reference's ``ray.wait(fetch_local=True)``
+  prefetch (``dataset.py:132-137``), but targeting device memory;
+* yielded batches are **global ``jax.Array``s sharded along the mesh's
+  batch axis** (``NamedSharding(mesh, P('data', ...))``), so a ``pjit``-ed
+  train step consumes them with zero further data movement. In multi-host
+  pods each process stages its own rank's shard and the global array is
+  assembled with ``jax.make_array_from_process_local_data``.
+
+Batch spec parity: ``feature_columns`` / ``feature_types`` / ``label_column``
+etc. mirror the reference's Torch data spec (``torch_dataset.py:45-59``);
+dtypes default to TPU-friendly 32-bit (int64→int32, float64→float32).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+
+
+def _default_device_dtype(np_dtype: np.dtype) -> jnp.dtype:
+    """TPU-friendly narrowing: 64-bit host columns become 32-bit on device
+    (embedding indices never exceed int32 range in DATA_SPEC; fp64 is
+    unsupported-by-default on TPU anyway)."""
+    if np.issubdtype(np_dtype, np.integer):
+        return jnp.int32
+    if np.issubdtype(np_dtype, np.floating):
+        return jnp.float32
+    raise TypeError(f"unsupported column dtype {np_dtype}")
+
+
+@dataclass
+class JaxBatchSpec:
+    """Feature/label layout for device batches (parity:
+    ``_normalize_torch_data_spec``, reference ``torch_dataset.py:144-201``)."""
+
+    feature_columns: List[str]
+    label_column: str
+    feature_types: Optional[List[Any]] = None
+    feature_shapes: Optional[List[Optional[Tuple[int, ...]]]] = None
+    label_type: Any = None
+    label_shape: Optional[Tuple[int, ...]] = None
+
+    def normalize(self) -> "JaxBatchSpec":
+        n = len(self.feature_columns)
+        types = self.feature_types or [None] * n
+        shapes = self.feature_shapes or [None] * n
+        assert len(types) == n, "feature_types size must match feature_columns"
+        assert len(shapes) == n, "feature_shapes size must match feature_columns"
+        return JaxBatchSpec(
+            feature_columns=list(self.feature_columns),
+            label_column=self.label_column,
+            feature_types=list(types),
+            feature_shapes=[
+                tuple(s) if s is not None else None for s in shapes
+            ],
+            label_type=self.label_type,
+            label_shape=tuple(self.label_shape)
+            if self.label_shape is not None
+            else None,
+        )
+
+
+class HostToDeviceStats:
+    """Staging instrumentation: bytes staged, wall time in ``device_put``
+    dispatch, and consumer stall time (time the training loop waited on the
+    ring). The reference measures the trainer-side analog as batch wait time
+    (``ray_torch_shuffle.py:201-230``)."""
+
+    def __init__(self):
+        self.bytes_staged = 0
+        self.batches_staged = 0
+        self.put_dispatch_s = 0.0
+        self.stall_s = 0.0
+        self.stalls = 0
+        self.first_batch_s: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "bytes_staged": self.bytes_staged,
+            "batches_staged": self.batches_staged,
+            "put_dispatch_s": self.put_dispatch_s,
+            "stall_s": self.stall_s,
+            "stalls": self.stalls,
+            "first_batch_s": self.first_batch_s or 0.0,
+        }
+
+
+class JaxShufflingDataset:
+    """Shuffling dataset yielding mesh-sharded, HBM-resident JAX batches.
+
+    Iterating yields ``(features, label)`` where ``features`` is a dict
+    mapping feature column name to a global ``jax.Array`` sharded along
+    ``batch_axis``, and ``label`` likewise.
+
+    Args mirror :class:`~.dataset.ShufflingDataset` (reference
+    ``dataset.py:37-48``) plus the batch spec and device placement:
+
+    Args:
+        mesh: ``jax.sharding.Mesh`` to shard batches over. Default: a 1-D
+            ``('data',)`` mesh over all local devices.
+        batch_axis: mesh axis name carrying the batch dimension.
+        prefetch_depth: in-flight device batches (2 = double buffering).
+        drop_last: defaults to **True** here (unlike the reference's False,
+            ``dataset.py:43``): a ragged final batch would retrigger XLA
+            compilation; opt back in explicitly if you want the tail.
+    """
+
+    def __init__(
+        self,
+        filenames: List[str],
+        num_epochs: int,
+        num_trainers: int,
+        batch_size: int,
+        rank: int,
+        feature_columns: List[str],
+        label_column: str,
+        feature_types: Optional[List[Any]] = None,
+        feature_shapes: Optional[List[Any]] = None,
+        label_type: Any = None,
+        label_shape: Optional[Tuple[int, ...]] = None,
+        drop_last: bool = True,
+        num_reducers: Optional[int] = None,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        queue_name: str = "BatchQueue",
+        mesh: Optional[Mesh] = None,
+        batch_axis: str = "data",
+        prefetch_depth: int = 2,
+    ):
+        self._ds = ShufflingDataset(
+            filenames,
+            num_epochs,
+            num_trainers,
+            batch_size,
+            rank,
+            drop_last=drop_last,
+            num_reducers=num_reducers,
+            max_concurrent_epochs=max_concurrent_epochs,
+            seed=seed,
+            queue_name=queue_name,
+        )
+        self._spec = JaxBatchSpec(
+            feature_columns=feature_columns,
+            label_column=label_column,
+            feature_types=feature_types,
+            feature_shapes=feature_shapes,
+            label_type=label_type,
+            label_shape=label_shape,
+        ).normalize()
+        if mesh is None:
+            mesh = Mesh(np.array(jax.local_devices()), (batch_axis,))
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._prefetch_depth = max(1, prefetch_depth)
+        self.stats = HostToDeviceStats()
+        self._dtype_cache: Dict[str, Any] = {}
+
+    # -- spec application ---------------------------------------------------
+
+    def _device_view(self, column: np.ndarray, dtype, shape) -> np.ndarray:
+        target = dtype or _default_device_dtype(column.dtype)
+        arr = np.asarray(column)
+        if arr.dtype != np.dtype(target):
+            arr = arr.astype(target)
+        if shape is not None:
+            arr = arr.reshape((-1, *shape))
+        return arr
+
+    def _stage(self, cb: ColumnBatch):
+        """Convert one host batch and dispatch its async H2D transfer."""
+        spec = self._spec
+        host: Dict[str, np.ndarray] = {}
+        for col, dtype, shape in zip(
+            spec.feature_columns, spec.feature_types, spec.feature_shapes
+        ):
+            host[col] = self._device_view(cb[col], dtype, shape)
+        label = self._device_view(
+            cb[spec.label_column], spec.label_type, spec.label_shape
+        )
+
+        t0 = time.perf_counter()
+        features = {}
+        nbytes = 0
+        for col, arr in host.items():
+            features[col] = self._put(arr)
+            nbytes += arr.nbytes
+        label_arr = self._put(label)
+        nbytes += label.nbytes
+        self.stats.put_dispatch_s += time.perf_counter() - t0
+        self.stats.bytes_staged += nbytes
+        self.stats.batches_staged += 1
+        return features, label_arr
+
+    def _put(self, arr: np.ndarray):
+        sharding = NamedSharding(
+            self.mesh, P(self.batch_axis, *([None] * (arr.ndim - 1)))
+        )
+        if jax.process_count() > 1:
+            # Multi-host: this process stages its local shard; the global
+            # array spans the pod (SURVEY §7 M3).
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
+
+    # -- iteration ----------------------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        self._ds.set_epoch(epoch)
+
+    @property
+    def batch_size(self) -> int:
+        return self._ds.batch_size
+
+    def __iter__(self):
+        """Yield device batches through the prefetch ring.
+
+        The stager thread converts + dispatches transfers ``prefetch_depth``
+        batches ahead; the bounded queue is the ring's backpressure. Stall
+        accounting: time this (consumer) side blocks on the ring.
+        """
+        ring: "queue.Queue" = queue.Queue(maxsize=self._prefetch_depth)
+        SENTINEL = object()
+        cancel = threading.Event()
+        error: List[BaseException] = []
+        epoch_start = time.perf_counter()
+
+        def stager():
+            try:
+                for cb in self._ds:
+                    if cancel.is_set():
+                        # Early consumer exit (break mid-epoch): keep
+                        # draining the underlying dataset WITHOUT staging so
+                        # its task_done acks still flow and the epoch window
+                        # can advance; stage nothing more to HBM.
+                        continue
+                    item = self._stage(cb)
+                    while not cancel.is_set():
+                        try:
+                            ring.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as exc:  # surfaced on the consumer side
+                error.append(exc)
+            finally:
+                # Place the sentinel without ever displacing a real batch:
+                # block politely while the consumer drains; evict only when
+                # the consumer has cancelled (its drain may already be done).
+                while True:
+                    try:
+                        ring.put(SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if cancel.is_set():
+                            try:
+                                ring.get_nowait()
+                            except queue.Empty:
+                                pass
+
+        thread = threading.Thread(target=stager, name="hbm-stager", daemon=True)
+        thread.start()
+        try:
+            first = True
+            while True:
+                t0 = time.perf_counter()
+                item = ring.get()
+                waited = time.perf_counter() - t0
+                if first:
+                    self.stats.first_batch_s = time.perf_counter() - epoch_start
+                    first = False
+                elif waited > 0.0005:
+                    self.stats.stall_s += waited
+                    self.stats.stalls += 1
+                if item is SENTINEL:
+                    break
+                yield item
+        finally:
+            # Runs on normal completion AND on GeneratorExit (consumer broke
+            # out mid-epoch): unblock and retire the stager so the epoch's
+            # acks complete and the next epoch can start.
+            cancel.set()
+            while True:
+                try:
+                    if ring.get_nowait() is SENTINEL:
+                        break
+                except queue.Empty:
+                    if not thread.is_alive():
+                        break
+                    time.sleep(0.01)
+            thread.join()
+            if error:
+                raise error[0]

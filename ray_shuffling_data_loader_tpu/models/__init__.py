@@ -1,1 +1,9 @@
-"""models subpackage."""
+"""Model zoo. Flagship: DLRM-style tabular recommender matching the
+synthetic DATA_SPEC workload the loader feeds (reference trains a mocked
+ConvNet instead — ``examples/horovod/ray_torch_shuffle.py:124-140,214``)."""
+
+from ray_shuffling_data_loader_tpu.models.dlrm import (  # noqa: F401
+    TabularDLRM,
+    dlrm_for_data_spec,
+    example_features,
+)

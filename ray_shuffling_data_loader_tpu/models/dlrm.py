@@ -1,0 +1,120 @@
+"""Flagship model: DLRM-style tabular recommender over the DATA_SPEC schema.
+
+The reference ships only a toy ConvNet whose train step is mocked by
+``time.sleep`` (``examples/horovod/ray_torch_shuffle.py:124-140,214``); the
+actual workload its loader feeds is a DLRM-like tabular embedding model —
+17 categorical embedding columns + 2 one-hot columns + a float label
+(``data_generation.py:56-77``). This module implements that model properly,
+TPU-first:
+
+* per-column embedding tables, looked up with ``take`` (gather);
+* dot-interaction of embedding vectors (batched matmul → MXU) as in the
+  DLRM architecture, upper-triangle extracted with a static mask;
+* top MLP in **bfloat16 compute / float32 params** so the matmuls hit the
+  MXU at full rate; logits return in float32 for a stable loss.
+
+Sharding intent (consumed by :mod:`..parallel`): large embedding tables
+shard their vocab dimension across the ``model`` mesh axis; MLP layers and
+small tables replicate; activations shard along ``data``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+
+
+class TabularDLRM(nn.Module):
+    """DLRM-style model over named categorical columns.
+
+    Attributes:
+        vocab_sizes: column name -> cardinality.
+        embed_dim: embedding width (shared across tables, as in DLRM).
+        top_mlp: hidden widths of the top MLP.
+        compute_dtype: activation/matmul dtype (bfloat16 for MXU).
+    """
+
+    vocab_sizes: Dict[str, int]
+    embed_dim: int = 32
+    top_mlp: Sequence[int] = (256, 128, 64)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features: Dict[str, jax.Array]) -> jax.Array:
+        """features: column name -> int32 [batch] index array. Returns
+        float32 [batch] logits."""
+        embeds: List[jax.Array] = []
+        for col in sorted(self.vocab_sizes):
+            table = self.param(
+                f"embed_{col}",
+                nn.initializers.normal(stddev=1.0 / np.sqrt(self.embed_dim)),
+                (self.vocab_sizes[col], self.embed_dim),
+                jnp.float32,
+            )
+            idx = features[col].reshape(-1)
+            embeds.append(
+                jnp.take(table, idx, axis=0).astype(self.compute_dtype)
+            )
+
+        # [batch, num_cols, dim]
+        stacked = jnp.stack(embeds, axis=1)
+        num_cols = stacked.shape[1]
+        # Dot interaction: batched Gram matrix on the MXU.
+        inter = jnp.einsum(
+            "bnd,bmd->bnm", stacked, stacked, precision=jax.lax.Precision.DEFAULT
+        )
+        iu, ju = jnp.triu_indices(num_cols, k=1)
+        inter_flat = inter[:, iu, ju]  # [batch, n*(n-1)/2]
+
+        x = jnp.concatenate(
+            [stacked.reshape(stacked.shape[0], -1), inter_flat], axis=-1
+        )
+        for width in self.top_mlp:
+            x = nn.Dense(
+                width,
+                dtype=self.compute_dtype,
+                param_dtype=jnp.float32,
+            )(x)
+            x = nn.relu(x)
+        logit = nn.Dense(1, dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        return logit.reshape(-1).astype(jnp.float32)
+
+
+def dlrm_for_data_spec(
+    embed_dim: int = 32,
+    top_mlp: Sequence[int] = (256, 128, 64),
+    vocab_cap: Optional[int] = None,
+) -> TabularDLRM:
+    """Build the flagship model for the synthetic DATA_SPEC schema
+    (``data_generation.py:56-77`` cardinalities). ``vocab_cap`` shrinks
+    tables for tests/dry-runs."""
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        DATA_SPEC,
+        LABEL_COLUMN,
+    )
+
+    vocab_sizes = {
+        col: int(min(high, vocab_cap) if vocab_cap else high)
+        for col, (low, high, dtype) in DATA_SPEC.items()
+        if col != LABEL_COLUMN
+    }
+    return TabularDLRM(
+        vocab_sizes=vocab_sizes, embed_dim=embed_dim, top_mlp=tuple(top_mlp)
+    )
+
+
+def example_features(
+    model: TabularDLRM, batch_size: int, seed: int = 0
+) -> Dict[str, jax.Array]:
+    """A host-side example batch matching the model's schema."""
+    rng = np.random.default_rng(seed)
+    return {
+        col: jnp.asarray(
+            rng.integers(0, size, batch_size, dtype=np.int32)
+        )
+        for col, size in model.vocab_sizes.items()
+    }

@@ -1,1 +1,23 @@
-"""parallel subpackage."""
+"""Parallelism layer: device meshes, sharding rules, distributed train steps.
+
+DP (the reference's sole strategy, SURVEY §2b) as a first-class mesh axis,
+composing with a ``model`` axis for sharded embedding tables; gradient
+exchange via XLA collectives over ICI instead of Horovod/NCCL."""
+
+from ray_shuffling_data_loader_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    batch_spec,
+    make_mesh,
+    param_shardings,
+    param_spec,
+    replicated,
+)
+from ray_shuffling_data_loader_tpu.parallel.train import (  # noqa: F401
+    TrainState,
+    bce_loss,
+    init_state,
+    make_psum_train_step,
+    make_train_step,
+)

@@ -1,0 +1,177 @@
+"""Distributed train steps: pjit sharding-driven DP×MP, and an explicit
+``shard_map`` + ``lax.psum`` data-parallel step.
+
+This replaces the reference's gradient plane — Horovod ``DistributedOptimizer``
+over NCCL with fp16 compression and Adasum (``ray_torch_shuffle.py:183-193``)
+— with XLA collectives over ICI:
+
+* :func:`make_train_step` is the idiomatic path: everything under one
+  ``jax.jit`` with ``NamedSharding`` annotations; XLA inserts the gradient
+  ``psum`` (and any embedding-gather collectives for model-sharded tables)
+  and overlaps them with compute.
+* :func:`make_psum_train_step` is the explicit path: per-device code under
+  ``shard_map`` with a hand-written ``jax.lax.psum`` over the ``data`` axis
+  — the literal NCCL-allreduce analog, kept for parity and for readers
+  mapping from the Horovod example.
+
+Loss: binary cross-entropy on the synthetic float label
+(``DATA_SPEC['labels']`` is uniform [0,1); BCE against a soft target is
+well-defined and keeps the workload honest).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_shuffling_data_loader_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    param_shardings,
+    replicated,
+)
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def bce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean sigmoid binary cross-entropy with soft targets."""
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    return -jnp.mean(labels * log_p + (1.0 - labels) * log_not_p)
+
+
+def init_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    example_features: Dict[str, jax.Array],
+    rng: Optional[jax.Array] = None,
+    vocab_shard_threshold: Optional[int] = None,
+) -> Tuple[TrainState, Any]:
+    """Initialize a sharded TrainState directly on the mesh.
+
+    Parameter and optimizer-state arrays are *created* with their target
+    shardings (via ``jit`` + ``out_shardings``), so a vocab-sharded
+    embedding table never materializes unsharded on one device.
+
+    Returns ``(state, state_shardings)``.
+    """
+    rng = rng if rng is not None else jax.random.key(0)
+    kwargs = (
+        {"vocab_shard_threshold": vocab_shard_threshold}
+        if vocab_shard_threshold is not None
+        else {}
+    )
+
+    def _init(rng):
+        params = model.init(rng, example_features)
+        opt_state = optimizer.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+
+    shapes = jax.eval_shape(_init, rng)
+    # Optimizer-state arrays mirror parameter shapes, so the same per-shape
+    # rule shards Adam moments alongside their tables.
+    shardings = TrainState(
+        step=replicated(mesh),
+        params=param_shardings(shapes.params, mesh, **kwargs),
+        opt_state=param_shardings(shapes.opt_state, mesh, **kwargs),
+    )
+    state = jax.jit(_init, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    state_shardings,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Sharding-annotated jitted train step (idiomatic pjit path).
+
+    Batch arrives sharded along ``data`` (as produced by
+    ``JaxShufflingDataset``); XLA derives the gradient all-reduce.
+    """
+    batch_in = batch_sharding(mesh, 1)
+
+    def step_fn(state: TrainState, features, labels):
+        def loss_fn(params):
+            logits = model.apply(params, features)
+            return bce_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(
+            state_shardings,
+            None,  # features dict: let jax use committed input shardings
+            batch_in,
+        ),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
+def make_psum_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+) -> Callable:
+    """Explicit-DP train step: per-device compute under ``shard_map`` with a
+    hand-written ``lax.psum`` gradient exchange over ICI — the literal
+    replacement for Horovod's NCCL allreduce (``ray_torch_shuffle.py:188``).
+
+    Requires replicated params (pure DP; use :func:`make_train_step` when
+    sharding the model axis).
+    """
+    from jax import shard_map
+
+    def per_device_step(state: TrainState, features, labels):
+        def loss_fn(params):
+            logits = model.apply(params, features)
+            return bce_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # The gradient plane: mean-reduce across the data axis on ICI.
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(step=state.step + 1, params=params, opt_state=opt_state),
+            {"loss": loss},
+        )
+
+    batch_spec = P(DATA_AXIS)
+    rep = P()
+    sharded = shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(rep, batch_spec, batch_spec),
+        out_specs=(rep, rep),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,231 @@
+"""PyTorch adapter: shuffled batches as ``(features, label)`` CPU tensors.
+
+Capability parity with the reference's Torch layer
+(``torch_dataset.py:14-236``): an ``IterableDataset`` wrapping
+:class:`~.dataset.ShufflingDataset` plus a column-spec-driven
+batch→tensor converter (feature columns/shapes/dtypes, label column).
+Tensors are CPU-resident, exactly like the reference (the ``.cuda()`` copy
+was always left to the user loop, ``ray_torch_shuffle.py:204-207``); TPU
+users should prefer :class:`~.jax_dataset.JaxShufflingDataset`, which
+stages batches into HBM directly.
+
+Differences: the converter consumes :class:`~.runtime.ColumnBatch` columns
+(already contiguous numpy arrays — ``torch.as_tensor`` wraps them zero-copy)
+instead of DataFrame columns, and object-dtype columns of
+ndarrays/lists/tuples are stacked the same way the reference handles them
+(``torch_dataset.py:211-221``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import numpy as np
+import torch
+from torch.utils.data import IterableDataset
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+
+
+class TorchShufflingDataset(IterableDataset):
+    """A Torch shuffling dataset yielding ``(feature_tensors, label_tensor)``
+    batches (reference ``TorchShufflingDataset``, ``torch_dataset.py:14-92``).
+
+    Args match :class:`~.dataset.ShufflingDataset` plus the Torch data spec:
+    ``feature_columns``, optional ``feature_shapes`` / ``feature_types``,
+    ``label_column``, optional ``label_shape`` / ``label_type``.
+    """
+
+    def __init__(
+        self,
+        filenames: List[str],
+        num_epochs: int,
+        num_trainers: int,
+        batch_size: int,
+        rank: int,
+        drop_last: bool = False,
+        num_reducers: Optional[int] = None,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        queue_name: str = "BatchQueue",
+        feature_columns: List[Any] = None,
+        feature_shapes: Optional[List[Any]] = None,
+        feature_types: Optional[List[torch.dtype]] = None,
+        label_column: Any = None,
+        label_shape: Optional[int] = None,
+        label_type: Optional[torch.dtype] = None,
+    ):
+        super().__init__()
+        self._ds = ShufflingDataset(
+            filenames,
+            num_epochs,
+            num_trainers,
+            batch_size,
+            rank,
+            drop_last=drop_last,
+            num_reducers=num_reducers,
+            max_concurrent_epochs=max_concurrent_epochs,
+            seed=seed,
+            queue_name=queue_name,
+        )
+        self._batch_transform = batch_to_tensor_factory(
+            feature_columns=feature_columns,
+            feature_shapes=feature_shapes,
+            feature_types=feature_types,
+            label_column=label_column,
+            label_shape=label_shape,
+            label_type=label_type,
+        )
+
+    def set_epoch(self, epoch: int) -> None:
+        """Call before each epoch's iteration (reference
+        ``torch_dataset.py:78-88``)."""
+        self._ds.set_epoch(epoch)
+
+    def __iter__(self):
+        for batch in iter(self._ds):
+            yield self._batch_transform(batch)
+
+
+def batch_to_tensor_factory(
+    feature_columns: List[Any] = None,
+    feature_shapes: Optional[List[Any]] = None,
+    feature_types: Optional[List[torch.dtype]] = None,
+    label_column: Any = None,
+    label_shape: Optional[int] = None,
+    label_type: Optional[torch.dtype] = None,
+) -> Callable[[ColumnBatch], Tuple[List[torch.Tensor], torch.Tensor]]:
+    """Returns a ColumnBatch → ``(feature_tensors, label_tensor)`` converter
+    (reference ``dataframe_to_tensor_factory``, ``torch_dataset.py:95-141``)."""
+    (
+        feature_columns,
+        feature_shapes,
+        feature_types,
+        label_column,
+        label_shape,
+        label_type,
+    ) = _normalize_torch_data_spec(
+        feature_columns,
+        feature_shapes,
+        feature_types,
+        label_column,
+        label_shape,
+        label_type,
+    )
+    return functools.partial(
+        convert_to_tensor,
+        feature_columns=feature_columns,
+        feature_shapes=feature_shapes,
+        feature_types=feature_types,
+        label_column=label_column,
+        label_shape=label_shape,
+        label_type=label_type,
+    )
+
+
+# Backwards-compatible alias for users porting from the reference API.
+dataframe_to_tensor_factory = batch_to_tensor_factory
+
+
+def _normalize_torch_data_spec(
+    feature_columns: List[Any] = None,
+    feature_shapes: Optional[List[Any]] = None,
+    feature_types: Optional[List[torch.dtype]] = None,
+    label_column: Any = None,
+    label_shape: Optional[int] = None,
+    label_type: Optional[torch.dtype] = None,
+):
+    """Defaults for unspecified spec fields (reference
+    ``torch_dataset.py:144-201``): float dtype, ``(-1, 1)`` shapes."""
+    if not isinstance(feature_columns, list):
+        feature_columns = [feature_columns]
+
+    if feature_shapes:
+        if not isinstance(feature_shapes, list):
+            feature_shapes = [feature_shapes]
+        assert len(feature_columns) == len(
+            feature_shapes
+        ), "The feature_shapes size must match the feature_columns"
+        feature_shapes = [
+            s if isinstance(s, Iterable) else [s] for s in feature_shapes
+        ]
+    else:
+        feature_shapes = [None] * len(feature_columns)
+
+    if feature_types:
+        if not isinstance(feature_types, list):
+            feature_types = [feature_types]
+        assert len(feature_columns) == len(
+            feature_types
+        ), "The feature_types size must match the feature_columns"
+        assert all(
+            isinstance(dtype, torch.dtype) for dtype in feature_types
+        ), "All values in feature_types should be torch.dtype instances"
+    else:
+        feature_types = [torch.float] * len(feature_columns)
+
+    if not label_type:
+        label_type = torch.float
+
+    return (
+        feature_columns,
+        feature_shapes,
+        feature_types,
+        label_column,
+        label_shape,
+        label_type,
+    )
+
+
+def _column_values(batch, col) -> np.ndarray:
+    values = np.asarray(batch[col])
+    if not values.flags.writeable:
+        # Columns can be read-only shared-memory views; torch tensors must
+        # own writable memory or in-place ops would fault on the read-only
+        # pages (torch.as_tensor would only warn).
+        values = values.copy()
+    if values.dtype == object:
+        first = values[0]
+        if isinstance(first, np.ndarray):
+            values = np.stack(values)
+        elif isinstance(first, (list, tuple)):
+            values = np.asarray([np.asarray(v) for v in values])
+        else:
+            raise Exception(
+                f"Column {col}'s type: {type(first)} is not supported. It "
+                "must be a numpy built-in type or a numpy object of "
+                "(ndarray, list, tuple)"
+            )
+    return values
+
+
+def convert_to_tensor(
+    batch,
+    feature_columns: List[Any],
+    feature_shapes: List[Any],
+    feature_types: List[torch.dtype],
+    label_column: Any,
+    label_shape: Optional[int],
+    label_type: torch.dtype,
+):
+    """Column-spec-driven conversion (reference ``convert_to_tensor``,
+    ``torch_dataset.py:204-236``). Accepts a ColumnBatch or DataFrame."""
+    feature_tensor = []
+    for col, shape, dtype in zip(feature_columns, feature_shapes, feature_types):
+        t = torch.as_tensor(_column_values(batch, col), dtype=dtype)
+        if shape is not None:
+            t = t.view(*(-1, *shape))
+        else:
+            t = t.view(-1, 1)
+        feature_tensor.append(t)
+
+    label_tensor = torch.as_tensor(
+        _column_values(batch, label_column), dtype=label_type
+    )
+    if label_shape:
+        label_tensor = label_tensor.view(-1, label_shape)
+    else:
+        label_tensor = label_tensor.view(-1, 1)
+    return feature_tensor, label_tensor

@@ -12,12 +12,18 @@ in for the cluster (``tests/conftest.py:7-44`` in the reference).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin (when present) overrides JAX_PLATFORMS from the
+# environment; the config API takes precedence, so force CPU explicitly.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

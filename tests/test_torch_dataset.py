@@ -1,0 +1,136 @@
+"""Torch adapter tests: spec normalization, tensor conversion, end-to-end
+iteration (reference covers this layer only via a smoke ``__main__``,
+``torch_dataset.py:239-309``)."""
+
+import numpy as np
+import pytest
+import torch
+
+from ray_shuffling_data_loader_tpu.data_generation import (
+    DATA_SPEC,
+    LABEL_COLUMN,
+)
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+from ray_shuffling_data_loader_tpu.torch_dataset import (
+    TorchShufflingDataset,
+    batch_to_tensor_factory,
+    convert_to_tensor,
+    dataframe_to_tensor_factory,
+)
+
+
+def test_convert_basic():
+    cb = ColumnBatch(
+        {
+            "a": np.arange(6, dtype=np.int64),
+            "b": np.linspace(0, 1, 6),
+            "y": np.ones(6),
+        }
+    )
+    transform = batch_to_tensor_factory(
+        feature_columns=["a", "b"],
+        feature_types=[torch.int64, torch.float32],
+        label_column="y",
+    )
+    features, label = transform(cb)
+    assert len(features) == 2
+    assert features[0].dtype == torch.int64
+    assert features[0].shape == (6, 1)
+    assert features[1].dtype == torch.float32
+    assert label.shape == (6, 1)
+    assert label.dtype == torch.float32  # default label type
+
+
+def test_convert_shapes():
+    cb = ColumnBatch({"a": np.arange(12, dtype=np.float64), "y": np.ones(12)})
+    transform = batch_to_tensor_factory(
+        feature_columns=["a"],
+        feature_shapes=[(3,)],
+        label_column="y",
+        label_shape=1,
+    )
+    features, label = transform(cb)
+    assert features[0].shape == (4, 3)
+    assert label.shape == (12, 1)
+
+
+def test_convert_object_ndarray_column():
+    col = np.empty(3, dtype=object)
+    for i in range(3):
+        col[i] = np.full(4, i, dtype=np.float32)
+    cb = ColumnBatch({"vec": col, "y": np.zeros(3)})
+    transform = batch_to_tensor_factory(
+        feature_columns=["vec"], feature_shapes=[(4,)], label_column="y"
+    )
+    features, _ = transform(cb)
+    assert features[0].shape == (3, 4)
+    np.testing.assert_array_equal(
+        features[0].numpy()[2], np.full(4, 2, np.float32)
+    )
+
+
+def test_convert_object_unsupported():
+    col = np.empty(2, dtype=object)
+    col[0] = {"not": "supported"}
+    col[1] = {"not": "supported"}
+    cb = ColumnBatch({"bad": col, "y": np.zeros(2)})
+    transform = batch_to_tensor_factory(
+        feature_columns=["bad"], label_column="y"
+    )
+    with pytest.raises(Exception, match="not supported"):
+        transform(cb)
+
+
+def test_spec_size_mismatch_asserts():
+    with pytest.raises(AssertionError, match="feature_shapes"):
+        batch_to_tensor_factory(
+            feature_columns=["a", "b"], feature_shapes=[(1,)], label_column="y"
+        )
+    with pytest.raises(AssertionError, match="feature_types"):
+        batch_to_tensor_factory(
+            feature_columns=["a"],
+            feature_types=[torch.float, torch.int64],
+            label_column="y",
+        )
+
+
+def test_dataframe_alias_and_pandas_input():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": np.arange(4), "y": np.zeros(4)})
+    transform = dataframe_to_tensor_factory(
+        feature_columns=["a"], label_column="y"
+    )
+    features, label = transform(df)
+    assert features[0].shape == (4, 1)
+
+
+def test_torch_dataset_end_to_end(local_runtime, tmp_path_factory):
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+    data_dir = tmp_path_factory.mktemp("torch-data")
+    filenames, _ = generate_data(2000, 2, 1, 0.0, str(data_dir))
+    feature_columns = [c for c in DATA_SPEC if c != LABEL_COLUMN]
+    feature_types = [torch.int64] * len(feature_columns)
+    ds = TorchShufflingDataset(
+        filenames,
+        num_epochs=2,
+        num_trainers=1,
+        batch_size=300,
+        rank=0,
+        num_reducers=2,
+        queue_name="q-torch",
+        feature_columns=feature_columns,
+        feature_types=feature_types,
+        label_column=LABEL_COLUMN,
+        label_type=torch.float64,
+    )
+    for epoch in range(2):
+        ds.set_epoch(epoch)
+        total = 0
+        for features, label in ds:
+            assert len(features) == len(feature_columns)
+            assert all(t.shape[1] == 1 for t in features)
+            assert label.dtype == torch.float64
+            total += label.shape[0]
+        assert total == 2000
