@@ -35,28 +35,25 @@ SEED = 0
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 
 
-def _get_data(ctx):
-    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+def _get_data():
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        cached_generate_data,
+    )
 
-    tag = f"r{NUM_ROWS}_f{NUM_FILES}_g{ROW_GROUPS_PER_FILE}_s{SEED}"
-    data_dir = os.path.join(CACHE_DIR, tag)
-    manifest = os.path.join(data_dir, "manifest.json")
-    if os.path.exists(manifest):
-        with open(manifest) as f:
-            m = json.load(f)
-        if all(os.path.exists(p) for p in m["filenames"]):
-            return m["filenames"], m["num_bytes"]
+    data_dir = os.path.join(
+        CACHE_DIR, f"r{NUM_ROWS}_f{NUM_FILES}_g{ROW_GROUPS_PER_FILE}_s{SEED}"
+    )
+    os.makedirs(data_dir, exist_ok=True)
     t0 = time.perf_counter()
-    filenames, num_bytes = generate_data(
-        NUM_ROWS, NUM_FILES, ROW_GROUPS_PER_FILE, 0.0, data_dir, seed=SEED
+    filenames, num_bytes = cached_generate_data(
+        NUM_ROWS, NUM_FILES, ROW_GROUPS_PER_FILE, data_dir, seed=SEED
     )
-    print(
-        f"[bench] generated {num_bytes/1e9:.2f} GB in "
-        f"{time.perf_counter()-t0:.1f}s",
-        file=sys.stderr,
-    )
-    with open(manifest, "w") as f:
-        json.dump({"filenames": list(filenames), "num_bytes": num_bytes}, f)
+    if time.perf_counter() - t0 > 1.0:
+        print(
+            f"[bench] generated {num_bytes/1e9:.2f} GB in "
+            f"{time.perf_counter()-t0:.1f}s",
+            file=sys.stderr,
+        )
     return list(filenames), num_bytes
 
 
@@ -96,8 +93,8 @@ def main() -> None:
     )
 
     num_chips = max(1, len(jax.devices()))
-    ctx = runtime.init()
-    filenames, dataset_bytes = _get_data(ctx)
+    runtime.init()
+    filenames, dataset_bytes = _get_data()
 
     peak_gbps = _measure_peak_h2d_gbps()
     print(f"[bench] peak H2D: {peak_gbps:.2f} GB/s", file=sys.stderr)

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Benchmark sweep: grid over num_files × num_trainers × reducers_per_trainer
+# (reference ``benchmarks/benchmark_batch.sh:9-42``, which drives the same
+# grid through `ray exec cluster.yaml`; here the runtime is in-process on the
+# TPU-VM host so the sweep is a plain loop).
+#
+# The reference's full-scale workload is 4e8 rows (~64 GB) with batch 250k;
+# scale via NUM_ROWS for the hardware at hand.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NUM_ROWS="${NUM_ROWS:-400000000}"
+BATCH_SIZE="${BATCH_SIZE:-250000}"
+NUM_EPOCHS="${NUM_EPOCHS:-10}"
+NUM_TRIALS="${NUM_TRIALS:-2}"
+MAX_CONCURRENT_EPOCHS="${MAX_CONCURRENT_EPOCHS:-2}"
+STATS_DIR="${STATS_DIR:-benchmark_stats}"
+DATA_DIR="${DATA_DIR:-benchmark_data}"
+
+first=1
+for num_files in 100 50 25; do
+  for num_trainers in 16 8 4; do
+    for reducers_per_trainer in 4 3 2; do
+      num_reducers=$((num_trainers * reducers_per_trainer))
+      echo "=== files=${num_files} trainers=${num_trainers}" \
+           "reducers=${num_reducers} ==="
+      # Reuse data only across same-file-count configs; when num_files
+      # changes the old files must be cleared first or a later
+      # --use-old-data run would pick up the leftovers.
+      if [[ "${prev_files:-}" == "$num_files" ]]; then
+        data_flags="--use-old-data"
+      else
+        data_flags="--num-files ${num_files} --clear-old-data"
+      fi
+      python benchmarks/benchmark.py \
+        --num-rows "${NUM_ROWS}" \
+        ${data_flags} \
+        --num-row-groups-per-file 5 \
+        --batch-size "${BATCH_SIZE}" \
+        --num-epochs "${NUM_EPOCHS}" \
+        --num-trials "${NUM_TRIALS}" \
+        --max-concurrent-epochs "${MAX_CONCURRENT_EPOCHS}" \
+        --num-trainers "${num_trainers}" \
+        --num-reducers "${num_reducers}" \
+        --data-dir "${DATA_DIR}" \
+        --stats-dir "${STATS_DIR}" \
+        $([[ "$first" -eq 1 ]] || echo --no-overwrite-stats)
+      first=0
+      prev_files="$num_files"
+    done
+  done
+done

@@ -14,6 +14,7 @@ the runtime worker pool instead of Ray tasks.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Dict, List, Tuple
 
@@ -149,3 +150,38 @@ def generate_data(
     results = [f.result() for f in futures]
     filenames, data_sizes = zip(*results)
     return list(filenames), int(sum(data_sizes))
+
+
+def cached_generate_data(
+    num_rows: int,
+    num_files: int,
+    num_row_groups_per_file: int,
+    data_dir: str,
+    seed: int = 0,
+) -> Tuple[List[str], int]:
+    """Generate the dataset once and reuse it across runs via a manifest
+    keyed on the full workload spec (the reference caches its filename list
+    in a pickle keyed on nothing, ``ray_torch_shuffle.py:294-314`` — a seed
+    or row-group change there silently reuses stale data)."""
+    key = {
+        "num_rows": num_rows,
+        "num_files": num_files,
+        "num_row_groups_per_file": num_row_groups_per_file,
+        "seed": seed,
+    }
+    manifest_path = os.path.join(data_dir, "manifest.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if manifest.get("key") == key and all(
+            os.path.exists(p) for p in manifest["filenames"]
+        ):
+            return manifest["filenames"], manifest["num_bytes"]
+    filenames, num_bytes = generate_data(
+        num_rows, num_files, num_row_groups_per_file, 0.0, data_dir, seed=seed
+    )
+    with open(manifest_path, "w") as f:
+        json.dump(
+            {"key": key, "filenames": filenames, "num_bytes": num_bytes}, f
+        )
+    return filenames, num_bytes

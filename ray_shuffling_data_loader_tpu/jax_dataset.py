@@ -178,7 +178,6 @@ class JaxShufflingDataset:
         self.batch_axis = batch_axis
         self._prefetch_depth = max(1, prefetch_depth)
         self.stats = HostToDeviceStats()
-        self._dtype_cache: Dict[str, Any] = {}
 
     # -- spec application ---------------------------------------------------
 

@@ -235,6 +235,10 @@ def shuffle_epoch(
                 out_ref = fut.result()
                 rank = int(rank_of[r])
                 batch_consumer.consume(rank, epoch, [out_ref])
+                if stats_collector is not None:
+                    stats_collector.call_oneway(
+                        "consume", rank, epoch, out_ref.nbytes
+                    )
                 if r + 1 == num_reducers or rank_of[r + 1] != rank:
                     batch_consumer.producer_done(rank, epoch)
                     done_ranks.add(rank)
@@ -278,7 +282,14 @@ def shuffle(
     start = timeit.default_timer()
     threads = []
     for epoch in range(num_epochs):
+        throttle_start = timeit.default_timer()
         batch_consumer.wait_until_ready(epoch)
+        if stats_collector is not None:
+            stats_collector.call_oneway(
+                "epoch_throttle",
+                epoch,
+                timeit.default_timer() - throttle_start,
+            )
         threads.append(
             shuffle_epoch(
                 epoch,

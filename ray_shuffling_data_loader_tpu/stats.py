@@ -1,0 +1,504 @@
+"""Shuffle/delivery statistics: model, collectors, and report writers.
+
+Capability parity with the reference stats subsystem (``stats.py:24-699``):
+a dataclass tree of per-trial/epoch/stage stats, an async collector actor
+that shuffle tasks report timings to, a store-utilization sampler thread,
+and ``process_stats`` writing trial-, epoch-, and consumer-timeline CSVs.
+
+TPU-first differences:
+
+* Store utilization comes from this runtime's session-scoped shared-memory
+  store (:func:`~.runtime.store_stats`) instead of a raw gRPC probe into the
+  raylet (reference ``stats.py:653-683``).
+* The collector additionally understands trainer-side HBM staging stats
+  (bytes staged, ``device_put`` dispatch time, stall time) reported by
+  :class:`~.jax_dataset.JaxShufflingDataset` — the north-star metrics
+  (BASELINE.md: stall% and host→HBM bandwidth) are first-class columns.
+* Timings use ``timeit.default_timer`` wall-clock deltas reported by the
+  tasks themselves, exactly like the reference (``shuffle.py:149-167``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import csv
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Stats model (reference stats.py:24-64)
+# ---------------------------------------------------------------------------
+
+
+def _agg(values: Sequence[float]) -> Dict[str, float]:
+    if not values:
+        return {"avg": 0.0, "std": 0.0, "max": 0.0, "min": 0.0}
+    arr = np.asarray(values, dtype=np.float64)
+    return {
+        "avg": float(arr.mean()),
+        "std": float(arr.std()),
+        "max": float(arr.max()),
+        "min": float(arr.min()),
+    }
+
+
+@dataclass
+class ConsumeRecord:
+    """One reducer-batch delivery (the consumer-timeline row, reference
+    ``stats.py:591-602``)."""
+
+    rank: int
+    epoch: int
+    time_since_epoch_start: float
+    nbytes: int
+
+
+@dataclass
+class EpochStats:
+    """Per-epoch stage timings (reference ``stats.py:33-52``)."""
+
+    epoch: int
+    start_time: float = 0.0
+    duration: float = 0.0
+    throttle_duration: float = 0.0  # epoch-window admission wait
+    map_durations: List[float] = field(default_factory=list)
+    map_read_durations: List[float] = field(default_factory=list)
+    reduce_durations: List[float] = field(default_factory=list)
+    consume_records: List[ConsumeRecord] = field(default_factory=list)
+    # Stage windows: first task start -> last task done.
+    map_stage_duration: float = 0.0
+    reduce_stage_duration: float = 0.0
+
+    def row(self, trial: int) -> Dict[str, float]:
+        out = {
+            "trial": trial,
+            "epoch": self.epoch,
+            "duration": self.duration,
+            "throttle_duration": self.throttle_duration,
+            "map_stage_duration": self.map_stage_duration,
+            "reduce_stage_duration": self.reduce_stage_duration,
+            "num_map_tasks": len(self.map_durations),
+            "num_reduce_tasks": len(self.reduce_durations),
+        }
+        for k, v in _agg(self.map_durations).items():
+            out[f"map_task_{k}"] = v
+        for k, v in _agg(self.map_read_durations).items():
+            out[f"map_read_{k}"] = v
+        for k, v in _agg(self.reduce_durations).items():
+            out[f"reduce_task_{k}"] = v
+        for k, v in _agg(
+            [c.time_since_epoch_start for c in self.consume_records]
+        ).items():
+            out[f"consume_time_{k}"] = v
+        return out
+
+
+@dataclass
+class StoreSample:
+    timestamp: float
+    num_objects: int
+    total_bytes: int
+
+
+@dataclass
+class StagingStats:
+    """Trainer-side HBM staging report (from ``HostToDeviceStats.as_dict``)."""
+
+    rank: int
+    bytes_staged: int = 0
+    batches_staged: int = 0
+    put_dispatch_s: float = 0.0
+    stall_s: float = 0.0
+    stalls: int = 0
+    first_batch_s: float = 0.0
+
+
+@dataclass
+class TrialStats:
+    """Whole-trial stats (reference ``stats.py:55-64``)."""
+
+    trial: int = 0
+    duration: float = 0.0
+    num_rows: int = 0
+    num_epochs: int = 0
+    batch_size: int = 0
+    num_trainers: int = 1
+    epochs: List[EpochStats] = field(default_factory=list)
+    store_samples: List[StoreSample] = field(default_factory=list)
+    staging: List[StagingStats] = field(default_factory=list)
+
+    # -- derived metrics (reference stats.py:396-401) -----------------------
+
+    @property
+    def row_throughput(self) -> float:
+        return (
+            self.num_epochs * self.num_rows / self.duration
+            if self.duration
+            else 0.0
+        )
+
+    @property
+    def batch_throughput(self) -> float:
+        return self.row_throughput / self.batch_size if self.batch_size else 0.0
+
+    @property
+    def per_trainer_batch_throughput(self) -> float:
+        return self.batch_throughput / max(1, self.num_trainers)
+
+    @property
+    def max_store_bytes(self) -> int:
+        return max((s.total_bytes for s in self.store_samples), default=0)
+
+    @property
+    def avg_store_bytes(self) -> float:
+        if not self.store_samples:
+            return 0.0
+        return float(np.mean([s.total_bytes for s in self.store_samples]))
+
+    @property
+    def total_stall_s(self) -> float:
+        return sum(s.stall_s for s in self.staging)
+
+    @property
+    def total_bytes_staged(self) -> int:
+        return sum(s.bytes_staged for s in self.staging)
+
+    def row(self) -> Dict[str, float]:
+        out = {
+            "trial": self.trial,
+            "duration": self.duration,
+            "num_rows": self.num_rows,
+            "num_epochs": self.num_epochs,
+            "batch_size": self.batch_size,
+            "num_trainers": self.num_trainers,
+            "row_throughput": self.row_throughput,
+            "batch_throughput": self.batch_throughput,
+            "per_trainer_batch_throughput": self.per_trainer_batch_throughput,
+            "avg_object_store_bytes": self.avg_store_bytes,
+            "max_object_store_bytes": self.max_store_bytes,
+            "total_stall_s": self.total_stall_s,
+            "total_bytes_staged": self.total_bytes_staged,
+        }
+        for k, v in _agg([e.duration for e in self.epochs]).items():
+            out[f"epoch_duration_{k}"] = v
+        for k, v in _agg(
+            [d for e in self.epochs for d in e.map_durations]
+        ).items():
+            out[f"map_task_{k}"] = v
+        for k, v in _agg(
+            [d for e in self.epochs for d in e.reduce_durations]
+        ).items():
+            out[f"reduce_task_{k}"] = v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Collector actor (reference stats.py:72-255)
+# ---------------------------------------------------------------------------
+
+
+class TrialStatsCollector:
+    """Collects per-stage timing reports from shuffle tasks.
+
+    Run as a named runtime actor (``runtime.spawn_actor(TrialStatsCollector,
+    ...)``); shuffle tasks hold a picklable handle and report via
+    fire-and-forget ``call_oneway`` — the analog of the reference's
+    zero-CPU async stats actor (``stats.py:209-255``).
+
+    Stage windows are computed server-side from first-start / last-done
+    wall-clock, using the collector's own clock so tasks on different
+    workers need no clock agreement beyond this one process.
+    """
+
+    def __init__(
+        self,
+        num_epochs: int,
+        num_maps_per_epoch: int,
+        num_reduces_per_epoch: int,
+        num_rows: int = 0,
+        batch_size: int = 0,
+        num_trainers: int = 1,
+        trial: int = 0,
+    ):
+        self._num_maps = num_maps_per_epoch
+        self._num_reduces = num_reduces_per_epoch
+        self.stats = TrialStats(
+            trial=trial,
+            num_rows=num_rows,
+            num_epochs=num_epochs,
+            batch_size=batch_size,
+            num_trainers=num_trainers,
+        )
+        self._epochs: Dict[int, EpochStats] = {}
+        self._map_started: Dict[int, int] = {}
+        self._map_first_start: Dict[int, float] = {}
+        self._reduce_first_start: Dict[int, float] = {}
+        self._done = asyncio.Event()
+
+    def _epoch(self, epoch: int) -> EpochStats:
+        if epoch not in self._epochs:
+            self._epochs[epoch] = EpochStats(epoch=epoch)
+        return self._epochs[epoch]
+
+    # -- producer-side hooks (called from shuffle tasks/driver) -------------
+
+    def epoch_start(self, epoch: int) -> None:
+        self._epoch(epoch).start_time = time.time()
+
+    def epoch_throttle(self, epoch: int, duration: float) -> None:
+        self._epoch(epoch).throttle_duration = duration
+
+    def map_start(self, epoch: int) -> None:
+        self._map_first_start.setdefault(epoch, time.time())
+
+    def map_done(self, epoch: int, duration: float, read_duration: float) -> None:
+        e = self._epoch(epoch)
+        e.map_durations.append(duration)
+        e.map_read_durations.append(read_duration)
+        if len(e.map_durations) == self._num_maps:
+            e.map_stage_duration = time.time() - self._map_first_start.get(
+                epoch, e.start_time or time.time()
+            )
+
+    def reduce_start(self, epoch: int) -> None:
+        self._reduce_first_start.setdefault(epoch, time.time())
+
+    def reduce_done(self, epoch: int, duration: float) -> None:
+        e = self._epoch(epoch)
+        e.reduce_durations.append(duration)
+        if len(e.reduce_durations) == self._num_reduces:
+            e.reduce_stage_duration = time.time() - self._reduce_first_start.get(
+                epoch, e.start_time or time.time()
+            )
+            if e.start_time:
+                e.duration = time.time() - e.start_time
+
+    def consume(self, rank: int, epoch: int, nbytes: int = 0) -> None:
+        e = self._epoch(epoch)
+        e.consume_records.append(
+            ConsumeRecord(
+                rank=rank,
+                epoch=epoch,
+                time_since_epoch_start=(
+                    time.time() - e.start_time if e.start_time else 0.0
+                ),
+                nbytes=nbytes,
+            )
+        )
+
+    # -- trainer-side hooks --------------------------------------------------
+
+    def report_staging(self, rank: int, staging: Dict[str, float]) -> None:
+        self.stats.staging.append(
+            StagingStats(
+                rank=rank,
+                bytes_staged=int(staging.get("bytes_staged", 0)),
+                batches_staged=int(staging.get("batches_staged", 0)),
+                put_dispatch_s=float(staging.get("put_dispatch_s", 0.0)),
+                stall_s=float(staging.get("stall_s", 0.0)),
+                stalls=int(staging.get("stalls", 0)),
+                first_batch_s=float(staging.get("first_batch_s", 0.0)),
+            )
+        )
+
+    def store_sample(self, num_objects: int, total_bytes: int) -> None:
+        self.stats.store_samples.append(
+            StoreSample(
+                timestamp=time.time(),
+                num_objects=num_objects,
+                total_bytes=total_bytes,
+            )
+        )
+
+    # -- completion ----------------------------------------------------------
+
+    def trial_done(self, duration: float) -> None:
+        self.stats.duration = duration
+        self._done.set()
+
+    def _counts_complete(self) -> bool:
+        """All expected fire-and-forget reports have landed. trial_done and
+        task reports arrive on different connections, so completion must be
+        judged by count, not by trial_done ordering."""
+        if len(self._epochs) < self.stats.num_epochs:
+            return False
+        for e in self._epochs.values():
+            if (
+                len(e.map_durations) < self._num_maps
+                or len(e.reduce_durations) < self._num_reduces
+                or len(e.consume_records) < self._num_reduces
+            ):
+                return False
+        return True
+
+    async def get_stats(self, timeout: Optional[float] = None) -> TrialStats:
+        """Await trial completion — the done signal AND every per-task report
+        (oneway frames from worker connections may trail ``trial_done``) —
+        then return the full stats tree (the reference instead awaits its
+        consume futures, ``stats.py:251-255``)."""
+
+        async def _wait():
+            await self._done.wait()
+            while not self._counts_complete():
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(_wait(), timeout)
+        self.stats.epochs = [self._epochs[e] for e in sorted(self._epochs)]
+        return self.stats
+
+
+# ---------------------------------------------------------------------------
+# Store utilization sampler (reference stats.py:258-279, 686-699)
+# ---------------------------------------------------------------------------
+
+
+class ObjectStoreStatsCollector:
+    """Context manager sampling shared-memory store utilization on a daemon
+    thread every ``sample_period_s`` and reporting to the collector actor
+    (or accumulating locally when ``collector`` is None)."""
+
+    def __init__(self, collector=None, sample_period_s: float = 5.0):
+        self._collector = collector
+        self._period = sample_period_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples: List[StoreSample] = []
+
+    def _loop(self):
+        from ray_shuffling_data_loader_tpu import runtime
+
+        while not self._stop.wait(self._period):
+            try:
+                s = runtime.store_stats()
+            except Exception:
+                continue
+            sample = StoreSample(
+                timestamp=time.time(),
+                num_objects=s.num_objects,
+                total_bytes=s.total_bytes,
+            )
+            self.samples.append(sample)
+            if self._collector is not None:
+                try:
+                    self._collector.call_oneway(
+                        "store_sample", s.num_objects, s.total_bytes
+                    )
+                except Exception:
+                    pass
+
+    def __enter__(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="store-stats", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=2 * self._period)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Report writers (reference stats.py:287-625)
+# ---------------------------------------------------------------------------
+
+
+def _write_csv(path: str, rows: List[Dict], overwrite: bool) -> None:
+    if not rows:
+        return
+    write_header = overwrite or not os.path.exists(path)
+    mode = "w" if overwrite else "a"
+    with open(path, mode, newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        if write_header:
+            writer.writeheader()
+        writer.writerows(rows)
+
+
+def process_stats(
+    all_trial_stats: Sequence[TrialStats],
+    stats_dir: str = ".",
+    overwrite_stats: bool = True,
+    trial_csv: str = "trial_stats.csv",
+    epoch_csv: str = "epoch_stats.csv",
+    consume_csv: str = "consume_timeline.csv",
+) -> Dict[str, float]:
+    """Aggregate trials into three CSV artifacts + a summary dict.
+
+    The reference writes trial-level (~40 cols), epoch-level, and
+    consumer-timeline CSVs locally or to s3 via fsspec
+    (``stats.py:287-625``); here local filesystem (or any mounted path).
+    Returns the cross-trial summary (mean/std duration + throughputs).
+    """
+    os.makedirs(stats_dir, exist_ok=True)
+    trial_rows = [t.row() for t in all_trial_stats]
+    epoch_rows = [
+        e.row(t.trial) for t in all_trial_stats for e in t.epochs
+    ]
+    consume_rows = [
+        {
+            "trial": t.trial,
+            "epoch": c.epoch,
+            "rank": c.rank,
+            "time_since_epoch_start": c.time_since_epoch_start,
+            "nbytes": c.nbytes,
+        }
+        for t in all_trial_stats
+        for e in t.epochs
+        for c in e.consume_records
+    ]
+    _write_csv(os.path.join(stats_dir, trial_csv), trial_rows, overwrite_stats)
+    _write_csv(os.path.join(stats_dir, epoch_csv), epoch_rows, overwrite_stats)
+    _write_csv(
+        os.path.join(stats_dir, consume_csv), consume_rows, overwrite_stats
+    )
+
+    durations = [t.duration for t in all_trial_stats]
+    summary = {
+        "num_trials": len(all_trial_stats),
+        "duration_mean": float(np.mean(durations)) if durations else 0.0,
+        "duration_std": float(np.std(durations)) if durations else 0.0,
+        "row_throughput_mean": float(
+            np.mean([t.row_throughput for t in all_trial_stats])
+        )
+        if all_trial_stats
+        else 0.0,
+        "batch_throughput_mean": float(
+            np.mean([t.batch_throughput for t in all_trial_stats])
+        )
+        if all_trial_stats
+        else 0.0,
+    }
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# Human-readable helpers (reference stats.py:628-646)
+# ---------------------------------------------------------------------------
+
+
+def human_readable_big_num(num: float) -> str:
+    for magnitude, suffix in ((12, "T"), (9, "B"), (6, "M"), (3, "K")):
+        if abs(num) >= 10 ** magnitude:
+            value = num / 10 ** magnitude
+            return (
+                f"{value:.0f}{suffix}"
+                if value == int(value)
+                else f"{value:.1f}{suffix}"
+            )
+    return f"{num:.0f}" if num == int(num) else f"{num:.1f}"
+
+
+def human_readable_size(num: float, precision: int = 1) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB", "PiB"):
+        if abs(num) < 1024.0:
+            return f"{num:.{precision}f} {unit}"
+        num /= 1024.0
+    return f"{num:.{precision}f} EiB"
