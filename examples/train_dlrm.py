@@ -134,8 +134,11 @@ def main(argv=None) -> int:
     warm_labels = jax.device_put(
         jnp.zeros((args.batch_size,), jnp.float32), bsh
     )
-    state, _ = train_step(state, warm_feats, warm_labels)
-    jax.block_until_ready(state.step)
+    # Discard the warm-up result: XLA's compile cache keeps the benefit,
+    # and training must start from the freshly initialized state.
+    warm_state, _ = train_step(state, warm_feats, warm_labels)
+    jax.block_until_ready(warm_state.step)
+    del warm_state
 
     ds = JaxShufflingDataset(
         filenames,

@@ -171,8 +171,11 @@ def cached_generate_data(
     }
     manifest_path = os.path.join(data_dir, "manifest.json")
     if os.path.exists(manifest_path):
-        with open(manifest_path) as f:
-            manifest = json.load(f)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            manifest = {}  # truncated/corrupt manifest == cache miss
         if manifest.get("key") == key and all(
             os.path.exists(p) for p in manifest["filenames"]
         ):
@@ -180,8 +183,10 @@ def cached_generate_data(
     filenames, num_bytes = generate_data(
         num_rows, num_files, num_row_groups_per_file, 0.0, data_dir, seed=seed
     )
-    with open(manifest_path, "w") as f:
+    tmp_path = f"{manifest_path}.{os.getpid()}.tmp"
+    with open(tmp_path, "w") as f:
         json.dump(
             {"key": key, "filenames": filenames, "num_bytes": num_bytes}, f
         )
+    os.replace(tmp_path, manifest_path)  # atomic publish
     return filenames, num_bytes

@@ -182,10 +182,10 @@ class JaxShufflingDataset:
     # -- spec application ---------------------------------------------------
 
     def _device_view(self, column: np.ndarray, dtype, shape) -> np.ndarray:
+        from ray_shuffling_data_loader_tpu import native
+
         target = dtype or _default_device_dtype(column.dtype)
-        arr = np.asarray(column)
-        if arr.dtype != np.dtype(target):
-            arr = arr.astype(target)
+        arr = native.narrow(np.asarray(column), np.dtype(target))
         if shape is not None:
             arr = arr.reshape((-1, *shape))
         return arr

@@ -1,0 +1,181 @@
+// Native data-plane kernels for the host side of the shuffle pipeline.
+//
+// The reference gets its native data plane from Ray core (plasma object
+// store, C++) and pandas/pyarrow internals; the hot host-side work of a
+// per-epoch shuffle — row gathers applying a permutation, fused
+// concat+gather in the reduce stage, and dtype narrowing before HBM
+// staging — is re-implemented here as standalone, multi-threaded C++
+// (reference pays DataFrame.sample / pd.concat copies instead,
+// /root/reference/ray_shuffling_data_loader/shuffle.py:192-194).
+//
+// All functions operate on raw contiguous buffers with an element size,
+// so a single entry point serves every column dtype. Parallelism is plain
+// std::thread over row ranges: gathers are memory-bound, so a few threads
+// saturate DRAM bandwidth; thread count is chosen by the Python caller.
+//
+// Build: g++ -O3 -shared -fPIC -pthread (see Makefile). Loaded via ctypes
+// (ray_shuffling_data_loader_tpu/native/__init__.py); every kernel has a
+// numpy fallback, so the package works without a toolchain.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(begin, end) over [0, n) split across up to n_threads threads.
+template <typename Fn>
+void parallel_for(int64_t n, int n_threads, Fn fn) {
+  if (n_threads <= 1 || n < (1 << 14)) {
+    fn(0, n);
+    return;
+  }
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t begin = t * chunk;
+    if (begin >= n) break;
+    int64_t end = std::min(n, begin + chunk);
+    threads.emplace_back([=] { fn(begin, end); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Typed gather: dst[i] = src[idx[i]], specialized per element width so the
+// inner loop is a plain indexed load/store instead of memcpy.
+template <typename T>
+void gather_typed(const T* src, T* dst, const int64_t* idx, int64_t n,
+                  int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) dst[i] = src[idx[i]];
+  });
+}
+
+void gather_bytes(const uint8_t* src, uint8_t* dst, const int64_t* idx,
+                  int64_t n, int64_t itemsize, int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(dst + i * itemsize, src + idx[i] * itemsize, itemsize);
+    }
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[i] = src[idx[i]] for n rows of `itemsize` bytes each.
+void rsdl_take(const void* src, void* dst, const int64_t* idx, int64_t n,
+               int64_t itemsize, int n_threads) {
+  switch (itemsize) {
+    case 1:
+      gather_typed(static_cast<const uint8_t*>(src),
+                   static_cast<uint8_t*>(dst), idx, n, n_threads);
+      break;
+    case 2:
+      gather_typed(static_cast<const uint16_t*>(src),
+                   static_cast<uint16_t*>(dst), idx, n, n_threads);
+      break;
+    case 4:
+      gather_typed(static_cast<const uint32_t*>(src),
+                   static_cast<uint32_t*>(dst), idx, n, n_threads);
+      break;
+    case 8:
+      gather_typed(static_cast<const uint64_t*>(src),
+                   static_cast<uint64_t*>(dst), idx, n, n_threads);
+      break;
+    default:
+      gather_bytes(static_cast<const uint8_t*>(src),
+                   static_cast<uint8_t*>(dst), idx, n, itemsize, n_threads);
+  }
+}
+
+// Fused concat + gather across parts: logical row j lives in part p where
+// row_offsets[p] <= j < row_offsets[p+1]; dst[i] = parts[p(idx[i])][...].
+// This is the reduce-stage hot path — the reference materializes
+// pd.concat(parts) first and then permutes (shuffle.py:192-194); fusing
+// halves the memory traffic.
+void rsdl_take_multi(const void** parts, const int64_t* row_offsets,
+                     int64_t n_parts, void* dst, const int64_t* idx,
+                     int64_t n, int64_t itemsize, int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    uint8_t* out = static_cast<uint8_t*>(dst);
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      // Branchless-ish upper_bound over typically small n_parts.
+      const int64_t* hi =
+          std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
+      int64_t p = hi - row_offsets - 1;
+      const uint8_t* src = static_cast<const uint8_t*>(parts[p]);
+      std::memcpy(out + i * itemsize,
+                  src + (j - row_offsets[p]) * itemsize, itemsize);
+    }
+  });
+}
+
+// Same, specialized for 8-byte elements (the DATA_SPEC schema is all
+// int64/float64 on disk), avoiding the per-row memcpy call.
+void rsdl_take_multi8(const void** parts, const int64_t* row_offsets,
+                      int64_t n_parts, void* dst, const int64_t* idx,
+                      int64_t n, int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    uint64_t* out = static_cast<uint64_t*>(dst);
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      const int64_t* hi =
+          std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
+      int64_t p = hi - row_offsets - 1;
+      out[i] = static_cast<const uint64_t*>(parts[p])[j - row_offsets[p]];
+    }
+  });
+}
+
+// Narrowing casts used at HBM staging time (TPU wants 32-bit; disk schema
+// is 64-bit — reference converts via torch.as_tensor copies instead,
+// torch_dataset.py:223).
+void rsdl_cast_i64_i32(const int64_t* src, int32_t* dst, int64_t n,
+                       int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i)
+      dst[i] = static_cast<int32_t>(src[i]);
+  });
+}
+
+void rsdl_cast_f64_f32(const double* src, float* dst, int64_t n,
+                       int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i)
+      dst[i] = static_cast<float>(src[i]);
+  });
+}
+
+// Stable group-by-key scatter: given assignment[i] in [0, n_groups), write
+// rows grouped by key preserving input order (the map-stage partitioner).
+// Equivalent to argsort(kind=stable)+gather but single-pass O(n).
+// `offsets` holds each group's running write cursor (start offsets on
+// entry, end offsets on return) — the caller computes it once per batch
+// and passes a fresh copy per column, so the histogram pass is not
+// repeated for every column. No bounds checks: the Python wrapper
+// validates the assignment range before calling.
+void rsdl_group_rows(const void* src, void* dst, const int32_t* assignment,
+                     int64_t n, int64_t itemsize, int64_t* offsets) {
+  const uint8_t* in = static_cast<const uint8_t*>(src);
+  uint8_t* out = static_cast<uint8_t*>(dst);
+  if (itemsize == 8) {
+    const uint64_t* in8 = static_cast<const uint64_t*>(src);
+    uint64_t* out8 = static_cast<uint64_t*>(dst);
+    for (int64_t i = 0; i < n; ++i) out8[offsets[assignment[i]]++] = in8[i];
+  } else {
+    for (int64_t i = 0; i < n; ++i) {
+      std::memcpy(out + offsets[assignment[i]]++ * itemsize,
+                  in + i * itemsize, itemsize);
+    }
+  }
+}
+
+int rsdl_abi_version() { return 2; }
+
+}  // extern "C"

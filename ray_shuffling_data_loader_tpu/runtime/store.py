@@ -109,8 +109,34 @@ class ColumnBatch(Mapping[str, np.ndarray]):
         return sum(v.nbytes for v in self._columns.values())
 
     def take(self, indices: np.ndarray) -> "ColumnBatch":
-        """Row gather: the core shuffle primitive (one gather per column)."""
-        return ColumnBatch({k: v[indices] for k, v in self._columns.items()})
+        """Row gather: the core shuffle primitive (one gather per column,
+        through the C++ kernel when built — ``native.take``)."""
+        from ray_shuffling_data_loader_tpu import native
+
+        return ColumnBatch(
+            {k: native.take(v, indices) for k, v in self._columns.items()}
+        )
+
+    @staticmethod
+    def concat_take(
+        batches: Sequence["ColumnBatch"], indices: np.ndarray
+    ) -> "ColumnBatch":
+        """``concat(batches).take(indices)`` without materializing the
+        concat when the native fused kernel is available (reduce-stage hot
+        path; the reference pays ``pd.concat`` + ``DataFrame.sample``,
+        reference ``shuffle.py:192-194``)."""
+        from ray_shuffling_data_loader_tpu import native
+
+        batches = [b for b in batches if b is not None and b.num_rows > 0]
+        if not batches:
+            return ColumnBatch({})
+        keys = list(batches[0])
+        return ColumnBatch(
+            {
+                k: native.take_multi([b[k] for b in batches], indices)
+                for k in keys
+            }
+        )
 
     def slice(self, start: int, stop: int) -> "ColumnBatch":
         """Zero-copy row slice."""

@@ -119,11 +119,14 @@ def shuffle_map(
     assert n > num_reducers, (n, num_reducers)
     rng = _map_seed(seed, epoch, file_index)
     assignment = rng.integers(num_reducers, size=n)
-    # Stable counting sort: rows grouped by reducer with one gather/column.
-    order = np.argsort(assignment, kind="stable")
-    counts = np.bincount(assignment, minlength=num_reducers)
-    offsets = np.concatenate([[0], np.cumsum(counts)])
-    grouped = batch.take(order)
+    # Stable group-by-reducer: single-pass counting scatter per column via
+    # the C++ kernel (one-argsort-then-gather fallback otherwise).
+    from ray_shuffling_data_loader_tpu import native
+
+    grouped_cols, offsets = native.group_rows_multi(
+        batch.columns, assignment, num_reducers
+    )
+    grouped = ColumnBatch(grouped_cols)
     refs = [
         ctx.store.put_columns(
             grouped.slice(int(offsets[i]), int(offsets[i + 1])).columns
@@ -156,12 +159,13 @@ def shuffle_reduce(
     start = timeit.default_timer()
     ctx = runtime.ensure_initialized()
     parts = [ctx.store.get_columns(r) for r in part_refs]
-    merged = ColumnBatch.concat(parts)
+    total_rows = sum(p.num_rows for p in parts)
     rng = _reduce_seed(seed, epoch, reduce_index)
-    perm = rng.permutation(merged.num_rows)
-    shuffled = merged.take(perm)
+    perm = rng.permutation(total_rows)
+    # Fused concat+permute straight out of the mmapped partitions.
+    shuffled = ColumnBatch.concat_take(parts, perm)
     out_ref = ctx.store.put_columns(shuffled.columns)
-    del parts, merged, shuffled  # drop mmap views before unlinking
+    del parts, shuffled  # drop mmap views before unlinking
     ctx.store.free(list(part_refs))
     duration = timeit.default_timer() - start
     if stats_collector is not None:
