@@ -61,7 +61,38 @@ def parse_args(argv=None):
         default=1,
         help="Size of the mesh 'model' axis (shards large embedding vocabs).",
     )
-    return p.parse_args(argv)
+    # Checkpoint / resume (no reference analog — the loader had none,
+    # SURVEY §5; preemptible TPU pods need it).
+    p.add_argument(
+        "--checkpoint-dir",
+        type=str,
+        default=None,
+        help="Enable checkpointing to this directory; if it already holds a "
+        "checkpoint, training resumes from it (mid-epoch batch cursor "
+        "included).",
+    )
+    p.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=50,
+        help="Steps between checkpoints.",
+    )
+    p.add_argument(
+        "--smoke",
+        action="store_true",
+        help="Tiny CI workload preset (overrides the size knobs).",
+    )
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.num_rows = 50_000
+        args.num_files = 4
+        args.num_row_groups_per_file = 1
+        args.batch_size = 4096
+        args.epochs = 2
+        args.num_reducers = 4
+        args.embed_dim = 8
+        args.data_dir = os.path.join(args.data_dir, "smoke")
+    return args
 
 
 def get_data(args):
@@ -90,6 +121,13 @@ def main(argv=None) -> int:
     args = parse_args(argv)
 
     import jax
+
+    # Some TPU plugins override JAX_PLATFORMS from the environment; the
+    # config API takes precedence, so re-assert the user's choice (the CPU
+    # smoke invocation in the module docstring depends on this).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -128,17 +166,45 @@ def main(argv=None) -> int:
     train_step = make_train_step(model, optimizer, mesh, state_shardings)
 
     # Compile off the hot path, with inputs placed exactly as real batches
-    # will arrive (committed + mesh-sharded).
+    # will arrive (committed + mesh-sharded). AOT lower/compile: no
+    # execution, so the donated state buffer stays live for the loop.
     bsh = batch_sharding(mesh, 1)
     warm_feats = {k: jax.device_put(v, bsh) for k, v in example.items()}
     warm_labels = jax.device_put(
         jnp.zeros((args.batch_size,), jnp.float32), bsh
     )
-    # Discard the warm-up result: XLA's compile cache keeps the benefit,
-    # and training must start from the freshly initialized state.
-    warm_state, _ = train_step(state, warm_feats, warm_labels)
-    jax.block_until_ready(warm_state.step)
-    del warm_state
+    train_step = train_step.lower(state, warm_feats, warm_labels).compile()
+
+    # Checkpoint/resume: restore state + batch cursor if a checkpoint
+    # exists, and save every --checkpoint-every steps.
+    ckpt_mgr = None
+    start_epoch, resume_skip, global_step = 0, 0, 0
+    stream_config = None
+    if args.checkpoint_dir:
+        from ray_shuffling_data_loader_tpu import BatchCursor, CheckpointManager
+
+        ckpt_mgr = CheckpointManager(args.checkpoint_dir)
+        stream_config = BatchCursor.stream_config(
+            seed=args.seed,
+            batch_size=args.batch_size,
+            num_trainers=1,
+            num_reducers=args.num_reducers,
+            num_files=len(filenames),
+            drop_last=True,
+        )
+        restored, cursor = ckpt_mgr.restore(
+            target=state, shardings=state_shardings
+        )
+        if cursor is not None:
+            cursor.validate(stream_config)
+            state = restored if restored is not None else state
+            start_epoch = cursor.epoch
+            resume_skip = cursor.batches_yielded
+            global_step = cursor.step
+            print(
+                f"resuming from step {global_step}: epoch {start_epoch}, "
+                f"skipping {resume_skip} already-trained batches"
+            )
 
     ds = JaxShufflingDataset(
         filenames,
@@ -152,17 +218,19 @@ def main(argv=None) -> int:
         max_concurrent_epochs=args.max_concurrent_epochs,
         seed=args.seed,
         mesh=mesh,
+        start_epoch=start_epoch,
     )
 
     # Train loop with per-batch wait-time measurement (reference ``_train``,
     # ray_torch_shuffle.py:195-231).
     all_wait_times = []
     loss = float("nan")
-    for epoch in range(args.epochs):
-        ds.set_epoch(epoch)
+    for epoch in range(start_epoch, args.epochs):
+        skip = resume_skip if epoch == start_epoch else 0
+        ds.set_epoch(epoch, skip_batches=skip)
         epoch_start = time.perf_counter()
         wait_times = []
-        num_batches = 0
+        num_batches = skip
         last_done = time.perf_counter()
         for features, labels in ds:
             wait_times.append(time.perf_counter() - last_done)
@@ -173,6 +241,19 @@ def main(argv=None) -> int:
                 jax.block_until_ready(state.step)
                 loss = float(metrics["loss"])
             num_batches += 1
+            global_step += 1
+            if ckpt_mgr is not None and global_step % args.checkpoint_every == 0:
+                from ray_shuffling_data_loader_tpu import BatchCursor
+
+                ckpt_mgr.save(
+                    global_step,
+                    cursor=BatchCursor(
+                        epoch=epoch,
+                        batches_yielded=num_batches,
+                        config=stream_config,
+                    ),
+                    state=state,
+                )
             last_done = time.perf_counter()
         epoch_s = time.perf_counter() - epoch_start
         all_wait_times.extend(wait_times)

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Lint / format gate (reference format.sh: yapf + flake8; here ruff, which
+# subsumes both). Usage:
+#   ./format.sh          # fix in place
+#   ./format.sh --check  # CI mode: fail on violations, change nothing
+set -euo pipefail
+cd "$(dirname "$0")"
+
+TARGETS=(ray_shuffling_data_loader_tpu tests benchmarks examples bench.py __graft_entry__.py)
+
+if ! command -v ruff >/dev/null 2>&1; then
+    echo "ruff not installed; running syntax check only" >&2
+    python -m compileall -q "${TARGETS[@]}"
+    exit 0
+fi
+
+if [[ "${1:-}" == "--check" ]]; then
+    ruff check "${TARGETS[@]}"
+else
+    ruff check --fix "${TARGETS[@]}"
+fi

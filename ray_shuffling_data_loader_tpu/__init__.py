@@ -18,6 +18,10 @@ Heavy adapters (jax / torch) are imported lazily so that CPU-side worker
 processes never pay for them.
 """
 
+from ray_shuffling_data_loader_tpu.checkpoint import (
+    BatchCursor,
+    CheckpointManager,
+)
 from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
 from ray_shuffling_data_loader_tpu.shuffle import shuffle
 
@@ -28,6 +32,8 @@ __all__ = [
     "shuffle",
     "JaxShufflingDataset",
     "TorchShufflingDataset",
+    "BatchCursor",
+    "CheckpointManager",
 ]
 
 

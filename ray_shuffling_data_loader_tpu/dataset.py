@@ -78,6 +78,9 @@ class ShufflingDataset:
         max_concurrent_epochs: Epoch pipelining window. Default 2.
         seed: Root seed for the per-epoch shuffle permutations.
         queue_name: Name of the shared batch-queue endpoint.
+        start_epoch: First epoch to shuffle/consume (checkpoint resume;
+            epoch indices stay absolute so permutations match the
+            original run).
     """
 
     def __init__(
@@ -92,6 +95,7 @@ class ShufflingDataset:
         max_concurrent_epochs: int = 2,
         seed: int = 0,
         queue_name: str = DEFAULT_QUEUE_NAME,
+        start_epoch: int = 0,
     ):
         runtime.ensure_initialized()
         if num_reducers is None:
@@ -120,6 +124,7 @@ class ShufflingDataset:
                         num_reducers,
                         num_trainers,
                         seed=seed,
+                        start_epoch=start_epoch,
                     )
                 except BaseException as exc:  # surfaced at iterator end
                     result.error = exc
@@ -145,15 +150,26 @@ class ShufflingDataset:
         self._epoch: Optional[int] = None
         self._last_epoch: Optional[int] = None
         self._drop_last = drop_last
+        self._skip_batches = 0
 
     @property
     def batch_size(self) -> int:
         return self._batch_size
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         """Must be called before each epoch's iteration (reference
-        ``dataset.py:96-106``)."""
+        ``dataset.py:96-106``).
+
+        ``skip_batches`` resumes mid-epoch after a preemption: the shuffle
+        is deterministic per ``(seed, epoch)`` (``shuffle.py:87-95``), so
+        regenerating the epoch and suppressing the first ``skip_batches``
+        yields exactly the stream an uninterrupted run would have produced
+        from that point (the reference has no resume at all, SURVEY §5).
+        Skipped batches still flow through the carry-buffer bookkeeping and
+        ``task_done`` acks — only the yields are suppressed.
+        """
         self._epoch = epoch
+        self._skip_batches = skip_batches
 
     def __iter__(self) -> Iterator[ColumnBatch]:
         if self._epoch is None or self._epoch == self._last_epoch:
@@ -162,6 +178,7 @@ class ShufflingDataset:
                 "the beginning of each epoch, before iterating over this "
                 "dataset."
             )
+        to_skip = self._skip_batches
         store = runtime.get_context().store
         buf: Optional[ColumnBatch] = None
         is_done = False
@@ -181,7 +198,10 @@ class ShufflingDataset:
                 # Top up the carry buffer with a front slice.
                 buf = ColumnBatch.concat([buf, cb.slice(0, offset)])
                 if buf.num_rows == self._batch_size:
-                    yield buf
+                    if to_skip > 0:
+                        to_skip -= 1
+                    else:
+                        yield buf
                     buf = None
                 # Whole batches straight from this reducer output, then the
                 # short tail into the carry buffer. (The reference's pointer
@@ -191,7 +211,9 @@ class ShufflingDataset:
                 # exactly-once tests.)
                 start = min(offset, cb.num_rows)
                 num_full = (cb.num_rows - start) // self._batch_size
-                for i in range(num_full):
+                num_skipped = min(to_skip, num_full)
+                to_skip -= num_skipped
+                for i in range(num_skipped, num_full):
                     lo = start + i * self._batch_size
                     yield cb.slice(lo, lo + self._batch_size)
                 tail = start + num_full * self._batch_size
@@ -205,7 +227,10 @@ class ShufflingDataset:
                 )
 
         if buf is not None and buf.num_rows > 0 and not self._drop_last:
-            yield buf
+            if to_skip > 0:
+                to_skip -= 1
+            else:
+                yield buf
         # Ack the producer-done sentinel itself (reference dataset.py:184).
         self._batch_queue.task_done(self._rank, self._epoch, 1)
         self._last_epoch = self._epoch

@@ -151,6 +151,7 @@ class JaxShufflingDataset:
         mesh: Optional[Mesh] = None,
         batch_axis: str = "data",
         prefetch_depth: int = 2,
+        start_epoch: int = 0,
     ):
         self._ds = ShufflingDataset(
             filenames,
@@ -163,6 +164,7 @@ class JaxShufflingDataset:
             max_concurrent_epochs=max_concurrent_epochs,
             seed=seed,
             queue_name=queue_name,
+            start_epoch=start_epoch,
         )
         self._spec = JaxBatchSpec(
             feature_columns=feature_columns,
@@ -227,8 +229,11 @@ class JaxShufflingDataset:
 
     # -- iteration ----------------------------------------------------------
 
-    def set_epoch(self, epoch: int) -> None:
-        self._ds.set_epoch(epoch)
+    def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
+        """``skip_batches`` resumes mid-epoch (see
+        :meth:`~.dataset.ShufflingDataset.set_epoch`); skipped batches are
+        suppressed before staging, so no HBM transfer is paid for them."""
+        self._ds.set_epoch(epoch, skip_batches=skip_batches)
 
     @property
     def batch_size(self) -> int:

@@ -42,6 +42,9 @@ class TabularDLRM(nn.Module):
     embed_dim: int = 32
     top_mlp: Sequence[int] = (256, 128, 64)
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Dot-interaction lowering: None = auto (fused Pallas kernel on TPU,
+    # XLA reference elsewhere); True/False forces it (ops/interaction.py).
+    use_pallas_interaction: Optional[bool] = None
 
     @nn.compact
     def __call__(self, features: Dict[str, jax.Array]) -> jax.Array:
@@ -62,13 +65,13 @@ class TabularDLRM(nn.Module):
 
         # [batch, num_cols, dim]
         stacked = jnp.stack(embeds, axis=1)
-        num_cols = stacked.shape[1]
-        # Dot interaction: batched Gram matrix on the MXU.
-        inter = jnp.einsum(
-            "bnd,bmd->bnm", stacked, stacked, precision=jax.lax.Precision.DEFAULT
-        )
-        iu, ju = jnp.triu_indices(num_cols, k=1)
-        inter_flat = inter[:, iu, ju]  # [batch, n*(n-1)/2]
+        # Dot interaction (batched Gram on the MXU + upper-triangle
+        # compaction), fused in VMEM by the Pallas kernel on TPU.
+        from ray_shuffling_data_loader_tpu.ops import dot_interaction
+
+        inter_flat = dot_interaction(
+            stacked, use_pallas=self.use_pallas_interaction
+        )  # [batch, n*(n-1)/2]
 
         x = jnp.concatenate(
             [stacked.reshape(stacked.shape[0], -1), inter_flat], axis=-1
@@ -88,6 +91,7 @@ def dlrm_for_data_spec(
     embed_dim: int = 32,
     top_mlp: Sequence[int] = (256, 128, 64),
     vocab_cap: Optional[int] = None,
+    use_pallas_interaction: Optional[bool] = None,
 ) -> TabularDLRM:
     """Build the flagship model for the synthetic DATA_SPEC schema
     (``data_generation.py:56-77`` cardinalities). ``vocab_cap`` shrinks
@@ -103,7 +107,10 @@ def dlrm_for_data_spec(
         if col != LABEL_COLUMN
     }
     return TabularDLRM(
-        vocab_sizes=vocab_sizes, embed_dim=embed_dim, top_mlp=tuple(top_mlp)
+        vocab_sizes=vocab_sizes,
+        embed_dim=embed_dim,
+        top_mlp=tuple(top_mlp),
+        use_pallas_interaction=use_pallas_interaction,
     )
 
 

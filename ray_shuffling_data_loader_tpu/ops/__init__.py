@@ -1,1 +1,9 @@
-"""ops subpackage."""
+"""Device-side ops: Pallas TPU kernels with XLA reference fallbacks."""
+
+from ray_shuffling_data_loader_tpu.ops.interaction import (  # noqa: F401
+    dot_interaction,
+    dot_interaction_reference,
+    num_pairs,
+)
+
+__all__ = ["dot_interaction", "dot_interaction_reference", "num_pairs"]

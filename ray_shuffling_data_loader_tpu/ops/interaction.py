@@ -1,0 +1,167 @@
+"""Fused DLRM dot-interaction: Pallas TPU kernel + jnp reference.
+
+The flagship model's hottest non-matmul op is the pairwise feature
+interaction (``models/dlrm.py``): a per-sample Gram matrix over the stacked
+embedding vectors followed by upper-triangle extraction. The naive lowering
+materializes the full ``[batch, n, n]`` Gram in HBM and then gathers
+``n(n-1)/2`` lanes back out. The Pallas kernel fuses both: one VMEM-resident
+pass per batch tile — Gram on the MXU, triangle extraction as statically
+unrolled VMEM slices — so only the compacted ``[batch, n(n-1)/2]``
+interaction ever touches HBM.
+
+The reference repo has no model compute at all (its train step is a mocked
+``time.sleep``, reference ``ray_torch_shuffle.py:214``); this op exists for
+the real DLRM workload its loader was built to feed.
+
+Differentiability: ``pallas_call`` needs an explicit VJP; the backward pass
+is plain XLA (scatter the cotangent into a symmetric Gram cotangent, one
+batched matmul against the primal), registered via ``jax.custom_vjp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def num_pairs(n: int) -> int:
+    return n * (n - 1) // 2
+
+
+# ---------------------------------------------------------------------------
+# Reference path (pure XLA; works everywhere, also the VJP building block)
+# ---------------------------------------------------------------------------
+
+
+def dot_interaction_reference(stacked: jax.Array) -> jax.Array:
+    """``[B, N, D] -> [B, N(N-1)/2]`` upper-triangle of the batched Gram."""
+    n = stacked.shape[1]
+    gram = jnp.einsum("bnd,bmd->bnm", stacked, stacked)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return gram[:, iu, ju]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _interaction_kernel(x_ref, out_ref):
+    """One batch tile: Gram via dot_general (MXU), then static unrolled
+    row-segment copies compact the strict upper triangle."""
+    x = x_ref[:]  # [bt, n, d]
+    n = x.shape[1]
+    # Batched Gram: contract d, batch over the tile dimension.
+    gram = jax.lax.dot_general(
+        x,
+        x,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [bt, n, n]
+    offset = 0
+    for i in range(n - 1):
+        width = n - 1 - i
+        # Row i, columns i+1..n: a static slice — no gather needed.
+        out_ref[:, offset : offset + width] = gram[:, i, i + 1 :].astype(
+            out_ref.dtype
+        )
+        offset += width
+
+
+def _interaction_pallas(
+    stacked: jax.Array, block_batch: int, interpret: bool
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    b, n, d = stacked.shape
+    p = num_pairs(n)
+    bt = min(block_batch, b)
+    # Tile the batch; pad the tail tile (zeros produce zero interactions,
+    # sliced off afterwards).
+    padded = -(-b // bt) * bt
+    if padded != b:
+        stacked = jnp.pad(stacked, ((0, padded - b), (0, 0), (0, 0)))
+    out = pl.pallas_call(
+        _interaction_kernel,
+        grid=(padded // bt,),
+        in_specs=[
+            pl.BlockSpec((bt, n, d), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, p), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded, p), stacked.dtype),
+        interpret=interpret,
+    )(stacked)
+    return out[:b]
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _dot_interaction_pallas_vjp(
+    stacked: jax.Array, block_batch: int, interpret: bool
+):
+    return _interaction_pallas(stacked, block_batch, interpret)
+
+
+def _fwd(stacked, block_batch, interpret):
+    return _interaction_pallas(stacked, block_batch, interpret), stacked
+
+
+def _bwd(block_batch, interpret, stacked, ct):
+    """d/dx of ``triu(x xᵀ)``: scatter ct into a strict-upper Gram
+    cotangent G̅, then ``(G̅ + G̅ᵀ) @ x`` — one batched matmul, pure XLA."""
+    n = stacked.shape[1]
+    iu, ju = jnp.triu_indices(n, k=1)
+    gram_ct = jnp.zeros(
+        (stacked.shape[0], n, n), dtype=ct.dtype
+    ).at[:, iu, ju].set(ct)
+    sym = gram_ct + jnp.swapaxes(gram_ct, 1, 2)
+    return (jnp.einsum("bnm,bmd->bnd", sym, stacked.astype(ct.dtype)).astype(
+        stacked.dtype
+    ),)
+
+
+_dot_interaction_pallas_vjp.defvjp(_fwd, _bwd)
+
+
+def _auto_pallas() -> bool:
+    """Auto policy: single-device TPU only. Under a multi-chip pjit the SPMD
+    partitioner's handling of ``pallas_call`` depends on the enclosing
+    sharding; callers doing explicit ``shard_map`` per-device code can force
+    ``use_pallas=True`` safely."""
+    try:
+        return jax.default_backend() == "tpu" and jax.device_count() == 1
+    except Exception:
+        return False
+
+
+def dot_interaction(
+    stacked: jax.Array,
+    *,
+    use_pallas: Optional[bool] = None,
+    block_batch: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pairwise dot-interaction ``[B, N, D] -> [B, N(N-1)/2]``.
+
+    Args:
+        stacked: per-sample stacked feature vectors.
+        use_pallas: force the kernel on/off; default auto (single-device
+            TPU — the kernel targets Mosaic; elsewhere the XLA reference
+            runs).
+        block_batch: batch tile per kernel invocation (VMEM budget:
+            ``bt·n·d + bt·n² + bt·p`` elements).
+        interpret: run the kernel in the Pallas interpreter (CPU tests).
+    """
+    if use_pallas is None:
+        use_pallas = _auto_pallas()
+    if not use_pallas:
+        return dot_interaction_reference(stacked)
+    return _dot_interaction_pallas_vjp(stacked, block_batch, interpret)

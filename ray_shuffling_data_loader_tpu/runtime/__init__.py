@@ -21,6 +21,7 @@ mirrors ``ray.init(address=...)`` joining an existing cluster
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import secrets
 import shutil
@@ -40,6 +41,7 @@ from .store import ColumnBatch, ObjectRef, ObjectStore, StoreStats  # noqa: F401
 from .tasks import TaskError, TaskFuture, WorkerPool, wait  # noqa: F401
 
 _ENV_DIR = "RSDL_RUNTIME_DIR"
+_CLUSTER_FILE = "cluster.json"
 
 
 class RuntimeContext:
@@ -48,10 +50,13 @@ class RuntimeContext:
         self.owner = owner
         self.session = os.path.basename(runtime_dir)
         self.store = ObjectStore(self.session)
+        self.cluster = None  # ClusterClient when joined to a cluster
+        self._owns_cluster_services = False
         self._pool: Optional[WorkerPool] = None
         self._pool_lock = threading.Lock()
         self._num_workers = num_workers
         self._owned_actors = []
+        self._owned_names = []
 
     @property
     def pool(self) -> WorkerPool:
@@ -67,7 +72,26 @@ class RuntimeContext:
                 )
             return self._pool
 
+    @property
+    def scheduler(self):
+        """Where tasks go: the cluster-wide round-robin scheduler when
+        joined to a cluster, else the local worker pool (same ``submit``
+        surface)."""
+        if self.cluster is not None:
+            return self.cluster.scheduler()
+        return self.pool
+
     def shutdown(self):
+        if self.cluster is not None:
+            # Release cluster-wide names this process claimed, so reruns
+            # against a persistent cluster can reuse them.
+            for name in self._owned_names:
+                try:
+                    self.cluster.unregister_named_actor(name)
+                except Exception:
+                    pass
+        if self.cluster is not None and self._owns_cluster_services:
+            self.cluster.leave()
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
@@ -77,6 +101,7 @@ class RuntimeContext:
             except Exception:
                 pass
         self._owned_actors.clear()
+        self.cluster = None
         if self.owner:
             self.store.cleanup()
             shutil.rmtree(self.runtime_dir, ignore_errors=True)
@@ -86,6 +111,74 @@ _context: Optional[RuntimeContext] = None
 _context_lock = threading.Lock()
 
 
+def _new_session_dir() -> str:
+    # Keep the path short: unix socket paths are capped at ~107 chars.
+    base = tempfile.gettempdir()
+    runtime_dir = os.path.join(base, f"rsdl-{secrets.token_hex(4)}")
+    os.makedirs(os.path.join(runtime_dir, "actors"))
+    return runtime_dir
+
+
+def _attach_cluster_client(ctx: RuntimeContext, record: dict, owns: bool):
+    """Wire a ClusterClient (from a cluster.json record) into the context:
+    registry/agent/store handles + the store's remote data-plane hooks."""
+    from .cluster import ClusterClient
+
+    client = ClusterClient(
+        registry=ActorHandle(tuple(record["registry"])),
+        host_id=record["host_id"],
+        advertise_host=record["advertise"],
+        agent=ActorHandle(tuple(record["agent"])),
+        store_server=ActorHandle(tuple(record["store"])),
+        is_head=record.get("is_head", False),
+        registry_address=tuple(record["registry"])[1:],
+    )
+    ctx.cluster = client
+    ctx._owns_cluster_services = owns
+    ctx.store.owner_address = tuple(record["store"])
+    ctx.store.remote_fetch = client.fetch_remote
+    ctx.store.remote_free = client.free_remote
+    return client
+
+
+def _bootstrap_cluster_host(
+    ctx: RuntimeContext,
+    registry: ActorHandle,
+    advertise: str,
+    num_workers: int,
+    is_head: bool,
+) -> None:
+    """Start this host's agent + store server, register with the cluster,
+    and persist ``cluster.json`` so local task workers joining via
+    ``$RSDL_RUNTIME_DIR`` inherit the cluster hooks (their refs must carry
+    this host's owner address or remote reducers could never fetch them)."""
+    from .cluster import start_host_services
+
+    agent, store_server = start_host_services(
+        ctx.runtime_dir, num_workers, advertise
+    )
+    ctx._owned_actors += [agent, store_server]
+    host_id = f"{advertise}:{ctx.session}"
+    registry.call(
+        "register_host",
+        host_id,
+        list(agent.address),
+        list(store_server.address),
+        num_workers,
+    )
+    record = {
+        "registry": list(registry.address),
+        "agent": list(agent.address),
+        "store": list(store_server.address),
+        "host_id": host_id,
+        "advertise": advertise,
+        "is_head": is_head,
+    }
+    with open(os.path.join(ctx.runtime_dir, _CLUSTER_FILE), "w") as f:
+        json.dump(record, f)
+    _attach_cluster_client(ctx, record, owns=True)
+
+
 def init(
     address: Optional[str] = None,
     num_workers: Optional[int] = None,
@@ -93,9 +186,12 @@ def init(
     """Create or join a runtime session.
 
     Args:
-        address: Path of an existing session's runtime directory to join
-            (also read from ``$RSDL_RUNTIME_DIR``). ``None`` creates a new
-            session owned by this process.
+        address: What to join. ``None`` creates a new single-host session
+            owned by this process. A filesystem path joins an existing
+            session's runtime directory (also read from
+            ``$RSDL_RUNTIME_DIR``). A ``tcp://head:port`` address joins a
+            multi-host cluster as a worker host (the ``ray.init(address=...)``
+            analog; see :func:`init_cluster` for the head side).
         num_workers: Size of the lazy task worker pool. Defaults to
             ``os.cpu_count()``.
     """
@@ -106,22 +202,103 @@ def init(
         if num_workers is None:
             num_workers = max(1, os.cpu_count() or 1)
         address = address or os.environ.get(_ENV_DIR)
+        if address and address.startswith("tcp://"):
+            from .cluster import (
+                default_advertise_host,
+                parse_cluster_address,
+            )
+
+            runtime_dir = _new_session_dir()
+            os.environ[_ENV_DIR] = runtime_dir
+            ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
+            registry = ActorHandle(("tcp", *parse_cluster_address(address)))
+            registry.wait_ready()
+            _context = ctx
+            atexit.register(shutdown)
+            try:
+                _bootstrap_cluster_host(
+                    ctx,
+                    registry,
+                    default_advertise_host(),
+                    num_workers,
+                    is_head=False,
+                )
+            except BaseException:
+                # A half-joined context must not survive as the global
+                # session: a retrying init() would get back a context with
+                # cluster=None and silently run single-host.
+                _context = None
+                try:
+                    ctx.shutdown()
+                except Exception:
+                    pass
+                raise
+            return ctx
         if address:
             if not os.path.isdir(address):
                 raise ValueError(f"no runtime session at {address!r}")
             ctx = RuntimeContext(address, owner=False, num_workers=num_workers)
+            # Task workers on a cluster host inherit the host's cluster
+            # wiring (owner stamping + remote fetch).
+            cluster_file = os.path.join(address, _CLUSTER_FILE)
+            if os.path.exists(cluster_file):
+                with open(cluster_file) as f:
+                    _attach_cluster_client(ctx, json.load(f), owns=False)
         else:
-            # Keep the path short: unix socket paths are capped at ~107 chars.
-            base = tempfile.gettempdir()
-            runtime_dir = os.path.join(
-                base, f"rsdl-{secrets.token_hex(4)}"
-            )
-            os.makedirs(os.path.join(runtime_dir, "actors"))
+            runtime_dir = _new_session_dir()
             os.environ[_ENV_DIR] = runtime_dir
             ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
         _context = ctx
         atexit.register(shutdown)
         return ctx
+
+
+def init_cluster(
+    listen_host: str = "0.0.0.0",
+    listen_port: int = 0,
+    advertise_host: Optional[str] = None,
+    num_workers: Optional[int] = None,
+) -> RuntimeContext:
+    """Start a cluster head: session + registry + this host's services.
+
+    Worker hosts join with ``init(address=ctx.cluster.address)`` (or the
+    ``python -m ray_shuffling_data_loader_tpu.runtime.cluster join`` CLI).
+    """
+    global _context
+    from .cluster import ClusterRegistry, default_advertise_host
+
+    with _context_lock:
+        if _context is not None:
+            raise RuntimeError("runtime already initialized")
+        if num_workers is None:
+            num_workers = max(1, os.cpu_count() or 1)
+        runtime_dir = _new_session_dir()
+        os.environ[_ENV_DIR] = runtime_dir
+        ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
+        _context = ctx
+        atexit.register(shutdown)
+    try:
+        advertise = advertise_host or default_advertise_host()
+        bind_host = advertise if listen_host == "0.0.0.0" else listen_host
+        registry = _spawn_actor(
+            ClusterRegistry,
+            runtime_dir=runtime_dir,
+            host=bind_host,
+            port=listen_port,
+        )
+        ctx._owned_actors.append(registry)
+        _bootstrap_cluster_host(
+            ctx, registry, advertise, num_workers, is_head=True
+        )
+    except BaseException:
+        with _context_lock:
+            _context = None
+        try:
+            ctx.shutdown()
+        except Exception:
+            pass
+        raise
+    return ctx
 
 
 def is_initialized() -> bool:
@@ -156,26 +333,51 @@ def shutdown() -> None:
 
 
 def submit(fn: Callable, *args, **kwargs) -> TaskFuture:
-    return get_context().pool.submit(fn, *args, **kwargs)
+    """Submit a task to the current scheduler (cluster-wide round-robin when
+    in a cluster, else the local pool)."""
+    return get_context().scheduler.submit(fn, *args, **kwargs)
 
 
 def spawn_actor(cls, *args, name: Optional[str] = None, **kwargs) -> ActorHandle:
+    """Spawn an actor process; named actors are discoverable session-wide
+    (and, in cluster mode, cluster-wide: the actor binds TCP and registers
+    with the head's registry)."""
     ctx = get_context()
+    if ctx.cluster is not None:
+        kwargs.setdefault("host", ctx.cluster.advertise_host)
     handle = _spawn_actor(
         cls, *args, name=name, runtime_dir=ctx.runtime_dir, **kwargs
     )
     ctx._owned_actors.append(handle)
+    if name is not None and ctx.cluster is not None:
+        ctx.cluster.register_named_actor(name, handle)
+        ctx._owned_names.append(name)
     return handle
 
 
 def connect_actor(name: str, num_retries: int = 5) -> ActorHandle:
+    """Discover a named actor: local session registry first, then (cluster
+    mode) the head's registry, with exponential backoff — parity with the
+    reference's ``connect_queue_actor`` retry loop
+    (``batch_queue.py:358-380``)."""
+    ctx = get_context()
+    fallback = (
+        ctx.cluster.lookup_named_actor if ctx.cluster is not None else None
+    )
     return _connect_actor(
-        name, get_context().runtime_dir, num_retries=num_retries
+        name,
+        ctx.runtime_dir,
+        num_retries=num_retries,
+        fallback_resolver=fallback,
     )
 
 
 def resolve_actor(name: str) -> Optional[ActorHandle]:
-    return _resolve_actor(name, get_context().runtime_dir)
+    ctx = get_context()
+    handle = _resolve_actor(name, ctx.runtime_dir)
+    if handle is None and ctx.cluster is not None:
+        handle = ctx.cluster.lookup_named_actor(name)
+    return handle
 
 
 def put_columns(columns) -> ObjectRef:

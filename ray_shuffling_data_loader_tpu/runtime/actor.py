@@ -125,11 +125,17 @@ class _ActorHost:
                         pass
 
     async def start(self):
-        """Bind the server socket; returns once the actor is reachable."""
+        """Bind the server socket; returns once the actor is reachable.
+        TCP with port 0 binds an OS-chosen port and rewrites ``address`` —
+        the child owns port selection, so there is no bind-race with other
+        spawners."""
         self._shutdown = asyncio.Event()
         self._server = await transport.start_server(
             self.address, self._handle_client
         )
+        if self.address[0] == "tcp" and self.address[2] == 0:
+            port = self._server.sockets[0].getsockname()[1]
+            self.address = ("tcp", self.address[1], port)
         setup = getattr(self.instance, "setup", None)
         if setup is not None:
             result = setup()
@@ -139,11 +145,34 @@ class _ActorHost:
     async def wait_shutdown(self):
         async with self._server:
             await self._shutdown.wait()
+        # Graceful resource teardown before process exit (e.g. the cluster
+        # HostAgent reaping its worker pool — a SIGKILLed agent would orphan
+        # the pool, and orphans holding the spawner's resource-tracker pipe
+        # hang that process's interpreter exit).
+        teardown = getattr(self.instance, "teardown", None)
+        if teardown is not None:
+            result = teardown()
+            if asyncio.iscoroutine(result):
+                await result
 
 
-def _actor_main(cls, args, kwargs, address: Address, registry_path, ready_q):
+def _actor_main(
+    cls, args, kwargs, address: Address, registry_path, ready_q,
+    watch_parent: Optional[int] = None,
+):
     # Child process entrypoint (spawned: fresh interpreter, no inherited
     # TPU/JAX state).
+    if watch_parent is not None:
+        # Non-daemon actors (those that must spawn their own children, e.g.
+        # the cluster HostAgent's worker pool) don't die with their parent
+        # automatically; poll the parent pid and exit when orphaned.
+        def _watch():
+            while True:
+                time.sleep(1.0)
+                if not _pid_alive(watch_parent):
+                    os._exit(0)
+
+        threading.Thread(target=_watch, daemon=True).start()
     try:
         instance = cls(*args, **kwargs)
         host = _ActorHost(instance, address)
@@ -158,9 +187,13 @@ def _actor_main(cls, args, kwargs, address: Address, registry_path, ready_q):
         if registry_path is not None:
             tmp = registry_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"address": list(address), "pid": os.getpid()}, f)
+                json.dump(
+                    {"address": list(host.address), "pid": os.getpid()}, f
+                )
             os.replace(tmp, registry_path)
-        ready_q.put(("ok", None))
+        # The bound address travels back (it differs from the requested one
+        # for tcp port 0).
+        ready_q.put(("ok", list(host.address)))
         await host.wait_shutdown()
 
     try:
@@ -391,23 +424,20 @@ def spawn_actor(
     runtime_dir: str,
     host: Optional[str] = None,
     port: int = 0,
+    daemon: bool = True,
     **kwargs,
 ) -> ActorHandle:
     """Start an actor process and return a connected handle.
 
     With ``host`` set, the actor listens on TCP (multi-host control plane);
-    otherwise on a unix socket under ``runtime_dir``.
+    otherwise on a unix socket under ``runtime_dir``. ``daemon=False`` is
+    for actors that must spawn child processes themselves (multiprocessing
+    forbids daemonic parents); they get a parent-death watchdog instead.
     """
     os.makedirs(_registry_dir(runtime_dir), exist_ok=True)
     token = secrets.token_hex(4)
     if host is not None:
-        if port == 0:
-            import socket as _socket
-
-            s = _socket.socket()
-            s.bind((host, 0))
-            port = s.getsockname()[1]
-            s.close()
+        # port 0: the child binds an OS-chosen port and reports it back.
         address: Address = ("tcp", host, port)
     else:
         address = ("unix", os.path.join(runtime_dir, f"a-{token}.sock"))
@@ -421,13 +451,16 @@ def spawn_actor(
     ready_q = ctx.Queue()
     proc = ctx.Process(
         target=_actor_main,
-        args=(cls, args, kwargs, address, registry_path, ready_q),
-        daemon=True,
+        args=(
+            cls, args, kwargs, address, registry_path, ready_q,
+            None if daemon else os.getpid(),
+        ),
+        daemon=daemon,
     )
     proc.start()
     while True:
         try:
-            status, err = ready_q.get(timeout=0.2)
+            status, payload = ready_q.get(timeout=0.2)
             break
         except Exception:  # queue.Empty
             if not proc.is_alive():
@@ -436,8 +469,8 @@ def spawn_actor(
                     f"(exitcode={proc.exitcode})"
                 ) from None
     if status != "ok":
-        raise RuntimeError(f"actor {cls.__name__} failed to start:\n{err}")
-    handle = ActorHandle(address, pid=proc.pid, name=name)
+        raise RuntimeError(f"actor {cls.__name__} failed to start:\n{payload}")
+    handle = ActorHandle(tuple(payload), pid=proc.pid, name=name)
     handle._process = proc  # keep a reference for join/cleanup by the owner
     return handle
 
@@ -455,15 +488,24 @@ def resolve_actor(name: str, runtime_dir: str) -> Optional[ActorHandle]:
 
 
 def connect_actor(
-    name: str, runtime_dir: str, num_retries: int = 5
+    name: str,
+    runtime_dir: str,
+    num_retries: int = 5,
+    fallback_resolver=None,
 ) -> ActorHandle:
     """Discover a named actor, retrying with exponential backoff (parity with
-    reference ``connect_queue_actor``, ``batch_queue.py:358-380``)."""
+    reference ``connect_queue_actor``, ``batch_queue.py:358-380``).
+
+    ``fallback_resolver(name) -> Optional[ActorHandle]`` is consulted when
+    the local session registry misses (cluster mode: the head's registry).
+    """
     retries = 0
     sleep_dur = 1.0
     last_exc: Optional[Exception] = None
     while retries < num_retries:
         handle = resolve_actor(name, runtime_dir)
+        if handle is None and fallback_resolver is not None:
+            handle = fallback_resolver(name)
         if handle is not None and handle.ping():
             return handle
         retries += 1

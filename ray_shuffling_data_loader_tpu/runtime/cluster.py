@@ -1,0 +1,553 @@
+"""Multi-host cluster plane: TCP registry, per-host agents, DCN data fetch.
+
+SURVEY §7 M3: the reference scales by pointing ``ray.init(address="auto")``
+at a Ray cluster — tasks scatter across nodes and the object store moves
+bytes between them transparently. This module is the TPU-VM equivalent,
+built on the same actor/transport substrate the single-host runtime uses
+(everything speaks the framed-pickle protocol of :mod:`.transport`, over TCP
+between hosts — the DCN control path):
+
+* :class:`ClusterRegistry` — one actor on the head host: the cluster-wide
+  name service (``ray.get_actor`` across hosts) plus the host membership
+  table.
+* :class:`HostAgent` — one actor per host, owning that host's spawned
+  :class:`~.tasks.WorkerPool`; the head submits shuffle map/reduce tasks to
+  agents round-robin, so stages scatter across all hosts' CPUs (the
+  ``@ray.remote`` task-scheduling analog).
+* :class:`StoreServer` — one actor per host serving raw object segments to
+  other hosts. A reader whose local ``/dev/shm`` misses an object pulls the
+  segment from its owner and caches it locally — the mapper→reducer and
+  reducer→trainer DCN hops (reference gets this from plasma's cross-node
+  transfer; SURVEY §2b).
+
+Topology:
+
+* head: ``runtime.init_cluster(listen_host=...)`` → session + registry +
+  local agent/store-server.
+* workers: ``runtime.init(address="tcp://head:port")`` → local session
+  joined to the cluster (or ``python -m
+  ray_shuffling_data_loader_tpu.runtime.cluster join tcp://head:port``).
+
+Object movement stays ref-based end to end: only :class:`~.store.ObjectRef`
+handles (now stamped with their owner's store-server address) cross the
+control plane; bulk bytes move host-to-host exactly once, on first use.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import transport
+from .actor import ActorDiedError, ActorHandle, spawn_actor
+from .store import ObjectRef
+
+
+def parse_cluster_address(address: str) -> Tuple[str, int]:
+    """``tcp://host:port`` -> ``(host, port)``."""
+    if not address.startswith("tcp://"):
+        raise ValueError(f"not a cluster address: {address!r}")
+    hostport = address[len("tcp://") :]
+    host, _, port = hostport.rpartition(":")
+    return host, int(port)
+
+
+def format_cluster_address(host: str, port: int) -> str:
+    return f"tcp://{host}:{port}"
+
+
+def default_advertise_host() -> str:
+    """The IP other hosts should dial to reach this host. Overridable via
+    ``RSDL_ADVERTISE_HOST`` (TPU pods: the VM's internal IP)."""
+    env = os.environ.get("RSDL_ADVERTISE_HOST")
+    if env:
+        return env
+    try:
+        # No packets are sent; this just picks the outbound interface.
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        host = s.getsockname()[0]
+        s.close()
+        return host
+    except OSError:
+        return "127.0.0.1"
+
+
+# ---------------------------------------------------------------------------
+# Registry actor (runs on the head host)
+# ---------------------------------------------------------------------------
+
+
+class ClusterRegistry:
+    """Cluster-wide name service + membership table.
+
+    Single-threaded asyncio actor; no locks needed. Hosts and named actors
+    register/deregister here; lookups come from every host.
+    """
+
+    def __init__(self):
+        self._actors: Dict[str, Dict[str, Any]] = {}
+        self._hosts: Dict[str, Dict[str, Any]] = {}
+
+    # -- named actors (cross-host ray.get_actor analog) ----------------------
+
+    def register_actor(self, name: str, address, pid: Optional[int]) -> None:
+        if name in self._actors:
+            raise ValueError(f"actor name {name!r} already registered")
+        self._actors[name] = {"address": list(address), "pid": pid}
+
+    def unregister_actor(self, name: str) -> None:
+        self._actors.pop(name, None)
+
+    def lookup_actor(self, name: str) -> Optional[Dict[str, Any]]:
+        return self._actors.get(name)
+
+    # -- host membership -----------------------------------------------------
+
+    def register_host(
+        self,
+        host_id: str,
+        agent_address,
+        store_address,
+        num_workers: int,
+    ) -> None:
+        self._hosts[host_id] = {
+            "agent": list(agent_address),
+            "store": list(store_address),
+            "num_workers": num_workers,
+            "joined_at": time.time(),
+        }
+
+    def unregister_host(self, host_id: str) -> None:
+        self._hosts.pop(host_id, None)
+
+    def hosts(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self._hosts)
+
+
+# ---------------------------------------------------------------------------
+# Per-host store server (DCN data plane)
+# ---------------------------------------------------------------------------
+
+
+class StoreServer:
+    """Serves this host's shared-memory segments to remote readers.
+
+    ``fetch`` returns the raw segment bytes (header + columnar payload);
+    the reader materializes them as a local segment and maps it zero-copy.
+    One transfer per (object, reader-host) — repeated gets hit the local
+    cache.
+    """
+
+    def __init__(self, shm_dir: str):
+        self.shm_dir = shm_dir
+
+    def _path(self, object_id: str) -> str:
+        # object_ids are token_hex-based; reject anything path-like.
+        if "/" in object_id or object_id.startswith("."):
+            raise ValueError(f"bad object id {object_id!r}")
+        return os.path.join(self.shm_dir, object_id)
+
+    def fetch(self, object_id: str) -> bytes:
+        with open(self._path(object_id), "rb") as f:
+            return f.read()
+
+    def free(self, object_id: str) -> None:
+        try:
+            os.unlink(self._path(object_id))
+        except (FileNotFoundError, ValueError):
+            pass
+
+    def exists(self, object_id: str) -> bool:
+        return os.path.exists(self._path(object_id))
+
+
+# ---------------------------------------------------------------------------
+# Per-host task agent (cross-host task scheduling)
+# ---------------------------------------------------------------------------
+
+
+class HostAgent:
+    """Owns one host's worker pool; executes tasks submitted by the head.
+
+    Runs as an actor process on its host. The pool is created lazily on
+    first submit (pure consumers never pay for it). ``submit`` is async so
+    many tasks run concurrently under the actor's event loop while each
+    awaits its pool future in a thread.
+    """
+
+    def __init__(self, runtime_dir: str, num_workers: int):
+        # Tasks must join THIS host's session (store segments live here).
+        os.environ["RSDL_RUNTIME_DIR"] = runtime_dir
+        self._runtime_dir = runtime_dir
+        self._num_workers = num_workers
+        self._pool = None
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self._completed = 0
+
+    def _get_pool(self):
+        from .tasks import WorkerPool
+
+        with self._lock:
+            if self._pool is None:
+                self._pool = WorkerPool(
+                    self._num_workers,
+                    env={"RSDL_RUNTIME_DIR": self._runtime_dir},
+                )
+            return self._pool
+
+    async def submit(self, fn, args, kwargs):
+        import asyncio
+
+        self._submitted += 1
+        fut = self._get_pool().submit(fn, *args, **kwargs)
+        loop = asyncio.get_running_loop()
+        # TaskFuture.result re-raises TaskError; the actor host forwards it
+        # to the remote caller as the reply frame.
+        result = await loop.run_in_executor(None, fut.result)
+        self._completed += 1
+        return result
+
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def agent_stats(self) -> Dict[str, int]:
+        return {"submitted": self._submitted, "completed": self._completed}
+
+    def teardown(self) -> None:
+        """Reap the worker pool before the actor process exits (called by
+        the actor host on graceful termination)."""
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Client side (lives in RuntimeContext)
+# ---------------------------------------------------------------------------
+
+
+class ClusterTaskFuture:
+    """TaskFuture-compatible wrapper over a concurrent future (same
+    ``done()/result()`` surface ``runtime.wait`` and the shuffle driver
+    poll)."""
+
+    def __init__(self, inner: concurrent.futures.Future):
+        self._inner = inner
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def result(self, timeout: Optional[float] = None):
+        return self._inner.result(timeout)
+
+
+class ClusterScheduler:
+    """Round-robin task scheduler over every host's agent, with dead-agent
+    failover.
+
+    The analog of Ray's cluster scheduler for this workload: shuffle stages
+    are embarrassingly parallel and uniform, so round-robin over hosts
+    (each agent then queues onto its local pool) keeps all hosts' CPUs fed
+    without load telemetry. An agent that dies mid-run (host preempted) is
+    dropped from the rotation and its task retried on a surviving host;
+    ``on_agent_dead`` (set by the owning client) evicts the host from the
+    membership table.
+    """
+
+    def __init__(self, agents: List[ActorHandle], max_inflight: int = 64):
+        if not agents:
+            raise ValueError("no host agents registered")
+        self._agents = list(agents)
+        self._idx = 0
+        self._lock = threading.Lock()
+        self.on_agent_dead = None  # Callable[[ActorHandle], None]
+        # Blocking actor calls ride threads; in-flight tasks are bounded by
+        # the executor width (queued beyond that, preserving order).
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_inflight, thread_name_prefix="cluster-sched"
+        )
+
+    @property
+    def agent_addresses(self) -> set:
+        with self._lock:
+            return {a.address for a in self._agents}
+
+    def _next_agent(self) -> ActorHandle:
+        with self._lock:
+            if not self._agents:
+                raise ActorDiedError("every cluster host agent has died")
+            agent = self._agents[self._idx % len(self._agents)]
+            self._idx += 1
+            return agent
+
+    def _drop_agent(self, agent: ActorHandle) -> None:
+        with self._lock:
+            self._agents = [
+                a for a in self._agents if a.address != agent.address
+            ]
+        if self.on_agent_dead is not None:
+            try:
+                self.on_agent_dead(agent)
+            except Exception:
+                pass
+
+    def _run(self, fn, args, kwargs):
+        # Task bodies are idempotent pure functions over the store (map/
+        # reduce stages), so retrying on another host after an agent death
+        # is safe; at most len(agents) attempts.
+        while True:
+            agent = self._next_agent()
+            try:
+                return agent.call("submit", fn, args, kwargs)
+            except ActorDiedError:
+                self._drop_agent(agent)
+
+    def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
+        inner = self._executor.submit(self._run, fn, args, kwargs)
+        return ClusterTaskFuture(inner)
+
+    def shutdown(self, cancel: bool = True):
+        # cancel=False: a membership-change rebuild retires this scheduler
+        # but already-submitted futures must still run to completion.
+        self._executor.shutdown(wait=False, cancel_futures=cancel)
+
+
+class ClusterClient:
+    """A host's view of the cluster: registry handle + local actors.
+
+    Created by ``runtime.init_cluster`` (head) or ``runtime.init`` with a
+    ``tcp://`` address (worker host). Wires the local store's remote-fetch
+    hooks and exposes the cross-host scheduler.
+    """
+
+    def __init__(
+        self,
+        registry: ActorHandle,
+        host_id: str,
+        advertise_host: str,
+        agent: ActorHandle,
+        store_server: ActorHandle,
+        is_head: bool,
+        registry_address: Tuple[str, int],
+    ):
+        self.registry = registry
+        self.host_id = host_id
+        self.advertise_host = advertise_host
+        self.agent = agent
+        self.store_server = store_server
+        self.is_head = is_head
+        self.address = format_cluster_address(*registry_address)
+        self._scheduler: Optional[ClusterScheduler] = None
+        self._scheduler_lock = threading.Lock()
+        self._scheduler_read_ts = 0.0
+        self._peer_stores: Dict[Tuple, ActorHandle] = {}
+        self._peer_lock = threading.Lock()
+        # How often the scheduler re-reads cluster membership (late joiners
+        # picked up; sub-second churn is not a target).
+        self.membership_refresh_s = 5.0
+
+    # -- data plane hooks (installed into ObjectStore) -----------------------
+
+    def _peer_store(self, address: Tuple) -> ActorHandle:
+        address = tuple(address)
+        with self._peer_lock:
+            handle = self._peer_stores.get(address)
+            if handle is None:
+                handle = ActorHandle(address)
+                self._peer_stores[address] = handle
+            return handle
+
+    def fetch_remote(self, ref: ObjectRef) -> bytes:
+        return self._peer_store(ref.owner).call("fetch", ref.object_id)
+
+    def free_remote(self, ref: ObjectRef) -> None:
+        try:
+            self._peer_store(ref.owner).call_oneway("free", ref.object_id)
+        except ActorDiedError:
+            pass
+
+    @property
+    def store_address(self) -> Tuple:
+        return self.store_server.address
+
+    # -- control plane -------------------------------------------------------
+
+    def _read_agents(self) -> List[ActorHandle]:
+        hosts = self.registry.call("hosts")
+        return [
+            self.agent
+            if info["agent"] == list(self.agent.address)
+            else ActorHandle(tuple(info["agent"]))
+            for info in hosts.values()
+        ]
+
+    def _evict_host(self, agent: ActorHandle) -> None:
+        """Drop a dead agent's host from the membership table so later
+        scheduler rebuilds don't resurrect it."""
+        try:
+            hosts = self.registry.call("hosts")
+            for host_id, info in hosts.items():
+                if tuple(info["agent"]) == tuple(agent.address):
+                    self.registry.call_oneway("unregister_host", host_id)
+        except ActorDiedError:
+            pass
+
+    def scheduler(self) -> ClusterScheduler:
+        """The cluster-wide task scheduler.
+
+        Membership is re-read every ``membership_refresh_s`` so hosts that
+        join after the first submit still receive work; a rebuild preserves
+        nothing but the agent set (the executor is per-scheduler, in-flight
+        calls on the old one complete normally)."""
+        now = time.monotonic()
+        with self._scheduler_lock:
+            stale = (
+                now - self._scheduler_read_ts > self.membership_refresh_s
+            )
+            if self._scheduler is not None and not stale:
+                return self._scheduler
+            if self._scheduler is not None:
+                agents = self._read_agents()
+                self._scheduler_read_ts = now
+                if {a.address for a in agents} == (
+                    self._scheduler.agent_addresses
+                ):
+                    return self._scheduler
+                old, self._scheduler = self._scheduler, None
+                old.shutdown(cancel=False)
+            else:
+                agents = self._read_agents()
+                self._scheduler_read_ts = now
+            self._scheduler = ClusterScheduler(agents)
+            self._scheduler.on_agent_dead = self._evict_host
+            return self._scheduler
+
+    def refresh_scheduler(self) -> ClusterScheduler:
+        """Force a membership re-read (joins/leaves are otherwise picked up
+        within ``membership_refresh_s``)."""
+        with self._scheduler_lock:
+            self._scheduler_read_ts = 0.0
+        return self.scheduler()
+
+    def register_named_actor(self, name: str, handle: ActorHandle) -> None:
+        try:
+            self.registry.call(
+                "register_actor", name, list(handle.address), handle.pid
+            )
+        except ValueError:
+            # Name taken. If the holder is dead (crashed run that never
+            # unregistered), evict the stale record and claim the name;
+            # a live holder is a real conflict.
+            existing = self.lookup_named_actor(name)
+            if existing is not None and existing.ping(timeout=2.0):
+                raise
+            self.registry.call("unregister_actor", name)
+            self.registry.call(
+                "register_actor", name, list(handle.address), handle.pid
+            )
+
+    def unregister_named_actor(self, name: str) -> None:
+        try:
+            self.registry.call_oneway("unregister_actor", name)
+        except ActorDiedError:
+            pass
+
+    def lookup_named_actor(self, name: str) -> Optional[ActorHandle]:
+        record = self.registry.call("lookup_actor", name)
+        if record is None:
+            return None
+        return ActorHandle(
+            tuple(record["address"]), pid=record.get("pid"), name=name
+        )
+
+    def leave(self) -> None:
+        try:
+            self.registry.call_oneway("unregister_host", self.host_id)
+        except ActorDiedError:
+            pass
+        if self._scheduler is not None:
+            self._scheduler.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap helpers (used by runtime.init / init_cluster)
+# ---------------------------------------------------------------------------
+
+
+def start_host_services(
+    runtime_dir: str,
+    num_workers: int,
+    advertise_host: str,
+) -> Tuple[ActorHandle, ActorHandle]:
+    """Spawn this host's agent + store server (TCP-bound)."""
+    from .store import _default_shm_dir
+
+    agent = spawn_actor(
+        HostAgent,
+        runtime_dir,
+        num_workers,
+        runtime_dir=runtime_dir,
+        host=advertise_host,
+        daemon=False,  # the agent spawns its own worker pool
+    )
+    store_server = spawn_actor(
+        StoreServer,
+        _default_shm_dir(),
+        runtime_dir=runtime_dir,
+        host=advertise_host,
+    )
+    return agent, store_server
+
+
+def serve_forever(poll_s: float = 1.0) -> None:
+    """Block while this worker host's services run; returns when the
+    registry becomes unreachable (head shut down)."""
+    from . import get_context
+
+    ctx = get_context()
+    if ctx.cluster is None:
+        raise RuntimeError("not joined to a cluster")
+    while True:
+        time.sleep(poll_s)
+        if not ctx.cluster.registry.ping(timeout=5.0):
+            return
+
+
+def _main(argv: List[str]) -> int:
+    import argparse
+
+    from . import init, shutdown
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_shuffling_data_loader_tpu.runtime.cluster"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    join = sub.add_parser("join", help="join a cluster as a worker host")
+    join.add_argument("address", help="head address, tcp://host:port")
+    join.add_argument("--num-workers", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    if args.cmd == "join":
+        ctx = init(address=args.address, num_workers=args.num_workers)
+        print(
+            f"[rsdl] host {ctx.cluster.host_id} joined {args.address}",
+            flush=True,
+        )
+        try:
+            serve_forever()
+        finally:
+            shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

@@ -64,12 +64,16 @@ class ObjectRef:
     """A small, picklable handle to a shared-memory object.
 
     The control-plane analog of ``ray.ObjectRef``: queues and RPC messages
-    carry these, never the underlying buffers.
+    carry these, never the underlying buffers. In cluster mode ``owner`` is
+    the producing host's store-server address, so any host can pull the
+    segment over DCN on first use (:mod:`.cluster`); ``None`` means
+    single-host/local.
     """
 
     object_id: str
     nbytes: int
     session: str = ""
+    owner: Optional[Tuple] = None
 
 
 class ColumnBatch(Mapping[str, np.ndarray]):
@@ -186,6 +190,13 @@ class ObjectStore:
     def __init__(self, session: str, shm_dir: Optional[str] = None):
         self.session = session
         self.shm_dir = shm_dir or _default_shm_dir()
+        # Cluster-mode hooks, installed by runtime.init when joined to a
+        # cluster: refs minted here get stamped with owner_address; misses
+        # on foreign refs go through remote_fetch; frees forward to owners.
+        self.owner_address: Optional[Tuple] = None
+        self.remote_fetch = None  # Callable[[ObjectRef], bytes]
+        self.remote_free = None  # Callable[[ObjectRef], None]
+        self._foreign: set = set()  # locally cached foreign object ids
 
     # -- write path ---------------------------------------------------------
 
@@ -237,7 +248,12 @@ class ObjectStore:
         finally:
             os.close(fd)
         os.rename(tmp, path)  # atomic publish
-        return ObjectRef(object_id=object_id, nbytes=total, session=self.session)
+        return ObjectRef(
+            object_id=object_id,
+            nbytes=total,
+            session=self.session,
+            owner=self.owner_address,
+        )
 
     def put_bytes(self, data: bytes) -> ObjectRef:
         return self.put_columns({"__bytes__": np.frombuffer(data, np.uint8)})
@@ -245,8 +261,20 @@ class ObjectStore:
     # -- read path ----------------------------------------------------------
 
     def get_columns(self, ref: ObjectRef) -> ColumnBatch:
-        """Open a segment and return zero-copy column views onto it."""
+        """Open a segment and return zero-copy column views onto it.
+
+        When the segment is not on this host and the ref names a remote
+        owner, the whole segment is pulled over DCN once and cached as a
+        local file; subsequent gets map the cache (the plasma cross-node
+        transfer analog, SURVEY §2b)."""
         path = os.path.join(self.shm_dir, ref.object_id)
+        if (
+            not os.path.exists(path)
+            and ref.owner is not None
+            and tuple(ref.owner) != self.owner_address
+            and self.remote_fetch is not None
+        ):
+            self._materialize_remote(ref, path)
         fd = os.open(path, os.O_RDONLY)
         try:
             size = os.fstat(fd).st_size
@@ -272,6 +300,19 @@ class ObjectStore:
     def get_bytes(self, ref: ObjectRef) -> bytes:
         return self.get_columns(ref)["__bytes__"].tobytes()
 
+    def _materialize_remote(self, ref: ObjectRef, path: str) -> None:
+        """Pull a foreign segment's bytes and publish them locally.
+
+        Concurrent readers may race here; both write a private tmp file and
+        the renames are idempotent (same content), so the winner is
+        irrelevant."""
+        data = self.remote_fetch(ref)
+        tmp = f"{path}.fetch-{os.getpid()}-{secrets.token_hex(4)}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.rename(tmp, path)
+        self._foreign.add(ref.object_id)
+
     # -- lifecycle ----------------------------------------------------------
 
     def free(self, refs) -> None:
@@ -282,6 +323,14 @@ class ObjectStore:
                 os.unlink(os.path.join(self.shm_dir, ref.object_id))
             except FileNotFoundError:
                 pass
+            self._foreign.discard(ref.object_id)
+            # Foreign object: also release the authoritative copy.
+            if (
+                ref.owner is not None
+                and tuple(ref.owner) != self.owner_address
+                and self.remote_free is not None
+            ):
+                self.remote_free(ref)
 
     def exists(self, ref: ObjectRef) -> bool:
         return os.path.exists(os.path.join(self.shm_dir, ref.object_id))
@@ -318,3 +367,11 @@ class ObjectStore:
                     os.unlink(os.path.join(self.shm_dir, name))
                 except FileNotFoundError:
                     pass
+        # Cached foreign segments carry their producer's session prefix;
+        # reclaim them explicitly.
+        for object_id in list(self._foreign):
+            try:
+                os.unlink(os.path.join(self.shm_dir, object_id))
+            except FileNotFoundError:
+                pass
+        self._foreign.clear()

@@ -73,6 +73,19 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
 
     os.environ.update(env)
     pid = os.getpid()
+    # Orphan self-destruct: if the pool owner dies without shutdown (e.g.
+    # SIGKILL), exit rather than linger holding inherited pipes/fds.
+    parent = os.getppid()
+
+    def _watch_parent():
+        import time
+
+        while True:
+            time.sleep(1.0)
+            if os.getppid() != parent:
+                os._exit(0)
+
+    threading.Thread(target=_watch_parent, daemon=True).start()
     while True:
         item = task_q.get()
         if item is None:
@@ -199,6 +212,14 @@ class WorkerPool:
             p.join(timeout=2)
             if p.is_alive():
                 p.terminate()
+        for p in self._procs:
+            # SIGKILL stragglers: a worker that survives SIGTERM (e.g. one
+            # wedged mid-syscall) would otherwise hang the interpreter's
+            # multiprocessing atexit join forever.
+            p.join(timeout=2)
+            if p.is_alive():
+                p.kill()
+                p.join()
         try:
             self._result_q.put(None)
         except Exception:

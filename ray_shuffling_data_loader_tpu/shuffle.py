@@ -197,7 +197,9 @@ def shuffle_epoch(
     """
     if stats_collector is not None:
         stats_collector.call_oneway("epoch_start", epoch)
-    pool = runtime.get_context().pool
+    # Cluster mode scatters stages across every host's workers; single-host
+    # falls back to the local pool (same submit surface).
+    pool = runtime.get_context().scheduler
     map_futs: List[TaskFuture] = [
         pool.submit(
             shuffle_map, fname, i, num_reducers, epoch, seed, stats_collector
@@ -275,17 +277,20 @@ def shuffle(
     num_trainers: int,
     seed: int = 0,
     stats_collector=None,
+    start_epoch: int = 0,
 ) -> float:
     """Shuffle the dataset every epoch; returns total wall-clock duration.
 
     The top-level driver (reference ``shuffle``, ``shuffle.py:51-86``): for
     each epoch, block until the consumer's epoch window admits it, then
-    launch that epoch's map/reduce/delivery pipeline.
+    launch that epoch's map/reduce/delivery pipeline. ``start_epoch`` skips
+    fully-consumed epochs when resuming from a checkpoint (epoch indices
+    stay absolute so per-epoch permutations match the original run).
     """
     runtime.ensure_initialized()
     start = timeit.default_timer()
     threads = []
-    for epoch in range(num_epochs):
+    for epoch in range(start_epoch, num_epochs):
         throttle_start = timeit.default_timer()
         batch_consumer.wait_until_ready(epoch)
         if stats_collector is not None:

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Example smoke runs (reference run_ci_examples.sh runs the dataset and
+# torch_dataset __main__ smoke tests; here the end-to-end DLRM trainer on a
+# tiny workload, CPU backend, plus the multi-chip dry run).
+set -euo pipefail
+cd "$(dirname "$0")"
+export JAX_PLATFORMS=cpu
+python examples/train_dlrm.py --smoke
+python __graft_entry__.py 8

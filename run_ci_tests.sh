@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# CI test entry (reference run_ci_tests.sh:8-11 wraps pytest likewise).
+# Tests force the CPU backend with 8 virtual devices via tests/conftest.py.
+set -euo pipefail
+cd "$(dirname "$0")"
+python -m pytest tests/ -v --durations=10 -x

@@ -1,0 +1,219 @@
+"""Checkpoint/resume tests: manager round-trip, retention, atomicity,
+cursor validation, sharded state restore, and the core resume property —
+``set_epoch(epoch, skip_batches=k)`` reproduces exactly the batches an
+uninterrupted run would have yielded after its first ``k``. The reference
+has no checkpointing at all (SURVEY §5), so these tests define the new
+subsystem's contract."""
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu import (
+    BatchCursor,
+    CheckpointManager,
+    ShufflingDataset,
+)
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+
+@pytest.fixture(scope="module")
+def ckpt_files(local_runtime, tmp_path_factory):
+    data_dir = tmp_path_factory.mktemp("ckpt-data")
+    filenames, _ = generate_data(
+        num_rows=2000,
+        num_files=2,
+        num_row_groups_per_file=1,
+        max_row_group_skew=0.0,
+        data_dir=str(data_dir),
+    )
+    return filenames
+
+
+def _make_ds(files, queue_name, **kwargs):
+    defaults = dict(
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=300,
+        rank=0,
+        num_reducers=3,
+        seed=7,
+    )
+    defaults.update(kwargs)
+    return ShufflingDataset(files, queue_name=queue_name, **defaults)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_manager_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() is None
+    assert mgr.restore_cursor() is None
+
+    cursor = BatchCursor(epoch=3, batches_yielded=17, config={"seed": 1})
+    state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones(3)}
+    mgr.save(42, cursor=cursor, state=state)
+
+    assert mgr.latest_step() == 42
+    got_cursor = mgr.restore_cursor()
+    assert got_cursor.epoch == 3
+    assert got_cursor.batches_yielded == 17
+    assert got_cursor.step == 42
+    assert got_cursor.config == {"seed": 1}
+
+    target = {"w": np.zeros((2, 3), np.float32), "b": np.zeros(3)}
+    got_state = mgr.restore_state(target)
+    np.testing.assert_array_equal(got_state["w"], state["w"])
+    np.testing.assert_array_equal(got_state["b"], state["b"])
+
+
+def test_manager_retention_and_steps(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    for step in (1, 5, 9):
+        mgr.save(step, cursor=BatchCursor(epoch=0, batches_yielded=step))
+    assert mgr.all_steps() == [5, 9]
+    assert mgr.restore_cursor(5).batches_yielded == 5
+    # Restoring a pruned step yields None, not an error.
+    assert mgr.restore_cursor(1) is None
+
+
+def test_manager_atomic_no_partial_dirs(tmp_path):
+    """A failed save must not leave a visible ckpt- directory."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+
+    class Boom:
+        pass
+
+    with pytest.raises(Exception):
+        # flax can't serialize an arbitrary object -> save raises mid-write.
+        mgr.save(7, state={"bad": Boom()})
+    assert mgr.all_steps() == []
+
+
+def test_cursor_validation():
+    config = BatchCursor.stream_config(
+        seed=1,
+        batch_size=10,
+        num_trainers=2,
+        num_reducers=4,
+        num_files=3,
+        drop_last=False,
+    )
+    cursor = BatchCursor(epoch=0, batches_yielded=0, config=config)
+    cursor.validate(dict(config))  # identical: fine
+    with pytest.raises(ValueError, match="batch_size"):
+        cursor.validate({**config, "batch_size": 20})
+
+
+def test_restore_sharded_state(tmp_path):
+    """State leaves land with the requested shardings on restore."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    state = {"w": np.arange(8, dtype=np.float32)}
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, state=state)
+    restored = mgr.restore_state(
+        {"w": np.zeros(8, np.float32)}, shardings={"w": sharding}
+    )
+    assert restored["w"].sharding == sharding
+    np.testing.assert_array_equal(np.asarray(restored["w"]), state["w"])
+
+
+# ---------------------------------------------------------------------------
+# Mid-epoch resume through the dataset
+# ---------------------------------------------------------------------------
+
+
+def test_skip_batches_resumes_stream(local_runtime, ckpt_files):
+    """The resumed stream equals the uninterrupted stream's tail, batch for
+    batch — the property that makes cursor checkpointing sound."""
+    full = _make_ds(ckpt_files, "q-ck-full")
+    full.set_epoch(0)
+    full_keys = [b["key"].tolist() for b in full]
+    assert len(full_keys) == 7  # 2000 rows / 300 -> 6 full + 1 partial
+
+    skip = 3
+    resumed = _make_ds(ckpt_files, "q-ck-resume")
+    resumed.set_epoch(0, skip_batches=skip)
+    resumed_keys = [b["key"].tolist() for b in resumed]
+    assert resumed_keys == full_keys[skip:]
+
+
+def test_skip_all_batches(local_runtime, ckpt_files):
+    """Skipping every batch (resume exactly at epoch end) yields nothing but
+    still drains and acks the epoch."""
+    ds = _make_ds(ckpt_files, "q-ck-skipall")
+    ds.set_epoch(0, skip_batches=7)
+    assert list(ds) == []
+
+
+def test_skip_resets_next_epoch(local_runtime, ckpt_files):
+    """skip_batches applies only to the epoch it was set for."""
+    ds = _make_ds(ckpt_files, "q-ck-reset", num_epochs=2)
+    ds.set_epoch(0, skip_batches=5)
+    assert len(list(ds)) == 2
+    ds.set_epoch(1)
+    assert len(list(ds)) == 7
+
+
+def test_start_epoch_resume(local_runtime, ckpt_files):
+    """Epoch-level resume: a dataset constructed with ``start_epoch=1``
+    yields epoch 1 exactly as the original run did (absolute epoch indices
+    keep the permutations identical), without shuffling epoch 0 at all."""
+    full = _make_ds(ckpt_files, "q-ck-se-full", num_epochs=2)
+    full.set_epoch(0)
+    list(full)
+    full.set_epoch(1)
+    epoch1 = [b["key"].tolist() for b in full]
+
+    resumed = _make_ds(
+        ckpt_files, "q-ck-se-res", num_epochs=2, start_epoch=1
+    )
+    resumed.set_epoch(1)
+    assert [b["key"].tolist() for b in resumed] == epoch1
+
+
+def test_end_to_end_preemption_replay(local_runtime, ckpt_files, tmp_path):
+    """Simulated preemption: after k batches the cursor is checkpointed and
+    every later batch of the first run is treated as lost (in a real
+    preemption the whole process dies); a fresh dataset resumes from the
+    cursor and re-produces exactly the lost tail — union of kept + resumed
+    keys = whole dataset, no dupes."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    config = BatchCursor.stream_config(
+        seed=7,
+        batch_size=300,
+        num_trainers=1,
+        num_reducers=3,
+        num_files=len(ckpt_files),
+        drop_last=False,
+    )
+
+    first = _make_ds(ckpt_files, "q-ck-pre1")
+    first.set_epoch(0)
+    kept = []
+    for i, batch in enumerate(first):
+        if i <= 1:
+            kept.append(batch["key"].tolist())
+        if i == 1:  # cursor written right after batch 2
+            mgr.save(
+                i + 1,
+                cursor=BatchCursor(
+                    epoch=0, batches_yielded=i + 1, config=config
+                ),
+            )
+        # batches after the checkpoint are discarded ("lost to preemption")
+
+    cursor = mgr.restore_cursor()
+    cursor.validate(config)
+    resumed = _make_ds(ckpt_files, "q-ck-pre2")
+    resumed.set_epoch(cursor.epoch, skip_batches=cursor.batches_yielded)
+    for batch in resumed:
+        kept.append(batch["key"].tolist())
+    all_keys = [k for batch in kept for k in batch]
+    assert sorted(all_keys) == list(range(2000))
