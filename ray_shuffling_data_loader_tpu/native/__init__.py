@@ -161,30 +161,68 @@ def _check_bounds(idx: np.ndarray, n: int) -> bool:
     return lo >= 0
 
 
-def take(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
-    """``arr[idx]`` along axis 0 (multi-threaded when native is loaded)."""
+def _out_ok(out: Optional[np.ndarray], shape, dtype) -> bool:
+    """Strict ``out=`` contract: providing a destination that cannot hold
+    the result is a caller bug and raises — silently falling back to a
+    fresh array would publish an untouched (zero) segment in the
+    direct-to-store write paths."""
+    if out is None:
+        return False
+    if (
+        out.shape != tuple(shape)
+        or out.dtype != dtype
+        or not out.flags.c_contiguous
+        or not out.flags.writeable
+    ):
+        raise ValueError(
+            f"out= mismatch: need {tuple(shape)} {dtype} C-contiguous "
+            f"writable, got {out.shape} {out.dtype} "
+            f"(contig={out.flags.c_contiguous}, "
+            f"writable={out.flags.writeable})"
+        )
+    return True
+
+
+def take(
+    arr: np.ndarray, idx: np.ndarray, out: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """``arr[idx]`` along axis 0 (multi-threaded when native is loaded).
+
+    ``out``: pre-allocated destination (e.g. a writable store-segment view
+    from ``ObjectStore.create_columns``) — the gather lands directly in
+    shared memory, skipping the copy-out a fresh array would need."""
     lib = _get_lib()
     row_bytes = _rows_contig(arr)
+    shape = (len(np.asarray(idx)), *arr.shape[1:])
     if (
         lib is None
         or row_bytes is None
         or arr.size == 0
         or not _check_bounds(np.asarray(idx), len(arr))
     ):
+        if _out_ok(out, shape, arr.dtype):
+            np.take(arr, np.asarray(idx), axis=0, out=out)
+            return out
         return arr[idx]
     idx = np.ascontiguousarray(idx, dtype=np.int64)
-    out = np.empty((len(idx), *arr.shape[1:]), dtype=arr.dtype)
+    if not _out_ok(out, shape, arr.dtype):
+        out = np.empty(shape, dtype=arr.dtype)
     lib.rsdl_take(
         _ptr(arr), _ptr(out), _ptr(idx), len(idx), row_bytes, _NUM_THREADS
     )
     return out
 
 
-def take_multi(parts: Sequence[np.ndarray], idx: np.ndarray) -> np.ndarray:
+def take_multi(
+    parts: Sequence[np.ndarray],
+    idx: np.ndarray,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
     """``np.concatenate(parts)[idx]`` without materializing the concat.
 
     The reduce-stage hot path: `parts` are one column's partitions from all
     mappers, `idx` the epoch permutation over their concatenated rows.
+    ``out`` lands the gather directly in a pre-allocated destination.
     """
     if not parts:
         raise ValueError("need at least one part to concatenate")
@@ -213,12 +251,14 @@ def take_multi(parts: Sequence[np.ndarray], idx: np.ndarray) -> np.ndarray:
         or not _check_bounds(np.asarray(idx), total)
     ):
         base = parts[0] if len(parts) == 1 else np.concatenate(parts)
-        return take(base, idx)
+        return take(base, idx, out=out)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
     offsets = np.zeros(len(parts) + 1, dtype=np.int64)
     np.cumsum([len(p) for p in parts], out=offsets[1:])
     ptrs = (ctypes.c_void_p * len(parts))(*[p.ctypes.data for p in parts])
-    out = np.empty((len(idx), *parts[0].shape[1:]), dtype=parts[0].dtype)
+    shape = (len(idx), *parts[0].shape[1:])
+    if not _out_ok(out, shape, parts[0].dtype):
+        out = np.empty(shape, dtype=parts[0].dtype)
     if row_bytes == 8:
         lib.rsdl_take_multi8(
             ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
@@ -263,11 +303,18 @@ def group_rows(arr: np.ndarray, assignment: np.ndarray, num_groups: int):
 
 
 def group_rows_multi(
-    columns: dict, assignment: np.ndarray, num_groups: int
+    columns: dict,
+    assignment: np.ndarray,
+    num_groups: int,
+    out: Optional[dict] = None,
 ):
     """:func:`group_rows` over several equal-length columns sharing one
     assignment. The numpy fallback argsorts the assignment ONCE and gathers
-    each column, matching the native path's per-column O(n) cost."""
+    each column, matching the native path's per-column O(n) cost.
+
+    ``out``: dict of pre-allocated destinations per column (e.g. writable
+    store-segment views) — the partition scatter writes shared memory
+    directly; the map stage's only full data pass."""
     lib = _get_lib()
     arrs = list(columns.values())
     assignment = np.asarray(assignment)
@@ -288,17 +335,34 @@ def group_rows_multi(
     counts = np.bincount(assignment, minlength=num_groups)
     offsets = np.zeros(num_groups + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
+    def _dst(name, arr):
+        if out is None:
+            return None
+        if name not in out:
+            raise KeyError(f"out= missing destination for column {name!r}")
+        return out[name]
+
     if not native_ok:
         order = np.argsort(assignment, kind="stable")
-        return {k: v[order] for k, v in columns.items()}, offsets
+        result = {}
+        for k, v in columns.items():
+            dst = _dst(k, v)
+            if _out_ok(dst, v.shape, v.dtype):
+                np.take(v, order, axis=0, out=dst)
+                result[k] = dst
+            else:
+                result[k] = v[order]
+        return result, offsets
     assignment = np.ascontiguousarray(assignment, dtype=np.int32)
-    out = {}
+    result = {}
     for name, arr in columns.items():
         cursors = offsets[:num_groups].copy()  # C kernel advances these
-        dst = np.empty_like(arr)
+        dst = _dst(name, arr)
+        if not _out_ok(dst, arr.shape, arr.dtype):
+            dst = np.empty_like(arr)
         lib.rsdl_group_rows(
             _ptr(arr), _ptr(dst), _ptr(assignment), len(arr),
             _rows_contig(arr), _ptr(cursors),
         )
-        out[name] = dst
-    return out, offsets
+        result[name] = dst
+    return result, offsets

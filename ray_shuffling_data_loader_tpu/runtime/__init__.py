@@ -124,6 +124,11 @@ def _attach_cluster_client(ctx: RuntimeContext, record: dict, owns: bool):
     registry/agent/store handles + the store's remote data-plane hooks."""
     from .cluster import ClusterClient
 
+    # Task workers joining via the runtime dir need the cluster's bearer
+    # token before their first TCP frame.
+    if record.get("token") and not os.environ.get("RSDL_CLUSTER_TOKEN"):
+        os.environ["RSDL_CLUSTER_TOKEN"] = record["token"]
+
     client = ClusterClient(
         registry=ActorHandle(tuple(record["registry"])),
         host_id=record["host_id"],
@@ -173,6 +178,7 @@ def _bootstrap_cluster_host(
         "host_id": host_id,
         "advertise": advertise,
         "is_head": is_head,
+        "token": os.environ.get("RSDL_CLUSTER_TOKEN"),
     }
     with open(os.path.join(ctx.runtime_dir, _CLUSTER_FILE), "w") as f:
         json.dump(record, f)
@@ -208,10 +214,14 @@ def init(
                 parse_cluster_address,
             )
 
+            host, port, token = parse_cluster_address(address)
+            if token:
+                # Must land before the first TCP frame (the registry ping).
+                os.environ["RSDL_CLUSTER_TOKEN"] = token
             runtime_dir = _new_session_dir()
             os.environ[_ENV_DIR] = runtime_dir
             ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
-            registry = ActorHandle(("tcp", *parse_cluster_address(address)))
+            registry = ActorHandle(("tcp", host, port))
             registry.wait_ready()
             _context = ctx
             atexit.register(shutdown)
@@ -278,6 +288,10 @@ def init_cluster(
         _context = ctx
         atexit.register(shutdown)
     try:
+        # Mint the cluster's bearer token before any TCP endpoint exists;
+        # every spawned service inherits it via the environment and every
+        # joiner receives it inside the printed tcp:// address.
+        os.environ.setdefault("RSDL_CLUSTER_TOKEN", secrets.token_hex(16))
         advertise = advertise_host or default_advertise_host()
         bind_host = advertise if listen_host == "0.0.0.0" else listen_host
         registry = _spawn_actor(

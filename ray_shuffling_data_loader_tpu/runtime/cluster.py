@@ -47,17 +47,28 @@ from .actor import ActorDiedError, ActorHandle, spawn_actor
 from .store import ObjectRef
 
 
-def parse_cluster_address(address: str) -> Tuple[str, int]:
-    """``tcp://host:port`` -> ``(host, port)``."""
+def parse_cluster_address(address: str) -> Tuple[str, int, Optional[str]]:
+    """``tcp://host:port[/token]`` -> ``(host, port, token)``.
+
+    The token is the cluster's bearer secret (see :mod:`.transport`); the
+    full address string is the single thing an operator copies from the
+    head to each worker host.
+    """
     if not address.startswith("tcp://"):
         raise ValueError(f"not a cluster address: {address!r}")
-    hostport = address[len("tcp://") :]
-    host, _, port = hostport.rpartition(":")
-    return host, int(port)
+    rest = address[len("tcp://") :]
+    token = None
+    if "/" in rest:
+        rest, token = rest.split("/", 1)
+    host, _, port = rest.rpartition(":")
+    return host, int(port), token or None
 
 
-def format_cluster_address(host: str, port: int) -> str:
-    return f"tcp://{host}:{port}"
+def format_cluster_address(
+    host: str, port: int, token: Optional[str] = None
+) -> str:
+    base = f"tcp://{host}:{port}"
+    return f"{base}/{token}" if token else base
 
 
 def default_advertise_host() -> str:
@@ -137,10 +148,12 @@ class ClusterRegistry:
 class StoreServer:
     """Serves this host's shared-memory segments to remote readers.
 
-    ``fetch`` returns the raw segment bytes (header + columnar payload);
+    ``fetch`` returns raw segment-format bytes (header + columnar payload);
     the reader materializes them as a local segment and maps it zero-copy.
     One transfer per (object, reader-host) — repeated gets hit the local
-    cache.
+    cache. A ``rows`` window ships just that window re-serialized (refs
+    published via ``publish_slices`` share one physical segment; without
+    slicing, every reducer would pull the whole thing — R× DCN traffic).
     """
 
     def __init__(self, shm_dir: str):
@@ -152,9 +165,17 @@ class StoreServer:
             raise ValueError(f"bad object id {object_id!r}")
         return os.path.join(self.shm_dir, object_id)
 
-    def fetch(self, object_id: str) -> bytes:
-        with open(self._path(object_id), "rb") as f:
-            return f.read()
+    def fetch(self, object_id: str, rows=None) -> bytes:
+        path = self._path(object_id)
+        if rows is None:
+            with open(path, "rb") as f:
+                return f.read()
+        from .store import map_segment_file, serialize_columns
+
+        batch = map_segment_file(path, object_id).slice(
+            int(rows[0]), int(rows[1])
+        )
+        return serialize_columns(batch.columns)
 
     def free(self, object_id: str) -> None:
         try:
@@ -343,7 +364,9 @@ class ClusterClient:
         self.agent = agent
         self.store_server = store_server
         self.is_head = is_head
-        self.address = format_cluster_address(*registry_address)
+        self.address = format_cluster_address(
+            *registry_address, token=os.environ.get("RSDL_CLUSTER_TOKEN")
+        )
         self._scheduler: Optional[ClusterScheduler] = None
         self._scheduler_lock = threading.Lock()
         self._scheduler_read_ts = 0.0
@@ -365,7 +388,9 @@ class ClusterClient:
             return handle
 
     def fetch_remote(self, ref: ObjectRef) -> bytes:
-        return self._peer_store(ref.owner).call("fetch", ref.object_id)
+        return self._peer_store(ref.owner).call(
+            "fetch", ref.object_id, ref.rows
+        )
 
     def free_remote(self, ref: ObjectRef) -> None:
         try:

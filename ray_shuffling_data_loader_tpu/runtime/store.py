@@ -59,6 +59,34 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+def _plan_layout(spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]):
+    """The single source of truth for the segment format: per-column meta,
+    payload start, and total size for a ``{name: (shape, dtype)}`` spec.
+    Used by the disk write path (``create_columns``) and the DCN wire path
+    (``serialize_columns``) so the two can never drift."""
+    meta: List[dict] = []
+    offset = 0
+    for name, (shape, dtype) in spec.items():
+        dtype = np.dtype(dtype)
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        offset = _align(offset)
+        meta.append(
+            {
+                "name": name,
+                "dtype": dtype.str,
+                "shape": list(shape),
+                "offset": offset,
+                "nbytes": nbytes,
+            }
+        )
+        offset += nbytes
+    payload_bytes = _align(offset)
+    meta_blob = json.dumps({"columns": meta}).encode()
+    payload_start = _align(_HEADER.size + len(meta_blob))
+    total = payload_start + payload_bytes
+    return meta, meta_blob, payload_start, total
+
+
 @dataclass(frozen=True)
 class ObjectRef:
     """A small, picklable handle to a shared-memory object.
@@ -68,12 +96,19 @@ class ObjectRef:
     the producing host's store-server address, so any host can pull the
     segment over DCN on first use (:mod:`.cluster`); ``None`` means
     single-host/local.
+
+    ``rows`` restricts the ref to a half-open row window of the segment —
+    several refs can hardlink one physical segment (the map stage publishes
+    its per-reducer partitions this way, so partitioning writes each row
+    once instead of once per copy-out). Each ref owns its own directory
+    link; the data dies when the last link is freed.
     """
 
     object_id: str
     nbytes: int
     session: str = ""
     owner: Optional[Tuple] = None
+    rows: Optional[Tuple[int, int]] = None
 
 
 class ColumnBatch(Mapping[str, np.ndarray]):
@@ -123,12 +158,15 @@ class ColumnBatch(Mapping[str, np.ndarray]):
 
     @staticmethod
     def concat_take(
-        batches: Sequence["ColumnBatch"], indices: np.ndarray
+        batches: Sequence["ColumnBatch"],
+        indices: np.ndarray,
+        out: Optional[Dict[str, np.ndarray]] = None,
     ) -> "ColumnBatch":
         """``concat(batches).take(indices)`` without materializing the
         concat when the native fused kernel is available (reduce-stage hot
         path; the reference pays ``pd.concat`` + ``DataFrame.sample``,
-        reference ``shuffle.py:192-194``)."""
+        reference ``shuffle.py:192-194``). ``out`` gathers straight into
+        pre-allocated destinations (store-segment views)."""
         from ray_shuffling_data_loader_tpu import native
 
         batches = [b for b in batches if b is not None and b.num_rows > 0]
@@ -137,7 +175,13 @@ class ColumnBatch(Mapping[str, np.ndarray]):
         keys = list(batches[0])
         return ColumnBatch(
             {
-                k: native.take_multi([b[k] for b in batches], indices)
+                k: native.take_multi(
+                    [b[k] for b in batches],
+                    indices,
+                    # out[k]: a missing destination must raise, not silently
+                    # gather into a throwaway array.
+                    out=out[k] if out is not None else None,
+                )
                 for k in keys
             }
         )
@@ -173,6 +217,122 @@ class ColumnBatch(Mapping[str, np.ndarray]):
         )
 
 
+class PendingColumns:
+    """An allocated-but-unpublished segment with writable column views.
+
+    Produced by :meth:`ObjectStore.create_columns`. The mapping stays alive
+    as long as this object (or any view of it) does; publishing renames the
+    hidden ``.tmp`` file, so readers never observe a half-written segment.
+    """
+
+    def __init__(self, store, object_id, tmp_path, path, nbytes, mm, views):
+        self._store = store
+        self.object_id = object_id
+        self._tmp = tmp_path
+        self._path = path
+        self.nbytes = nbytes
+        self._mm = mm
+        self.columns: Dict[str, np.ndarray] = views
+        self._published = False
+
+    @property
+    def num_rows(self) -> int:
+        for v in self.columns.values():
+            return len(v)
+        return 0
+
+    def seal(self) -> ObjectRef:
+        """Publish as a single object."""
+        assert not self._published, "already published"
+        os.rename(self._tmp, self._path)
+        self._published = True
+        return ObjectRef(
+            object_id=self.object_id,
+            nbytes=self.nbytes,
+            session=self._store.session,
+            owner=self._store.owner_address,
+        )
+
+    def publish_slices(
+        self, windows: Sequence[Tuple[int, int]]
+    ) -> List[ObjectRef]:
+        """Publish one hardlinked ref per row window.
+
+        Each ref owns its own directory entry (tmpfs hardlink), so the
+        per-ref ``free()`` semantics are unchanged and the physical pages
+        are reclaimed when the last window is freed — a filesystem-level
+        refcount standing in for Ray's distributed ref counting.
+        """
+        assert not self._published, "already published"
+        refs: List[ObjectRef] = []
+        for start, stop in windows:
+            link_id = self._store._new_object_id()
+            os.link(self._tmp, os.path.join(self._store.shm_dir, link_id))
+            refs.append(
+                ObjectRef(
+                    object_id=link_id,
+                    nbytes=self.nbytes,
+                    session=self._store.session,
+                    owner=self._store.owner_address,
+                    rows=(int(start), int(stop)),
+                )
+            )
+        os.unlink(self._tmp)
+        self._published = True
+        return refs
+
+    def abort(self) -> None:
+        if not self._published:
+            try:
+                os.unlink(self._tmp)
+            except FileNotFoundError:
+                pass
+            self._published = True
+
+
+def map_segment_file(path: str, object_id: str = "?") -> ColumnBatch:
+    """mmap a published segment file into zero-copy column views."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        size = os.fstat(fd).st_size
+        mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+    finally:
+        os.close(fd)
+    magic, meta_len = _HEADER.unpack_from(mm, 0)
+    if magic != _MAGIC:
+        raise ValueError(f"corrupt object segment {object_id!r}")
+    meta = json.loads(bytes(mm[_HEADER.size : _HEADER.size + meta_len]))
+    payload_start = _align(_HEADER.size + meta_len)
+    cols: Dict[str, np.ndarray] = {}
+    for m in meta["columns"]:
+        arr = np.frombuffer(
+            mm,
+            dtype=np.dtype(m["dtype"]),
+            count=int(np.prod(m["shape"])) if m["shape"] else 1,
+            offset=payload_start + m["offset"],
+        ).reshape(m["shape"])
+        cols[m["name"]] = arr
+    return ColumnBatch(cols, _keepalive=mm)
+
+
+def serialize_columns(columns: Mapping[str, np.ndarray]) -> bytes:
+    """Serialize columns into the segment wire/disk format (used by the
+    cluster StoreServer to ship a ref's row window without the rest of the
+    segment)."""
+    cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
+    meta, meta_blob, payload_start, total = _plan_layout(
+        {k: (v.shape, v.dtype) for k, v in cols.items()}
+    )
+    out = bytearray(total)
+    out[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
+    out[_HEADER.size : _HEADER.size + len(meta_blob)] = meta_blob
+    view = np.frombuffer(out, dtype=np.uint8)
+    for m, arr in zip(meta, cols.values()):
+        start = payload_start + m["offset"]
+        view[start : start + arr.nbytes] = arr.reshape(-1).view(np.uint8)
+    return bytes(out)
+
+
 @dataclass
 class StoreStats:
     num_objects: int = 0
@@ -200,60 +360,53 @@ class ObjectStore:
 
     # -- write path ---------------------------------------------------------
 
-    def put_columns(self, columns: Mapping[str, np.ndarray]) -> ObjectRef:
-        """Write a columnar batch as one aligned segment; return its ref."""
-        cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
-        meta: List[dict] = []
-        offset = 0
-        # Header is written first; buffer offsets are relative to payload
-        # start, which is itself aligned.
-        for name, arr in cols.items():
-            offset = _align(offset)
-            meta.append(
-                {
-                    "name": name,
-                    "dtype": arr.dtype.str,
-                    "shape": list(arr.shape),
-                    "offset": offset,
-                    "nbytes": arr.nbytes,
-                }
-            )
-            offset += arr.nbytes
-        payload_bytes = _align(offset)
-        meta_blob = json.dumps({"columns": meta}).encode()
-        payload_start = _align(_HEADER.size + len(meta_blob))
-        total = payload_start + payload_bytes
+    def _new_object_id(self) -> str:
+        return f"{self.session}-{secrets.token_hex(8)}"
 
-        object_id = f"{self.session}-{secrets.token_hex(8)}"
+    def create_columns(
+        self, spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]
+    ) -> "PendingColumns":
+        """Allocate an unpublished segment and return writable column views.
+
+        The zero-extra-copy write path: producers (shuffle map/reduce
+        kernels) scatter/gather rows *directly into shared memory* instead
+        of building host arrays and copying them in via :meth:`put_columns`
+        — one full memory pass saved per stage. Fill the views, then
+        ``seal()`` (one ref) or ``publish_slices()`` (hardlinked row-window
+        refs).
+        """
+        meta, meta_blob, payload_start, total = _plan_layout(spec)
+
+        object_id = self._new_object_id()
         path = os.path.join(self.shm_dir, object_id)
         tmp = path + ".tmp"
         fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, max(total, 1))
             mm = mmap.mmap(fd, max(total, 1))
-            try:
-                mm[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
-                mm[_HEADER.size : _HEADER.size + len(meta_blob)] = meta_blob
-                for m, arr in zip(meta, cols.values()):
-                    start = payload_start + m["offset"]
-                    dst = np.frombuffer(
-                        mm, dtype=np.uint8, count=arr.nbytes, offset=start
-                    )
-                    dst[:] = arr.reshape(-1).view(np.uint8)
-                    # Drop the exported buffer before close, else mmap.close
-                    # raises BufferError.
-                    del dst
-            finally:
-                mm.close()
         finally:
             os.close(fd)
-        os.rename(tmp, path)  # atomic publish
-        return ObjectRef(
-            object_id=object_id,
-            nbytes=total,
-            session=self.session,
-            owner=self.owner_address,
+        mm[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
+        mm[_HEADER.size : _HEADER.size + len(meta_blob)] = meta_blob
+        views: Dict[str, np.ndarray] = {}
+        for m in meta:
+            views[m["name"]] = np.frombuffer(
+                mm,
+                dtype=np.dtype(m["dtype"]),
+                count=int(np.prod(m["shape"], dtype=np.int64)),
+                offset=payload_start + m["offset"],
+            ).reshape(m["shape"])
+        return PendingColumns(self, object_id, tmp, path, total, mm, views)
+
+    def put_columns(self, columns: Mapping[str, np.ndarray]) -> ObjectRef:
+        """Write a columnar batch as one aligned segment; return its ref."""
+        cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
+        pending = self.create_columns(
+            {k: (v.shape, v.dtype) for k, v in cols.items()}
         )
+        for k, v in cols.items():
+            pending.columns[k][...] = v
+        return pending.seal()
 
     def put_bytes(self, data: bytes) -> ObjectRef:
         return self.put_columns({"__bytes__": np.frombuffer(data, np.uint8)})
@@ -263,45 +416,49 @@ class ObjectStore:
     def get_columns(self, ref: ObjectRef) -> ColumnBatch:
         """Open a segment and return zero-copy column views onto it.
 
-        When the segment is not on this host and the ref names a remote
-        owner, the whole segment is pulled over DCN once and cached as a
-        local file; subsequent gets map the cache (the plasma cross-node
-        transfer analog, SURVEY §2b)."""
+        ``ref.rows`` windows slice the views (still zero-copy). When the
+        segment is not on this host and the ref names a remote owner, just
+        the ref's window is pulled over DCN once and cached as a local
+        standalone segment; subsequent gets map the cache (the plasma
+        cross-node transfer analog, SURVEY §2b)."""
         path = os.path.join(self.shm_dir, ref.object_id)
-        if (
-            not os.path.exists(path)
-            and ref.owner is not None
+        rows = ref.rows
+        if not os.path.exists(path) and self._is_foreign(ref):
+            # Window refs cache under a window-suffixed name (the fetched
+            # segment holds only the window; the name keeps that fact
+            # consistent across processes on this host).
+            cache_path = self._cache_path(ref)
+            if not os.path.exists(cache_path):
+                self._materialize_remote(ref, cache_path)
+            path = cache_path
+            rows = None
+        batch = self._map_segment(path, ref.object_id)
+        if rows is not None:
+            batch = batch.slice(rows[0], rows[1])
+        return batch
+
+    def _is_foreign(self, ref: ObjectRef) -> bool:
+        return (
+            ref.owner is not None
             and tuple(ref.owner) != self.owner_address
             and self.remote_fetch is not None
-        ):
-            self._materialize_remote(ref, path)
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            size = os.fstat(fd).st_size
-            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
-        finally:
-            os.close(fd)
-        magic, meta_len = _HEADER.unpack_from(mm, 0)
-        if magic != _MAGIC:
-            raise ValueError(f"corrupt object segment {ref.object_id!r}")
-        meta = json.loads(bytes(mm[_HEADER.size : _HEADER.size + meta_len]))
-        payload_start = _align(_HEADER.size + meta_len)
-        cols: Dict[str, np.ndarray] = {}
-        for m in meta["columns"]:
-            arr = np.frombuffer(
-                mm,
-                dtype=np.dtype(m["dtype"]),
-                count=int(np.prod(m["shape"])) if m["shape"] else 1,
-                offset=payload_start + m["offset"],
-            ).reshape(m["shape"])
-            cols[m["name"]] = arr
-        return ColumnBatch(cols, _keepalive=mm)
+        )
+
+    def _cache_path(self, ref: ObjectRef) -> str:
+        name = ref.object_id
+        if ref.rows is not None:
+            name = f"{name}+w{ref.rows[0]}-{ref.rows[1]}"
+        return os.path.join(self.shm_dir, name)
+
+    def _map_segment(self, path: str, object_id: str) -> ColumnBatch:
+        return map_segment_file(path, object_id)
 
     def get_bytes(self, ref: ObjectRef) -> bytes:
         return self.get_columns(ref)["__bytes__"].tobytes()
 
     def _materialize_remote(self, ref: ObjectRef, path: str) -> None:
-        """Pull a foreign segment's bytes and publish them locally.
+        """Pull a foreign segment's bytes (just the ref's window) and
+        publish them locally.
 
         Concurrent readers may race here; both write a private tmp file and
         the renames are idempotent (same content), so the winner is
@@ -311,7 +468,7 @@ class ObjectStore:
         with open(tmp, "wb") as f:
             f.write(data)
         os.rename(tmp, path)
-        self._foreign.add(ref.object_id)
+        self._foreign.add(os.path.basename(path))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -319,40 +476,66 @@ class ObjectStore:
         if isinstance(refs, ObjectRef):
             refs = [refs]
         for ref in refs:
+            if self._is_foreign(ref):
+                # Drop the local window cache and release the authoritative
+                # copy (the owner's hardlink) — the physical segment dies
+                # when its last window's link is freed.
+                cache = self._cache_path(ref)
+                try:
+                    os.unlink(cache)
+                except FileNotFoundError:
+                    pass
+                self._foreign.discard(os.path.basename(cache))
+                if self.remote_free is not None:
+                    self.remote_free(ref)
+                continue
             try:
                 os.unlink(os.path.join(self.shm_dir, ref.object_id))
             except FileNotFoundError:
                 pass
-            self._foreign.discard(ref.object_id)
-            # Foreign object: also release the authoritative copy.
-            if (
-                ref.owner is not None
-                and tuple(ref.owner) != self.owner_address
-                and self.remote_free is not None
-            ):
-                self.remote_free(ref)
+
+    def drop_cache(self, refs) -> None:
+        """Release only this host's fetched copy of foreign refs — the
+        authoritative segments survive, so a task calling this remains
+        retryable (unlike :meth:`free`)."""
+        if isinstance(refs, ObjectRef):
+            refs = [refs]
+        for ref in refs:
+            if not self._is_foreign(ref):
+                continue
+            cache = self._cache_path(ref)
+            try:
+                os.unlink(cache)
+            except FileNotFoundError:
+                pass
+            self._foreign.discard(os.path.basename(cache))
 
     def exists(self, ref: ObjectRef) -> bool:
         return os.path.exists(os.path.join(self.shm_dir, ref.object_id))
 
     def store_stats(self) -> StoreStats:
         """Utilization for this session (replaces the reference's raylet
-        ``FormatGlobalMemoryInfo`` probe, ``stats.py:675-683``)."""
+        ``FormatGlobalMemoryInfo`` probe, ``stats.py:675-683``).
+
+        Hardlinked slice refs share pages; bytes are counted once per inode
+        while every ref still counts as an object."""
         stats = StoreStats()
         prefix = f"{self.session}-"
         try:
             names = os.listdir(self.shm_dir)
         except FileNotFoundError:
             return stats
+        seen_inodes = set()
         for name in names:
             if name.startswith(prefix) and not name.endswith(".tmp"):
                 try:
-                    stats.total_bytes += os.stat(
-                        os.path.join(self.shm_dir, name)
-                    ).st_size
-                    stats.num_objects += 1
+                    st = os.stat(os.path.join(self.shm_dir, name))
                 except FileNotFoundError:
-                    pass
+                    continue
+                stats.num_objects += 1
+                if st.st_ino not in seen_inodes:
+                    seen_inodes.add(st.st_ino)
+                    stats.total_bytes += st.st_size
         return stats
 
     def cleanup(self) -> None:

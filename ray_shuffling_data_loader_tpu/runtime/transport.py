@@ -4,20 +4,46 @@ Length-prefixed pickle frames over stream sockets. Addresses are tagged
 tuples so the same protocol runs over unix-domain sockets on one host and
 over TCP between TPU-VM hosts (the DCN control path) — replacing Ray's gRPC
 control plane (reference depends on Ray core for all RPC, ``setup.py:14-20``).
+
+TCP security: frames are pickles, so accepting them from arbitrary peers
+would be remote code execution. Every TCP connection therefore starts with
+a bearer-token hello (``$RSDL_CLUSTER_TOKEN``, minted by ``init_cluster``
+and carried in the ``tcp://host:port/<token>`` join address); servers drop
+non-matching peers before touching pickle. Unix sockets rely on the 0o700
+runtime directory instead, like Ray's on-host sockets.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hmac
+import os
 import pickle
 import socket
 import struct
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
+_AUTH_MAGIC = b"RSDLAUTH"
 
 # Address = ("unix", path) | ("tcp", host, port)
 Address = Tuple
+
+
+def cluster_token() -> Optional[bytes]:
+    token = os.environ.get("RSDL_CLUSTER_TOKEN")
+    return token.encode() if token else None
+
+
+def _auth_blob(token: bytes) -> bytes:
+    return _AUTH_MAGIC + token
+
+
+def send_auth(sock: socket.socket) -> None:
+    token = cluster_token()
+    if token is not None:
+        payload = _auth_blob(token)
+        sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def dumps(obj: Any) -> bytes:
@@ -41,6 +67,7 @@ class Connection:
         elif address[0] == "tcp":
             self.sock = socket.create_connection((address[1], address[2]))
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            send_auth(self.sock)
         else:
             raise ValueError(f"unknown address scheme: {address!r}")
         if timeout is not None:
@@ -90,7 +117,15 @@ async def open_connection(address: Address):
     if address[0] == "unix":
         return await asyncio.open_unix_connection(address[1])
     elif address[0] == "tcp":
-        return await asyncio.open_connection(address[1], address[2])
+        reader, writer = await asyncio.open_connection(
+            address[1], address[2]
+        )
+        token = cluster_token()
+        if token is not None:
+            payload = _auth_blob(token)
+            writer.write(_LEN.pack(len(payload)) + payload)
+            await writer.drain()
+        return reader, writer
     raise ValueError(f"unknown address scheme: {address!r}")
 
 
@@ -98,5 +133,31 @@ async def start_server(address: Address, handler):
     if address[0] == "unix":
         return await asyncio.start_unix_server(handler, path=address[1])
     elif address[0] == "tcp":
-        return await asyncio.start_server(handler, address[1], address[2])
+        token = cluster_token()
+
+        async def tcp_handler(reader, writer):
+            # Gate BEFORE any pickle touches peer bytes: first frame must
+            # be the bearer token; anything else drops the connection.
+            if token is not None:
+                try:
+                    header = await reader.readexactly(_LEN.size)
+                    (length,) = _LEN.unpack(header)
+                    if length > 4096:
+                        raise ConnectionError("oversized auth frame")
+                    blob = await reader.readexactly(length)
+                    if not hmac.compare_digest(blob, _auth_blob(token)):
+                        raise ConnectionError("bad cluster token")
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    OSError,
+                ):
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    return
+            await handler(reader, writer)
+
+        return await asyncio.start_server(tcp_handler, address[1], address[2])
     raise ValueError(f"unknown address scheme: {address!r}")

@@ -120,19 +120,30 @@ def shuffle_map(
     rng = _map_seed(seed, epoch, file_index)
     assignment = rng.integers(num_reducers, size=n)
     # Stable group-by-reducer: single-pass counting scatter per column via
-    # the C++ kernel (one-argsort-then-gather fallback otherwise).
+    # the C++ kernel (one-argsort-then-gather fallback otherwise), written
+    # DIRECTLY into one shared-memory segment; per-reducer partitions are
+    # published as hardlinked row-window refs — this stage's only full data
+    # pass (put_columns copy-out eliminated).
     from ray_shuffling_data_loader_tpu import native
 
-    grouped_cols, offsets = native.group_rows_multi(
-        batch.columns, assignment, num_reducers
+    pending = ctx.store.create_columns(
+        {k: (v.shape, v.dtype) for k, v in batch.columns.items()}
     )
-    grouped = ColumnBatch(grouped_cols)
-    refs = [
-        ctx.store.put_columns(
-            grouped.slice(int(offsets[i]), int(offsets[i + 1])).columns
+    try:
+        _, offsets = native.group_rows_multi(
+            batch.columns, assignment, num_reducers, out=pending.columns
         )
-        for i in range(num_reducers)
-    ]
+        refs = pending.publish_slices(
+            [
+                (int(offsets[i]), int(offsets[i + 1]))
+                for i in range(num_reducers)
+            ]
+        )
+    finally:
+        # Reclaims the tmpfs segment if anything above raised; no-op after
+        # a successful publish.
+        pending.abort()
+    del pending  # drop writable views before readers map the segment
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway(
@@ -162,11 +173,27 @@ def shuffle_reduce(
     total_rows = sum(p.num_rows for p in parts)
     rng = _reduce_seed(seed, epoch, reduce_index)
     perm = rng.permutation(total_rows)
-    # Fused concat+permute straight out of the mmapped partitions.
-    shuffled = ColumnBatch.concat_take(parts, perm)
-    out_ref = ctx.store.put_columns(shuffled.columns)
-    del parts, shuffled  # drop mmap views before unlinking
-    ctx.store.free(list(part_refs))
+    # Fused concat+permute straight out of the mmapped partitions INTO the
+    # output segment — this stage's only full data pass (put_columns
+    # copy-out eliminated).
+    template = parts[0] if parts else None
+    pending = ctx.store.create_columns(
+        {
+            k: ((total_rows, *template[k].shape[1:]), template[k].dtype)
+            for k in (template or {})
+        }
+    )
+    try:
+        ColumnBatch.concat_take(parts, perm, out=pending.columns)
+        out_ref = pending.seal()
+    finally:
+        pending.abort()  # reclaims the segment on failure; no-op after seal
+    del parts, pending  # drop mmap views before unlinking
+    # Input partitions are NOT freed here — the driver frees them after
+    # the result lands (shuffle_epoch), which keeps this task retryable
+    # on another host after an agent death. Only this host's DCN window
+    # caches are dropped (authoritative copies survive).
+    ctx.store.drop_cache(list(part_refs))
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
@@ -239,6 +266,12 @@ def shuffle_epoch(
             # determinism.
             for r, fut in enumerate(reduce_futs):
                 out_ref = fut.result()
+                # Free this reducer's input partitions from the driver —
+                # not inside the task — so reduce tasks stay retryable
+                # (cluster failover re-runs them against intact inputs).
+                runtime.get_context().store.free(
+                    [refs[r] for refs in per_file_refs]
+                )
                 rank = int(rank_of[r])
                 batch_consumer.consume(rank, epoch, [out_ref])
                 if stats_collector is not None:

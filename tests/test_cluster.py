@@ -23,6 +23,13 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+class Echo:
+    """Module-level so the spawned actor process can unpickle it."""
+
+    def echo(self, x):
+        return x
+
 HEAD_SCRIPT = r"""
 import json, os, sys, time
 sys.path.insert(0, {repo!r})
@@ -97,6 +104,48 @@ print(f"joined {{ctx.cluster.host_id}}", flush=True)
 cluster.serve_forever()
 runtime.shutdown()
 """
+
+
+def test_tcp_actor_requires_cluster_token(tmp_path, monkeypatch):
+    """TCP endpoints speak pickle, so unauthenticated peers must be dropped
+    before their first frame is deserialized (transport.py bearer-token
+    hello); authorized handles work normally."""
+    import pickle
+    import socket
+    import struct
+
+    from ray_shuffling_data_loader_tpu.runtime import actor as actor_mod
+
+    monkeypatch.setenv("RSDL_CLUSTER_TOKEN", "sekrit-token")
+
+    handle = actor_mod.spawn_actor(
+        Echo, runtime_dir=str(tmp_path), host="127.0.0.1"
+    )
+    try:
+        # Authorized: the handle's connection sends the token hello.
+        assert handle.call("echo", 41) == 41
+
+        # Unauthorized: raw frame without the hello -> connection dropped,
+        # no reply.
+        _, host, port = handle.address
+        sock = socket.create_connection((host, port), timeout=5)
+        try:
+            payload = pickle.dumps((1, "echo", (42,), {}, False))
+            sock.sendall(struct.pack("<Q", len(payload)) + payload)
+            sock.settimeout(5)
+            assert sock.recv(1) == b""  # server closed without answering
+        finally:
+            sock.close()
+
+        # Wrong token: also dropped.
+        monkeypatch.setenv("RSDL_CLUSTER_TOKEN", "wrong")
+        from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
+
+        intruder = ActorHandle(handle.address)
+        assert not intruder.ping(timeout=5)
+        monkeypatch.setenv("RSDL_CLUSTER_TOKEN", "sekrit-token")
+    finally:
+        handle.terminate()
 
 
 def test_two_host_cluster_shuffle(tmp_path):
