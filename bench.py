@@ -104,6 +104,10 @@ def main() -> None:
     model = TabularDLRM(
         vocab_sizes={c: DATA_SPEC[c][1] for c in feature_columns},
         embed_dim=EMBED_DIM,
+        # Explicit reference interaction: bench must run on any TPU
+        # plugin; the Pallas kernel is opt-in until validated on the
+        # target runtime (interaction is <1% of bench wall-clock).
+        use_pallas_interaction=False,
     )
     optimizer = optax.adam(1e-3)
 

@@ -1,0 +1,125 @@
+"""Multi-process data-parallel trainers on one host (late-joiner path).
+
+The reference's Horovod example runs one Torch process per GPU, with rank 0
+creating the named queue and workers connecting by name with retry
+(``ray_torch_shuffle.py:143-163``, ``dataset.py:75-84``). This example runs
+the same topology on this runtime: N trainer processes, each consuming its
+disjoint shard of every epoch's shuffled batches through the shared queue.
+
+On a TPU pod the analog is one process per TPU-VM host under
+``jax.distributed`` with ``JaxShufflingDataset`` assembling pod-global
+arrays; here ranks consume host batches so the example runs anywhere:
+
+    python examples/train_dlrm_multirank.py --num-trainers 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num-trainers", type=int, default=2)
+    p.add_argument("--num-rows", type=int, default=200_000)
+    p.add_argument("--num-files", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=10_000)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--num-reducers", type=int, default=8)
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--data-dir", type=str, default="example_data_multirank")
+    # internal: set for spawned rank processes
+    p.add_argument("--rank", type=int, default=None, help=argparse.SUPPRESS)
+    return p.parse_args(argv)
+
+
+def run_rank(args) -> int:
+    """One trainer rank: rank 0 owns the queue + shuffle; others join the
+    session (``$RSDL_RUNTIME_DIR``) and connect by queue name with retry."""
+    from ray_shuffling_data_loader_tpu import ShufflingDataset, runtime
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        cached_generate_data,
+    )
+
+    runtime.init()
+    # Same spec as the driver -> cache hit, same filename list.
+    filenames, _ = cached_generate_data(
+        args.num_rows, args.num_files, 2, args.data_dir, seed=args.seed
+    )
+    ds = ShufflingDataset(
+        filenames,
+        num_epochs=args.epochs,
+        num_trainers=args.num_trainers,
+        batch_size=args.batch_size,
+        rank=args.rank,
+        num_reducers=args.num_reducers,
+        seed=args.seed,
+    )
+    total_rows = 0
+    for epoch in range(args.epochs):
+        ds.set_epoch(epoch)
+        t0 = time.perf_counter()
+        rows = sum(b.num_rows for b in ds)
+        total_rows += rows
+        print(
+            f"[rank {args.rank}] epoch {epoch}: {rows} rows in "
+            f"{time.perf_counter() - t0:.2f}s",
+            flush=True,
+        )
+    print(f"[rank {args.rank}] total {total_rows} rows", flush=True)
+    if args.rank == 0:
+        runtime.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.rank is not None:
+        return run_rank(args)
+
+    # Driver: generate data, create the session, then launch one process
+    # per rank. Rank 0 must start first (it owns the queue); later ranks
+    # join via the exported runtime dir — the late-joiner retry handles
+    # any startup skew.
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        cached_generate_data,
+    )
+
+    ctx = runtime.init()
+    os.makedirs(args.data_dir, exist_ok=True)
+    cached_generate_data(
+        args.num_rows, args.num_files, 2, args.data_dir, seed=args.seed
+    )
+
+    env = dict(os.environ, RSDL_RUNTIME_DIR=ctx.runtime_dir)
+    procs = []
+    for rank in range(args.num_trainers):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)]
+                + [a for a in sys.argv[1:]]
+                + ["--rank", str(rank)],
+                env=env,
+            )
+        )
+        if rank == 0:
+            time.sleep(0.5)  # queue actor up before late joiners connect
+    codes = [p.wait() for p in procs]
+    per_rank_expected = args.num_rows * args.epochs
+    print(
+        f"all ranks done, exit codes {codes}; "
+        f"{per_rank_expected} rows/epoch split across "
+        f"{args.num_trainers} ranks per epoch"
+    )
+    return max(codes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -259,3 +259,32 @@ class BatchConsumerQueue(BatchConsumer):
 
     def wait_until_all_epochs_done(self):
         self._batch_queue.wait_until_all_epochs_done()
+
+
+if __name__ == "__main__":
+    # Smoke run (reference dataset.py:208-252 runs the same shape in CI):
+    # generate a small dataset, iterate every epoch, assert exactly-once.
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+    num_rows, num_files, num_epochs, batch_size = 10**5, 10, 4, 20_000
+    runtime.init()
+    filenames, _ = generate_data(
+        num_rows, num_files, 2, 0.0, "smoke_data"
+    )
+    ds = ShufflingDataset(
+        filenames,
+        num_epochs=num_epochs,
+        num_trainers=1,
+        batch_size=batch_size,
+        rank=0,
+        num_reducers=8,
+    )
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        keys = [k for b in ds for k in b["key"].tolist()]
+        assert sorted(keys) == list(range(num_rows)), len(keys)
+        print(f"epoch {epoch}: {num_rows} rows exactly once")
+    runtime.shutdown()
+    print("smoke OK")

@@ -265,18 +265,31 @@ class PendingColumns:
         """
         assert not self._published, "already published"
         refs: List[ObjectRef] = []
-        for start, stop in windows:
-            link_id = self._store._new_object_id()
-            os.link(self._tmp, os.path.join(self._store.shm_dir, link_id))
-            refs.append(
-                ObjectRef(
-                    object_id=link_id,
-                    nbytes=self.nbytes,
-                    session=self._store.session,
-                    owner=self._store.owner_address,
-                    rows=(int(start), int(stop)),
+        try:
+            for start, stop in windows:
+                link_id = self._store._new_object_id()
+                os.link(self._tmp, os.path.join(self._store.shm_dir, link_id))
+                refs.append(
+                    ObjectRef(
+                        object_id=link_id,
+                        nbytes=self.nbytes,
+                        session=self._store.session,
+                        owner=self._store.owner_address,
+                        rows=(int(start), int(stop)),
+                    )
                 )
-            )
+        except BaseException:
+            # Partial failure (e.g. ENOSPC mid-loop): reclaim the links
+            # already created — no ref for them ever reaches a caller, and
+            # each pins the whole segment.
+            for ref in refs:
+                try:
+                    os.unlink(
+                        os.path.join(self._store.shm_dir, ref.object_id)
+                    )
+                except FileNotFoundError:
+                    pass
+            raise
         os.unlink(self._tmp)
         self._published = True
         return refs

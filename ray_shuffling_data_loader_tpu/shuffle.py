@@ -261,17 +261,40 @@ def shuffle_epoch(
                 )
                 for r in range(num_reducers)
             ]
+            # Free each reducer's input partitions from the driver — not
+            # inside the task (keeps reduce retryable for cluster
+            # failover) — and in COMPLETION order on a side thread, not
+            # delivery order: the delivery loop below can block on
+            # consumer backpressure while later reducers finished long
+            # ago, and holding their inputs would double peak /dev/shm.
+            def free_inputs():
+                store = runtime.get_context().store
+                index_of = {id(f): r for r, f in enumerate(reduce_futs)}
+                remaining = list(reduce_futs)
+                while remaining:
+                    finished, remaining = wait(remaining, num_returns=1)
+                    for f in finished:
+                        try:
+                            store.free(
+                                [
+                                    refs[index_of[id(f)]]
+                                    for refs in per_file_refs
+                                ]
+                            )
+                        except Exception:
+                            pass
+
+            threading.Thread(
+                target=free_inputs,
+                name=f"free-inputs-e{epoch}",
+                daemon=True,
+            ).start()
+
             # Stream each reducer's output to its rank as soon as it
             # completes, preserving reducer order within a rank for
             # determinism.
             for r, fut in enumerate(reduce_futs):
                 out_ref = fut.result()
-                # Free this reducer's input partitions from the driver —
-                # not inside the task — so reduce tasks stay retryable
-                # (cluster failover re-runs them against intact inputs).
-                runtime.get_context().store.free(
-                    [refs[r] for refs in per_file_refs]
-                )
                 rank = int(rank_of[r])
                 batch_consumer.consume(rank, epoch, [out_ref])
                 if stats_collector is not None:
@@ -320,6 +343,9 @@ def shuffle(
     fully-consumed epochs when resuming from a checkpoint (epoch indices
     stay absolute so per-epoch permutations match the original run).
     """
+    if not filenames:
+        # A typo'd glob would otherwise "shuffle" zero rows successfully.
+        raise ValueError("no input files to shuffle")
     runtime.ensure_initialized()
     start = timeit.default_timer()
     threads = []

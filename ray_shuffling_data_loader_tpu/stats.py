@@ -410,16 +410,42 @@ class ObjectStoreStatsCollector:
 # ---------------------------------------------------------------------------
 
 
+def _is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _write_rows(f, rows: List[Dict], write_header: bool) -> None:
+    writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+    if write_header:
+        writer.writeheader()
+    writer.writerows(rows)
+
+
 def _write_csv(path: str, rows: List[Dict], overwrite: bool) -> None:
     if not rows:
         return
+    if _is_remote(path):
+        # Remote artifact store (s3://, gs://, ...) via fsspec — parity
+        # with the reference's s3 stats upload (``stats.py:316-334``).
+        # Object stores have no append: emulate it by read-modify-write
+        # (stats files are small; one rewrite per trial is fine).
+        import fsspec
+
+        fs, _ = fsspec.core.url_to_fs(path)
+        exists = fs.exists(path)
+        if overwrite or not exists:
+            with fsspec.open(path, "w", newline="") as f:
+                _write_rows(f, rows, write_header=True)
+        else:
+            with fsspec.open(path, "r", newline="") as f:
+                existing = f.read()
+            with fsspec.open(path, "w", newline="") as f:
+                f.write(existing)
+                _write_rows(f, rows, write_header=False)
+        return
     write_header = overwrite or not os.path.exists(path)
-    mode = "w" if overwrite else "a"
-    with open(path, mode, newline="") as f:
-        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-        if write_header:
-            writer.writeheader()
-        writer.writerows(rows)
+    with open(path, "w" if overwrite else "a", newline="") as f:
+        _write_rows(f, rows, write_header)
 
 
 def process_stats(
@@ -437,7 +463,8 @@ def process_stats(
     (``stats.py:287-625``); here local filesystem (or any mounted path).
     Returns the cross-trial summary (mean/std duration + throughputs).
     """
-    os.makedirs(stats_dir, exist_ok=True)
+    if not _is_remote(stats_dir):
+        os.makedirs(stats_dir, exist_ok=True)
     trial_rows = [t.row() for t in all_trial_stats]
     epoch_rows = [
         e.row(t.trial) for t in all_trial_stats for e in t.epochs

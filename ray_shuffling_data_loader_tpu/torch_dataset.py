@@ -229,3 +229,40 @@ def convert_to_tensor(
     else:
         label_tensor = label_tensor.view(-1, 1)
     return feature_tensor, label_tensor
+
+
+if __name__ == "__main__":
+    # Smoke run (reference torch_dataset.py:239-309 runs the same shape in
+    # CI): shuffled DataFrame batches -> (feature tensors, label tensor).
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        DATA_SPEC,
+        LABEL_COLUMN,
+        generate_data,
+    )
+
+    num_rows, batch_size, num_epochs = 10**5, 20_000, 2
+    runtime.init()
+    filenames, _ = generate_data(num_rows, 10, 2, 0.0, "smoke_data")
+    feature_columns = [c for c in DATA_SPEC if c != LABEL_COLUMN]
+    ds = TorchShufflingDataset(
+        filenames,
+        num_epochs=num_epochs,
+        num_trainers=1,
+        batch_size=batch_size,
+        rank=0,
+        feature_columns=feature_columns,
+        label_column=LABEL_COLUMN,
+        num_reducers=8,
+    )
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        rows = 0
+        for features, label in ds:
+            assert len(features) == len(feature_columns)
+            assert features[0].shape == (len(label), 1)
+            rows += len(label)
+        assert rows == num_rows, rows
+        print(f"epoch {epoch}: {rows} rows -> tensors")
+    runtime.shutdown()
+    print("smoke OK")

@@ -6,4 +6,8 @@ set -euo pipefail
 cd "$(dirname "$0")"
 export JAX_PLATFORMS=cpu
 python examples/train_dlrm.py --smoke
+python examples/train_dlrm_multirank.py --num-trainers 2 \
+    --num-rows 50000 --num-files 4 --batch-size 5000 --epochs 2
+python -m ray_shuffling_data_loader_tpu.dataset
+python -m ray_shuffling_data_loader_tpu.torch_dataset
 python __graft_entry__.py 8

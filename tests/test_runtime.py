@@ -17,6 +17,90 @@ from ray_shuffling_data_loader_tpu.runtime.tasks import TaskError, wait
 # -- object store -----------------------------------------------------------
 
 
+def test_create_columns_direct_write(local_runtime):
+    """The zero-copy write path: fill mmapped views, seal, read back."""
+    store = runtime.get_context().store
+    pending = store.create_columns(
+        {"a": ((10,), np.int64), "b": ((10, 3), np.float32)}
+    )
+    pending.columns["a"][:] = np.arange(10)
+    pending.columns["b"][:] = np.ones((10, 3), np.float32)
+    ref = pending.seal()
+    got = store.get_columns(ref)
+    np.testing.assert_array_equal(got["a"], np.arange(10))
+    np.testing.assert_array_equal(got["b"], np.ones((10, 3), np.float32))
+    del got
+    store.free(ref)
+    assert store.store_stats().num_objects == 0
+
+
+def test_publish_slices_hardlink_refcount(local_runtime):
+    """Window refs share one physical segment; pages survive until the
+    LAST window is freed (filesystem-refcount semantics)."""
+    store = runtime.get_context().store
+    pending = store.create_columns({"x": ((9,), np.int64)})
+    pending.columns["x"][:] = np.arange(9)
+    refs = pending.publish_slices([(0, 3), (3, 6), (6, 9)])
+    assert [r.rows for r in refs] == [(0, 3), (3, 6), (6, 9)]
+    # Bytes counted once despite three links.
+    stats = store.store_stats()
+    assert stats.num_objects == 3
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            store.get_columns(ref)["x"], np.arange(3 * i, 3 * i + 3)
+        )
+    # Free two; the third still reads.
+    store.free(refs[:2])
+    np.testing.assert_array_equal(
+        store.get_columns(refs[2])["x"], np.arange(6, 9)
+    )
+    store.free(refs[2])
+    assert store.store_stats().num_objects == 0
+
+
+def test_pending_abort_reclaims(local_runtime, tmp_path):
+    store = runtime.get_context().store
+    pending = store.create_columns({"x": ((4,), np.int64)})
+    pending.abort()
+    assert store.store_stats().num_objects == 0
+    pending.abort()  # idempotent
+
+
+def test_serialize_columns_roundtrip(local_runtime, tmp_path):
+    """Wire format == disk format (shared layout planner): bytes written
+    to a file map back identically — the DCN windowed-fetch path."""
+    from ray_shuffling_data_loader_tpu.runtime.store import (
+        map_segment_file,
+        serialize_columns,
+    )
+
+    cols = {
+        "a": np.arange(7, dtype=np.int32),
+        "b": np.linspace(0, 1, 7).astype(np.float64),
+    }
+    blob = serialize_columns(cols)
+    path = tmp_path / "seg"
+    path.write_bytes(blob)
+    got = map_segment_file(str(path))
+    np.testing.assert_array_equal(got["a"], cols["a"])
+    np.testing.assert_array_equal(got["b"], cols["b"])
+
+
+def test_out_mismatch_raises(local_runtime):
+    """Strict out= contract: a destination that can't hold the result is a
+    loud error, never a silent fallback (would publish zeros)."""
+    from ray_shuffling_data_loader_tpu import native
+
+    arr = np.arange(10, dtype=np.int64)
+    bad_out = np.empty(5, dtype=np.int64)
+    with pytest.raises(ValueError, match="out="):
+        native.take(arr, np.arange(10), out=bad_out)
+    with pytest.raises(ValueError, match="out="):
+        native.take_multi(
+            [arr, arr], np.arange(20), out=np.empty(20, np.int32)
+        )
+
+
 def test_store_roundtrip(local_runtime):
     store = local_runtime.store
     cols = {
