@@ -27,7 +27,7 @@ NUM_ROWS = 1_000_000
 NUM_FILES = 8
 ROW_GROUPS_PER_FILE = 2
 BATCH_SIZE = 65_536
-NUM_EPOCHS = 2
+NUM_EPOCHS = 4
 NUM_REDUCERS = 4
 EMBED_DIM = 32
 SEED = 0
@@ -143,6 +143,12 @@ def main() -> None:
         queue_name="bench-queue",
     )
 
+    # Optional trace (SURVEY §5 tracing): RSDL_PROFILE_DIR=/tmp/trace
+    # wraps the measured region in a jax.profiler trace for xprof.
+    profile_dir = os.environ.get("RSDL_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+
     t_start = time.perf_counter()
     step_time = 0.0
     num_steps = 0
@@ -156,6 +162,8 @@ def main() -> None:
             num_steps += 1
     total_s = time.perf_counter() - t_start
     jax.block_until_ready(state.params)
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     stats = ds.stats.as_dict()
     staged_gb = stats["bytes_staged"] / 1e9
@@ -177,6 +185,9 @@ def main() -> None:
         "total_s": round(total_s, 2),
         "loss": round(float(metrics["loss"]), 4),
         "num_chips": num_chips,
+        "peak_hbm_gb": round(
+            stats.get("peak_device_bytes_in_use", 0) / 1e9, 3
+        ),
     }
     print(json.dumps(result))
 

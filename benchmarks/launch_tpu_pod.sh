@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Launch the shuffle benchmark across a TPU pod slice — the analog of the
+# reference's Ray-autoscaler cluster.yaml + `ray exec` flow
+# (reference benchmarks/cluster.yaml, benchmarks/benchmark_batch.sh).
+#
+# Topology: host 0 of the slice is the cluster head; every other TPU-VM
+# host joins over the pod's internal network (the DCN control path).
+# Input Parquet must be on storage all hosts can read (GCS via gcsfuse,
+# or a shared NFS mount).
+#
+# Usage (from your workstation, gcloud configured):
+#   TPU_NAME=my-v5e-16 ZONE=us-west4-a ./benchmarks/launch_tpu_pod.sh \
+#       --num-rows 400000000 --num-files 100 --num-trainers 16 \
+#       --num-reducers 48 --num-epochs 10
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME}
+ZONE=${ZONE:?set ZONE}
+REPO_DIR=${REPO_DIR:-"\$HOME/ray_shuffling_data_loader_tpu"}
+HEAD_PORT=${HEAD_PORT:-43211}
+
+run_on() {  # run_on <worker-index|all> <command>
+    gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" \
+        --worker="$1" --command="$2"
+}
+
+# Head host (worker 0) starts the cluster and prints the join address
+# (tcp://ip:port/token — the token gates the pickle RPC plane).
+ADDRESS=$(run_on 0 "cd $REPO_DIR && python - <<'PY'
+from ray_shuffling_data_loader_tpu import runtime
+ctx = runtime.init_cluster(listen_port=$HEAD_PORT)
+print(ctx.cluster.address, flush=True)
+import time
+time.sleep(86400)  # keep the head alive; benchmark attaches via env
+PY" | tail -1)
+echo "head up at $ADDRESS"
+
+# Every other host joins as a worker.
+NUM_WORKERS=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" --zone "$ZONE" \
+    --format="value(networkEndpoints.len())")
+for w in $(seq 1 $((NUM_WORKERS - 1))); do
+    run_on "$w" "cd $REPO_DIR && nohup python -m \
+        ray_shuffling_data_loader_tpu.runtime.cluster join $ADDRESS \
+        > join.log 2>&1 &" &
+done
+wait
+echo "all $NUM_WORKERS hosts joined"
+
+# Benchmark runs on the head, scattering shuffle stages across the pod.
+run_on 0 "cd $REPO_DIR && python benchmarks/benchmark.py --address $ADDRESS $*"

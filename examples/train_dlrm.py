@@ -122,11 +122,11 @@ def main(argv=None) -> int:
 
     import jax
 
-    # Some TPU plugins override JAX_PLATFORMS from the environment; the
-    # config API takes precedence, so re-assert the user's choice (the CPU
-    # smoke invocation in the module docstring depends on this).
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from ray_shuffling_data_loader_tpu.utils import force_platform_from_env
+
+    # Honor the user's platform choice even under TPU plugins that
+    # override JAX_PLATFORMS (the CPU smoke invocation depends on this).
+    force_platform_from_env()
 
     import jax.numpy as jnp
     import numpy as np

@@ -87,8 +87,10 @@ class JaxBatchSpec:
 
 class HostToDeviceStats:
     """Staging instrumentation: bytes staged, wall time in ``device_put``
-    dispatch, and consumer stall time (time the training loop waited on the
-    ring). The reference measures the trainer-side analog as batch wait time
+    dispatch, consumer stall time (time the training loop waited on the
+    ring), and peak device-memory use while staging (the HBM-occupancy
+    analog of the reference's object-store sampling, ``stats.py:686-699``).
+    The reference measures the trainer-side analog as batch wait time
     (``ray_torch_shuffle.py:201-230``)."""
 
     def __init__(self):
@@ -98,6 +100,19 @@ class HostToDeviceStats:
         self.stall_s = 0.0
         self.stalls = 0
         self.first_batch_s: Optional[float] = None
+        self.peak_device_bytes_in_use = 0
+
+    def sample_device_memory(self) -> None:
+        """Record current HBM occupancy if the backend exposes it (TPU
+        does via ``memory_stats``; CPU returns nothing)."""
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = int(stats.get("bytes_in_use", 0))
+        except Exception:
+            return
+        self.peak_device_bytes_in_use = max(
+            self.peak_device_bytes_in_use, in_use
+        )
 
     def as_dict(self) -> Dict[str, float]:
         return {
@@ -107,6 +122,7 @@ class HostToDeviceStats:
             "stall_s": self.stall_s,
             "stalls": self.stalls,
             "first_batch_s": self.first_batch_s or 0.0,
+            "peak_device_bytes_in_use": self.peak_device_bytes_in_use,
         }
 
 
@@ -215,6 +231,8 @@ class JaxShufflingDataset:
         self.stats.put_dispatch_s += time.perf_counter() - t0
         self.stats.bytes_staged += nbytes
         self.stats.batches_staged += 1
+        if self.stats.batches_staged % 8 == 0:
+            self.stats.sample_device_memory()
         return features, label_arr
 
     def _put(self, arr: np.ndarray):

@@ -163,9 +163,10 @@ def _actor_main(
     # Child process entrypoint (spawned: fresh interpreter, no inherited
     # TPU/JAX state).
     if watch_parent is not None:
-        # Non-daemon actors (those that must spawn their own children, e.g.
-        # the cluster HostAgent's worker pool) don't die with their parent
-        # automatically; poll the parent pid and exit when orphaned.
+        # Daemonic children die with a cleanly-exiting parent but NOT with
+        # a SIGKILLed one (preemption), and non-daemon actors (those that
+        # spawn their own children, e.g. the HostAgent's worker pool)
+        # never do; poll the parent pid and exit when orphaned.
         def _watch():
             while True:
                 time.sleep(1.0)
@@ -453,7 +454,7 @@ def spawn_actor(
         target=_actor_main,
         args=(
             cls, args, kwargs, address, registry_path, ready_q,
-            None if daemon else os.getpid(),
+            os.getpid(),
         ),
         daemon=daemon,
     )

@@ -1,1 +1,9 @@
-"""utils subpackage."""
+"""Shared utilities: platform pinning, wall-clock timing."""
+
+from ray_shuffling_data_loader_tpu.utils.platform import (  # noqa: F401
+    force_platform_from_env,
+    pin_platform,
+)
+from ray_shuffling_data_loader_tpu.utils.timing import timer  # noqa: F401
+
+__all__ = ["force_platform_from_env", "pin_platform", "timer"]

@@ -1,0 +1,31 @@
+"""JAX platform pinning.
+
+Some TPU plugins override ``JAX_PLATFORMS`` from the environment during
+their registration; the config API takes precedence, so code that must
+honor the user's platform choice (CPU smoke runs, virtual-device sharding
+validation) re-asserts it through the config. Used by the examples, the
+test conftest, and the driver entry points.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pin_platform(name: str) -> None:
+    """Force JAX onto ``name`` (e.g. ``"cpu"``), overriding any plugin's
+    default. Must run before the first computation; safe after ``import
+    jax`` (backends initialize lazily)."""
+    import jax
+
+    jax.config.update("jax_platforms", name)
+
+
+def force_platform_from_env(var: str = "JAX_PLATFORMS") -> Optional[str]:
+    """Re-assert ``$JAX_PLATFORMS`` via the config API; returns the pinned
+    name (or None if the variable is unset)."""
+    name = os.environ.get(var)
+    if name:
+        pin_platform(name)
+    return name

@@ -1,0 +1,21 @@
+"""Wall-clock timing helper shared by the stats hooks and benchmarks."""
+
+from __future__ import annotations
+
+import timeit
+from contextlib import contextmanager
+
+
+@contextmanager
+def timer():
+    """``with timer() as t: ...; t()`` -> elapsed seconds (callable stays
+    live after the block; matches the reference's ``timeit.default_timer``
+    deltas, reference ``shuffle.py:149-167``)."""
+    start = timeit.default_timer()
+    end = None
+
+    def elapsed() -> float:
+        return (end if end is not None else timeit.default_timer()) - start
+
+    yield elapsed
+    end = timeit.default_timer()

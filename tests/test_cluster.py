@@ -148,6 +148,108 @@ def test_tcp_actor_requires_cluster_token(tmp_path, monkeypatch):
         handle.terminate()
 
 
+FAILOVER_HEAD_SCRIPT = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime, ShufflingDataset
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+ctx = runtime.init_cluster(advertise_host="127.0.0.1", num_workers=2)
+with open({addr_file!r} + ".tmp", "w") as f:
+    f.write(ctx.cluster.address)
+os.rename({addr_file!r} + ".tmp", {addr_file!r})
+
+deadline = time.time() + 60
+while len(ctx.cluster.registry.call("hosts")) < 2:
+    if time.time() > deadline:
+        print("VERDICT: FAIL worker never joined", flush=True)
+        sys.exit(1)
+    time.sleep(0.2)
+# Signal the test to SIGKILL the worker, then wait for it to be gone.
+open({joined_file!r}, "w").close()
+while os.path.exists({joined_file!r}):
+    time.sleep(0.1)
+
+filenames, _ = generate_data(
+    num_rows=1500, num_files=3, num_row_groups_per_file=1,
+    max_row_group_skew=0.0, data_dir={data_dir!r},
+)
+# The membership table still lists the dead host; the scheduler must hit
+# it, drop it, evict it, and reroute every task onto this host.
+ds = ShufflingDataset(
+    filenames, num_epochs=1, num_trainers=1, batch_size=250, rank=0,
+    num_reducers=3, seed=13, queue_name="q-failover",
+)
+ds.set_epoch(0)
+keys = sorted(k for b in ds for k in b["key"].tolist())
+ok = keys == list(range(1500))
+if not ok:
+    print("VERDICT: FAIL keys wrong after failover", flush=True)
+hosts = ctx.cluster.registry.call("hosts")
+if len(hosts) != 1:
+    ok = False
+    print(f"VERDICT: FAIL dead host not evicted: {{list(hosts)}}", flush=True)
+print("VERDICT: " + ("PASS" if ok else "FAIL"), flush=True)
+runtime.shutdown()
+"""
+
+
+def test_dead_host_failover(tmp_path):
+    """A worker host that joined and then died (SIGKILL — no unregister)
+    must not break the run: the scheduler drops the dead agent, evicts the
+    host from membership, and reroutes its tasks (SURVEY §5: the reference
+    has essentially no failure handling; this is new capability)."""
+    addr_file = str(tmp_path / "head_address")
+    joined_file = str(tmp_path / "worker_joined")
+    data_dir = str(tmp_path / "data")
+    env = dict(
+        os.environ, RSDL_ADVERTISE_HOST="127.0.0.1", JAX_PLATFORMS="cpu"
+    )
+    head_log = tmp_path / "head.log"
+    worker_log = tmp_path / "worker.log"
+    with open(head_log, "w") as hf, open(worker_log, "w") as wf:
+        head = subprocess.Popen(
+            [sys.executable, "-c", FAILOVER_HEAD_SCRIPT.format(
+                repo=_REPO,
+                addr_file=addr_file,
+                joined_file=joined_file,
+                data_dir=data_dir,
+            )],
+            stdout=hf,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        worker = subprocess.Popen(
+            [sys.executable, "-c", WORKER_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file
+            )],
+            stdout=wf,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        try:
+            deadline = time.time() + 120
+            while not os.path.exists(joined_file):
+                assert time.time() < deadline, "worker never joined"
+                assert head.poll() is None, "head died early"
+                time.sleep(0.2)
+            worker.kill()
+            worker.wait()
+            os.unlink(joined_file)
+            head.wait(timeout=180)
+        finally:
+            head.kill()
+            worker.kill()
+            head.wait()
+            worker.wait()
+
+    head_out = head_log.read_text()
+    assert "VERDICT: PASS" in head_out, (
+        f"head output:\n{head_out}\n--- worker output:\n"
+        f"{worker_log.read_text()}"
+    )
+
+
 def test_two_host_cluster_shuffle(tmp_path):
     addr_file = str(tmp_path / "head_address")
     data_dir = str(tmp_path / "data")
