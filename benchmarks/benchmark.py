@@ -144,6 +144,8 @@ def run_trial(
             args.batch_size,
             args.num_trainers,
             trial,
+            args.num_row_groups_per_file,
+            args.max_concurrent_epochs,
             name=f"stats-trial-{trial}",
         )
         collector.wait_ready()

@@ -104,13 +104,36 @@ class _QueueActor:
             raise Full from None
 
     async def put_batch(self, rank, epoch, items, timeout=None):
-        for item in items:
-            try:
-                await asyncio.wait_for(
-                    self.queues[epoch][rank].put(item), timeout
-                )
-            except asyncio.TimeoutError:
-                raise Full from None
+        # All-or-nothing: wait until the queue has room for EVERY item,
+        # then enqueue atomically (single-threaded event loop, no awaits
+        # between puts). A timeout therefore leaves the queue untouched —
+        # the reference's sequential awaited puts can time out half-way
+        # with no way to tell the caller what landed
+        # (reference ``batch_queue.py:480-488`` is all-or-nothing only for
+        # the nowait variant).
+        queue = self.queues[epoch][rank]
+        items = list(items)
+        if self.maxsize > 0 and len(items) > self.maxsize:
+            raise Full(
+                f"Cannot ever add {len(items)} items to a queue with "
+                f"maxsize {self.maxsize}."
+            )
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        while True:
+            # Room check and enqueue in ONE synchronous block — no await
+            # between them, so a concurrent producer scheduled in the gap
+            # cannot steal the room and force a partial enqueue.
+            if not (
+                self.maxsize > 0
+                and queue.qsize() + len(items) > self.maxsize
+            ):
+                for item in items:
+                    queue.put_nowait(item)
+                return
+            if deadline is not None and loop.time() >= deadline:
+                raise Full
+            await asyncio.sleep(0.005)
 
     async def get(self, rank, epoch, timeout=None):
         try:

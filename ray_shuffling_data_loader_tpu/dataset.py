@@ -189,6 +189,10 @@ class ShufflingDataset:
                 is_done = True
                 pending.pop()
             num_outstanding = len(pending)
+            # Pull every foreign ref's bytes over DCN in parallel while the
+            # first is being consumed (the ``ray.wait(fetch_local=True)``
+            # analog, reference ``dataset.py:132-137``); local refs no-op.
+            store.prefetch(pending)
 
             for ref in pending:
                 cb = store.get_columns(ref)

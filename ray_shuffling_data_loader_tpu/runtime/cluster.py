@@ -157,25 +157,45 @@ class StoreServer:
     """
 
     def __init__(self, shm_dir: str):
+        from .store import _default_spill_dir
+
         self.shm_dir = shm_dir
+        self.spill_dir = _default_spill_dir()
+        self.served_count = 0
+        self.served_bytes = 0
 
     def _path(self, object_id: str) -> str:
         # object_ids are token_hex-based; reject anything path-like.
         if "/" in object_id or object_id.startswith("."):
             raise ValueError(f"bad object id {object_id!r}")
-        return os.path.join(self.shm_dir, object_id)
+        path = os.path.join(self.shm_dir, object_id)
+        if not os.path.exists(path):
+            # Segments over the capacity budget live in the spill dir.
+            spath = os.path.join(self.spill_dir, object_id)
+            if os.path.exists(spath):
+                return spath
+        return path
 
     def fetch(self, object_id: str, rows=None) -> bytes:
         path = self._path(object_id)
         if rows is None:
             with open(path, "rb") as f:
-                return f.read()
-        from .store import map_segment_file, serialize_columns
+                data = f.read()
+        else:
+            from .store import map_segment_file, serialize_columns
 
-        batch = map_segment_file(path, object_id).slice(
-            int(rows[0]), int(rows[1])
-        )
-        return serialize_columns(batch.columns)
+            batch = map_segment_file(path, object_id).slice(
+                int(rows[0]), int(rows[1])
+            )
+            data = serialize_columns(batch.columns)
+        self.served_count += 1
+        self.served_bytes += len(data)
+        return data
+
+    def fetch_stats(self) -> Dict[str, int]:
+        """Cross-host traffic served by this host (the locality test's
+        measurement; the reference's analog is plasma transfer metrics)."""
+        return {"count": self.served_count, "bytes": self.served_bytes}
 
     def free(self, object_id: str) -> None:
         try:
@@ -261,12 +281,42 @@ class ClusterTaskFuture:
 
     def __init__(self, inner: concurrent.futures.Future):
         self._inner = inner
+        self._waiters_lock = threading.Lock()
+        self._waiters: set = set()
+        self._callback_added = False
 
     def done(self) -> bool:
         return self._inner.done()
 
     def result(self, timeout: Optional[float] = None):
         return self._inner.result(timeout)
+
+    # One permanent done-callback consulting a removable waiter set:
+    # concurrent futures never drop registered callbacks, so registering
+    # one per wait() call would leak O(waits) closures on slow futures
+    # (shuffle's free-inputs loop waits num_reducers times per epoch).
+    def _add_waiter(self, event: threading.Event) -> None:
+        register = False
+        with self._waiters_lock:
+            self._waiters.add(event)
+            if not self._callback_added:
+                self._callback_added = True
+                register = True
+        if register:
+            # OUTSIDE the lock: on an already-done future the callback
+            # fires synchronously in this thread (concurrent.futures
+            # contract) and _notify_waiters needs the lock.
+            self._inner.add_done_callback(self._notify_waiters)
+
+    def _notify_waiters(self, _f) -> None:
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, set()
+        for event in waiters:
+            event.set()
+
+    def _remove_waiter(self, event: threading.Event) -> None:
+        with self._waiters_lock:
+            self._waiters.discard(event)
 
 
 class ClusterScheduler:
@@ -282,10 +332,21 @@ class ClusterScheduler:
     membership table.
     """
 
-    def __init__(self, agents: List[ActorHandle], max_inflight: int = 64):
+    def __init__(
+        self,
+        agents: List[ActorHandle],
+        store_to_agent: Optional[Dict[Tuple, ActorHandle]] = None,
+        max_inflight: int = 64,
+    ):
         if not agents:
             raise ValueError("no host agents registered")
         self._agents = list(agents)
+        # store-server address -> that host's agent; lets locality hints
+        # (ObjectRef.owner carries the store address) pick the host that
+        # already holds a task's inputs.
+        self._store_to_agent = {
+            tuple(k): v for k, v in (store_to_agent or {}).items()
+        }
         self._idx = 0
         self._lock = threading.Lock()
         self.on_agent_dead = None  # Callable[[ActorHandle], None]
@@ -332,6 +393,57 @@ class ClusterScheduler:
 
     def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
         inner = self._executor.submit(self._run, fn, args, kwargs)
+        return ClusterTaskFuture(inner)
+
+    def _locality_agent(self, refs) -> Optional[ActorHandle]:
+        """The agent on the host owning the most input rows/bytes, or None
+        when no preference exists (no owners, owner not in the cluster, or
+        locality disabled via ``RSDL_DISABLE_LOCALITY``)."""
+        if os.environ.get("RSDL_DISABLE_LOCALITY"):
+            return None
+        weights: Dict[Tuple, int] = {}
+        for ref in refs:
+            owner = getattr(ref, "owner", None)
+            if owner is None:
+                continue
+            rows = getattr(ref, "rows", None)
+            # Window refs weigh by row span (uniform row width across one
+            # reduce's inputs); whole-segment refs by size.
+            w = (
+                int(rows[1]) - int(rows[0])
+                if rows is not None
+                else max(1, int(getattr(ref, "nbytes", 1)))
+            )
+            key = tuple(owner)
+            weights[key] = weights.get(key, 0) + w
+        if not weights:
+            return None
+        best = max(weights, key=weights.get)
+        agent = self._store_to_agent.get(best)
+        if agent is None:
+            return None
+        with self._lock:
+            live = {a.address for a in self._agents}
+        return agent if agent.address in live else None
+
+    def _run_preferring(self, preferred, fn, args, kwargs):
+        if preferred is not None:
+            try:
+                return preferred.call("submit", fn, args, kwargs)
+            except ActorDiedError:
+                self._drop_agent(preferred)
+        return self._run(fn, args, kwargs)
+
+    def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
+        """Locality-aware submit: place the task on the host holding the
+        most of ``refs``' bytes (Ray schedules reduce tasks near their
+        input objects; round-robin would ship ~(N-1)/N of all partition
+        bytes across DCN unnecessarily). Falls back to round-robin when
+        no host dominates or the preferred host died."""
+        preferred = self._locality_agent(refs)
+        inner = self._executor.submit(
+            self._run_preferring, preferred, fn, args, kwargs
+        )
         return ClusterTaskFuture(inner)
 
     def shutdown(self, cancel: bool = True):
@@ -404,14 +516,21 @@ class ClusterClient:
 
     # -- control plane -------------------------------------------------------
 
-    def _read_agents(self) -> List[ActorHandle]:
+    def _read_agents(
+        self,
+    ) -> Tuple[List[ActorHandle], Dict[Tuple, ActorHandle]]:
         hosts = self.registry.call("hosts")
-        return [
-            self.agent
-            if info["agent"] == list(self.agent.address)
-            else ActorHandle(tuple(info["agent"]))
-            for info in hosts.values()
-        ]
+        agents: List[ActorHandle] = []
+        store_to_agent: Dict[Tuple, ActorHandle] = {}
+        for info in hosts.values():
+            agent = (
+                self.agent
+                if info["agent"] == list(self.agent.address)
+                else ActorHandle(tuple(info["agent"]))
+            )
+            agents.append(agent)
+            store_to_agent[tuple(info["store"])] = agent
+        return agents, store_to_agent
 
     def _evict_host(self, agent: ActorHandle) -> None:
         """Drop a dead agent's host from the membership table so later
@@ -439,7 +558,7 @@ class ClusterClient:
             if self._scheduler is not None and not stale:
                 return self._scheduler
             if self._scheduler is not None:
-                agents = self._read_agents()
+                agents, store_to_agent = self._read_agents()
                 self._scheduler_read_ts = now
                 if {a.address for a in agents} == (
                     self._scheduler.agent_addresses
@@ -448,9 +567,9 @@ class ClusterClient:
                 old, self._scheduler = self._scheduler, None
                 old.shutdown(cancel=False)
             else:
-                agents = self._read_agents()
+                agents, store_to_agent = self._read_agents()
                 self._scheduler_read_ts = now
-            self._scheduler = ClusterScheduler(agents)
+            self._scheduler = ClusterScheduler(agents, store_to_agent)
             self._scheduler.on_agent_dead = self._evict_host
             return self._scheduler
 

@@ -34,6 +34,7 @@ import mmap
 import os
 import secrets
 import struct
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
@@ -53,6 +54,33 @@ def _default_shm_dir() -> str:
     import tempfile
 
     return tempfile.gettempdir()
+
+
+def _default_spill_dir() -> str:
+    d = os.environ.get("RSDL_SPILL_DIR")
+    if d:
+        return d
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "rsdl-spill")
+
+
+def _default_capacity_bytes(shm_dir: str) -> Optional[int]:
+    """Session budget for shared-memory residency. ``RSDL_STORE_CAPACITY_BYTES``
+    absolute, else ``RSDL_STORE_CAPACITY_FRACTION`` (default 0.8) of the
+    filesystem size — the reference provisions its object store explicitly
+    per node with spilling disabled (reference
+    ``benchmarks/cluster.yaml:171-181``); here the default caps tmpfs use
+    below the cliff where the kernel OOM-kills or ENOSPCs mid-epoch."""
+    env = os.environ.get("RSDL_STORE_CAPACITY_BYTES")
+    if env:
+        return int(env) if int(env) > 0 else None
+    frac = float(os.environ.get("RSDL_STORE_CAPACITY_FRACTION", "0.8"))
+    try:
+        st = os.statvfs(shm_dir)
+        return int(st.f_blocks * st.f_frsize * frac)
+    except OSError:
+        return None
 
 
 def _align(n: int) -> int:
@@ -264,11 +292,12 @@ class PendingColumns:
         refcount standing in for Ray's distributed ref counting.
         """
         assert not self._published, "already published"
+        seg_dir = os.path.dirname(self._tmp)  # shm or spill, same fs as tmp
         refs: List[ObjectRef] = []
         try:
             for start, stop in windows:
                 link_id = self._store._new_object_id()
-                os.link(self._tmp, os.path.join(self._store.shm_dir, link_id))
+                os.link(self._tmp, os.path.join(seg_dir, link_id))
                 refs.append(
                     ObjectRef(
                         object_id=link_id,
@@ -284,9 +313,7 @@ class PendingColumns:
             # each pins the whole segment.
             for ref in refs:
                 try:
-                    os.unlink(
-                        os.path.join(self._store.shm_dir, ref.object_id)
-                    )
+                    os.unlink(os.path.join(seg_dir, ref.object_id))
                 except FileNotFoundError:
                     pass
             raise
@@ -350,6 +377,7 @@ def serialize_columns(columns: Mapping[str, np.ndarray]) -> bytes:
 class StoreStats:
     num_objects: int = 0
     total_bytes: int = 0
+    spill_bytes: int = 0  # portion of total_bytes living on disk, not shm
 
 
 class ObjectStore:
@@ -363,6 +391,18 @@ class ObjectStore:
     def __init__(self, session: str, shm_dir: Optional[str] = None):
         self.session = session
         self.shm_dir = shm_dir or _default_shm_dir()
+        # Capacity budgeting (SURVEY §7 hard-part 4): shared-memory
+        # residency for this session is capped; segments beyond the budget
+        # are created in (or fetched to) the disk-backed spill dir instead
+        # of dying on ENOSPC. Admission stays non-blocking, so the pipeline
+        # cannot deadlock on its own backpressure.
+        self.capacity_bytes: Optional[int] = _default_capacity_bytes(
+            self.shm_dir
+        )
+        self.spill_dir = _default_spill_dir()
+        if os.path.realpath(self.spill_dir) == os.path.realpath(self.shm_dir):
+            # A spill dir on tmpfs defeats the point; disable budgeting.
+            self.capacity_bytes = None
         # Cluster-mode hooks, installed by runtime.init when joined to a
         # cluster: refs minted here get stamped with owner_address; misses
         # on foreign refs go through remote_fetch; frees forward to owners.
@@ -370,11 +410,88 @@ class ObjectStore:
         self.remote_fetch = None  # Callable[[ObjectRef], bytes]
         self.remote_free = None  # Callable[[ObjectRef], None]
         self._foreign: set = set()  # locally cached foreign object ids
+        self._prefetch_pool = None  # lazy ThreadPoolExecutor
+        self._prefetch_lock = threading.Lock()
+        # Cache names freed in this process: a prefetch thread whose fetch
+        # lands AFTER the consumer already freed the ref must discard its
+        # result instead of orphaning a cache file (object ids are never
+        # reused, so entries can only ever refer to dead refs). Bounded in
+        # free()/drop_cache: entries only matter while a prefetch could
+        # still be in flight (seconds), so the set is cleared when it
+        # outgrows any plausible in-flight window.
+        self._freed_caches: set = set()
+        # Capacity-check cache: _shm_session_bytes listdir+stats the whole
+        # shm dir, so the result is reused for a short TTL with creations
+        # since the last scan added on top (frees within the TTL leave the
+        # estimate high — the conservative direction: spill a hair early).
+        self._shm_scan_base = 0
+        self._shm_scan_adjust = 0
+        self._shm_scan_ts = float("-inf")
 
     # -- write path ---------------------------------------------------------
 
     def _new_object_id(self) -> str:
         return f"{self.session}-{secrets.token_hex(8)}"
+
+    def _shm_session_bytes(self) -> int:
+        """This session's shared-memory residency (inode-deduped; spilled
+        segments excluded), cached for a short TTL so the data path is not
+        O(resident objects) per placement decision."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._shm_scan_ts <= 0.2:
+            return self._shm_scan_base + self._shm_scan_adjust
+        self._shm_scan_base = self._scan_shm_session_bytes()
+        self._shm_scan_adjust = 0
+        self._shm_scan_ts = now
+        return self._shm_scan_base
+
+    def _scan_shm_session_bytes(self) -> int:
+        """The uncached scan. The filesystem is the shared truth across the
+        session's processes — worker pools race this check and can
+        overshoot by one segment each, which the budget's slack absorbs."""
+        prefix = f"{self.session}-"
+        total = 0
+        seen = set()
+        try:
+            names = os.listdir(self.shm_dir)
+        except FileNotFoundError:
+            return 0
+        for name in names:
+            if name.startswith(prefix):
+                try:
+                    st = os.stat(os.path.join(self.shm_dir, name))
+                except FileNotFoundError:
+                    continue
+                if st.st_ino not in seen:
+                    seen.add(st.st_ino)
+                    total += st.st_size
+        return total
+
+    def _placement_dir(self, nbytes: int) -> str:
+        """Where a new segment of ``nbytes`` goes: shm while the session is
+        under budget, else the spill dir."""
+        if (
+            self.capacity_bytes is not None
+            and nbytes + self._shm_session_bytes() > self.capacity_bytes
+        ):
+            os.makedirs(self.spill_dir, exist_ok=True)
+            return self.spill_dir
+        # Count the imminent write against the cached estimate so rapid
+        # placements between scans see each other.
+        self._shm_scan_adjust += nbytes
+        return self.shm_dir
+
+    def _find_segment(self, object_id: str) -> Optional[str]:
+        """Resolve a local object id to its segment path (shm, then spill)."""
+        path = os.path.join(self.shm_dir, object_id)
+        if os.path.exists(path):
+            return path
+        spath = os.path.join(self.spill_dir, object_id)
+        if os.path.exists(spath):
+            return spath
+        return None
 
     def create_columns(
         self, spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]
@@ -391,7 +508,7 @@ class ObjectStore:
         meta, meta_blob, payload_start, total = _plan_layout(spec)
 
         object_id = self._new_object_id()
-        path = os.path.join(self.shm_dir, object_id)
+        path = os.path.join(self._placement_dir(total), object_id)
         tmp = path + ".tmp"
         fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
@@ -434,17 +551,20 @@ class ObjectStore:
         the ref's window is pulled over DCN once and cached as a local
         standalone segment; subsequent gets map the cache (the plasma
         cross-node transfer analog, SURVEY §2b)."""
-        path = os.path.join(self.shm_dir, ref.object_id)
+        path = self._find_segment(ref.object_id)
         rows = ref.rows
-        if not os.path.exists(path) and self._is_foreign(ref):
+        if path is None and self._is_foreign(ref):
             # Window refs cache under a window-suffixed name (the fetched
             # segment holds only the window; the name keeps that fact
             # consistent across processes on this host).
-            cache_path = self._cache_path(ref)
-            if not os.path.exists(cache_path):
+            cache_path = self._find_cache(ref)
+            if cache_path is None:
+                cache_path = self._cache_path(ref)
                 self._materialize_remote(ref, cache_path)
             path = cache_path
             rows = None
+        elif path is None:
+            path = os.path.join(self.shm_dir, ref.object_id)  # -> ENOENT
         batch = self._map_segment(path, ref.object_id)
         if rows is not None:
             batch = batch.slice(rows[0], rows[1])
@@ -457,17 +577,92 @@ class ObjectStore:
             and self.remote_fetch is not None
         )
 
-    def _cache_path(self, ref: ObjectRef) -> str:
-        name = ref.object_id
+    def _cache_name(self, ref: ObjectRef) -> str:
+        # Caches carry the READER session's prefix (not the producer's):
+        # every process sharing this session computes the same name, and
+        # the session's ordinary prefix cleanup reclaims caches that pool
+        # workers materialized and a failed task never dropped.
+        name = f"{self.session}-cache-{ref.object_id}"
         if ref.rows is not None:
             name = f"{name}+w{ref.rows[0]}-{ref.rows[1]}"
-        return os.path.join(self.shm_dir, name)
+        return name
+
+    def _cache_path(self, ref: ObjectRef) -> str:
+        """Placement for a NEW cache file (capacity-aware like any other
+        segment; ``ref.nbytes`` is the whole-segment size, a safe
+        overestimate for window refs)."""
+        return os.path.join(
+            self._placement_dir(ref.nbytes), self._cache_name(ref)
+        )
+
+    def _find_cache(self, ref: ObjectRef) -> Optional[str]:
+        """An existing cache of ``ref`` (shm, then spill), or None."""
+        name = self._cache_name(ref)
+        for d in (self.shm_dir, self.spill_dir):
+            path = os.path.join(d, name)
+            if os.path.exists(path):
+                return path
+        return None
 
     def _map_segment(self, path: str, object_id: str) -> ColumnBatch:
         return map_segment_file(path, object_id)
 
     def get_bytes(self, ref: ObjectRef) -> bytes:
         return self.get_columns(ref)["__bytes__"].tobytes()
+
+    def prefetch(self, refs, max_parallel: int = 8) -> List:
+        """Start pulling foreign refs' windows into the local cache on
+        background threads; returns immediately with the fetch futures.
+
+        The ``ray.wait(fetch_local=True)`` analog (reference
+        ``dataset.py:132-137``): the reference pulls ALL pending reducer
+        outputs to the local node while the trainer consumes the first.
+        Kicking this off as soon as a queue ``get_batch`` returns its refs
+        overlaps every DCN hop with consumption, instead of stalling the
+        iterator on each foreign ref in turn.
+
+        Failures are swallowed here — the consuming ``get_columns`` retries
+        the fetch synchronously and is the place errors surface.
+        """
+        foreign = [
+            r
+            for r in refs
+            if isinstance(r, ObjectRef)
+            and self._is_foreign(r)
+            and self._find_cache(r) is None
+        ]
+        if not foreign:
+            return []
+        with self._prefetch_lock:
+            if self._prefetch_pool is None:
+                import concurrent.futures
+
+                self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max_parallel,
+                    thread_name_prefix="store-prefetch",
+                )
+
+        def _pull(ref: ObjectRef) -> None:
+            name = self._cache_name(ref)
+            if name in self._freed_caches or self._find_cache(ref) is not None:
+                return
+            try:
+                self._materialize_remote(ref, self._cache_path(ref))
+            except Exception:
+                return
+            if name in self._freed_caches:
+                # The consumer freed the ref while the fetch was in flight
+                # (it cache-missed and fetched synchronously); reclaim the
+                # now-orphaned copy.
+                cache = self._find_cache(ref)
+                if cache is not None:
+                    try:
+                        os.unlink(cache)
+                    except FileNotFoundError:
+                        pass
+                self._foreign.discard(name)
+
+        return [self._prefetch_pool.submit(_pull, r) for r in foreign]
 
     def _materialize_remote(self, ref: ObjectRef, path: str) -> None:
         """Pull a foreign segment's bytes (just the ref's window) and
@@ -492,20 +687,30 @@ class ObjectStore:
             if self._is_foreign(ref):
                 # Drop the local window cache and release the authoritative
                 # copy (the owner's hardlink) — the physical segment dies
-                # when its last window's link is freed.
-                cache = self._cache_path(ref)
-                try:
-                    os.unlink(cache)
-                except FileNotFoundError:
-                    pass
-                self._foreign.discard(os.path.basename(cache))
+                # when its last window's link is freed. Mark first so an
+                # in-flight prefetch landing after this unlink cleans up.
+                if len(self._freed_caches) > 8192:
+                    # Entries only matter while a prefetch is in flight
+                    # (seconds); cap the set instead of leaking for the
+                    # process lifetime.
+                    self._freed_caches.clear()
+                self._freed_caches.add(self._cache_name(ref))
+                cache = self._find_cache(ref)
+                if cache is not None:
+                    try:
+                        os.unlink(cache)
+                    except FileNotFoundError:
+                        pass
+                self._foreign.discard(self._cache_name(ref))
                 if self.remote_free is not None:
                     self.remote_free(ref)
                 continue
-            try:
-                os.unlink(os.path.join(self.shm_dir, ref.object_id))
-            except FileNotFoundError:
-                pass
+            path = self._find_segment(ref.object_id)
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
 
     def drop_cache(self, refs) -> None:
         """Release only this host's fetched copy of foreign refs — the
@@ -516,58 +721,63 @@ class ObjectStore:
         for ref in refs:
             if not self._is_foreign(ref):
                 continue
-            cache = self._cache_path(ref)
-            try:
-                os.unlink(cache)
-            except FileNotFoundError:
-                pass
-            self._foreign.discard(os.path.basename(cache))
+            if len(self._freed_caches) > 8192:
+                self._freed_caches.clear()
+            self._freed_caches.add(self._cache_name(ref))
+            cache = self._find_cache(ref)
+            if cache is not None:
+                try:
+                    os.unlink(cache)
+                except FileNotFoundError:
+                    pass
+            self._foreign.discard(self._cache_name(ref))
 
     def exists(self, ref: ObjectRef) -> bool:
-        return os.path.exists(os.path.join(self.shm_dir, ref.object_id))
+        return self._find_segment(ref.object_id) is not None
 
     def store_stats(self) -> StoreStats:
         """Utilization for this session (replaces the reference's raylet
         ``FormatGlobalMemoryInfo`` probe, ``stats.py:675-683``).
 
         Hardlinked slice refs share pages; bytes are counted once per inode
-        while every ref still counts as an object."""
+        while every ref still counts as an object. Spilled segments are
+        included, with their share reported in ``spill_bytes``."""
         stats = StoreStats()
         prefix = f"{self.session}-"
-        try:
-            names = os.listdir(self.shm_dir)
-        except FileNotFoundError:
-            return stats
         seen_inodes = set()
-        for name in names:
-            if name.startswith(prefix) and not name.endswith(".tmp"):
-                try:
-                    st = os.stat(os.path.join(self.shm_dir, name))
-                except FileNotFoundError:
-                    continue
-                stats.num_objects += 1
-                if st.st_ino not in seen_inodes:
-                    seen_inodes.add(st.st_ino)
-                    stats.total_bytes += st.st_size
+        for dirpath, is_spill in (
+            (self.shm_dir, False),
+            (self.spill_dir, True),
+        ):
+            try:
+                names = os.listdir(dirpath)
+            except FileNotFoundError:
+                continue
+            for name in names:
+                if name.startswith(prefix) and not name.endswith(".tmp"):
+                    try:
+                        st = os.stat(os.path.join(dirpath, name))
+                    except FileNotFoundError:
+                        continue
+                    stats.num_objects += 1
+                    if st.st_ino not in seen_inodes:
+                        seen_inodes.add(st.st_ino)
+                        stats.total_bytes += st.st_size
+                        if is_spill:
+                            stats.spill_bytes += st.st_size
         return stats
 
     def cleanup(self) -> None:
         prefix = f"{self.session}-"
-        try:
-            names = os.listdir(self.shm_dir)
-        except FileNotFoundError:
-            return
-        for name in names:
-            if name.startswith(prefix):
-                try:
-                    os.unlink(os.path.join(self.shm_dir, name))
-                except FileNotFoundError:
-                    pass
-        # Cached foreign segments carry their producer's session prefix;
-        # reclaim them explicitly.
-        for object_id in list(self._foreign):
+        for dirpath in (self.shm_dir, self.spill_dir):
             try:
-                os.unlink(os.path.join(self.shm_dir, object_id))
+                names = os.listdir(dirpath)
             except FileNotFoundError:
-                pass
+                continue
+            for name in names:
+                if name.startswith(prefix):
+                    try:
+                        os.unlink(os.path.join(dirpath, name))
+                    except FileNotFoundError:
+                        pass
         self._foreign.clear()

@@ -30,6 +30,8 @@ class TaskFuture:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[str] = None
+        self._waiters_lock = threading.Lock()
+        self._waiters: List[threading.Event] = []
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -41,10 +43,28 @@ class TaskFuture:
             raise TaskError(self._error)
         return self._result
 
+    def _add_waiter(self, event: threading.Event) -> None:
+        with self._waiters_lock:
+            if self._event.is_set():
+                event.set()
+            else:
+                self._waiters.append(event)
+
+    def _remove_waiter(self, event: threading.Event) -> None:
+        with self._waiters_lock:
+            try:
+                self._waiters.remove(event)
+            except ValueError:
+                pass
+
     def _fulfill(self, result, error):
         self._result = result
         self._error = error
         self._event.set()
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            w.set()
 
 
 def wait(
@@ -53,19 +73,51 @@ def wait(
     timeout: Optional[float] = None,
 ) -> Tuple[List[TaskFuture], List[TaskFuture]]:
     """``ray.wait`` analog: block until ``num_returns`` futures complete;
-    return (done, pending) preserving submission order."""
+    return (done, pending) preserving submission order.
+
+    Event-driven: completions notify a shared event, so waiting burns no
+    CPU (futures without waiter support — e.g. bare concurrent futures —
+    fall back to a coarse poll).
+    """
     import time as _time
 
     deadline = None if timeout is None else _time.monotonic() + timeout
-    while True:
-        done = [f for f in futures if f.done()]
-        if len(done) >= num_returns:
-            pending = [f for f in futures if not f.done()]
-            return done, pending
-        if deadline is not None and _time.monotonic() > deadline:
-            pending = [f for f in futures if not f.done()]
-            return done, pending
-        _time.sleep(0.001)
+    notify = threading.Event()
+    subscribed = []
+    for f in futures:
+        add = getattr(f, "_add_waiter", None)
+        if add is not None:
+            add(notify)
+            subscribed.append(f)
+    pollable = len(subscribed) < len(futures)
+    try:
+        while True:
+            # Clear BEFORE checking: a completion racing this loop either
+            # lands before the check (seen via done()) or after (re-sets
+            # the event, so the next wait() returns immediately).
+            notify.clear()
+            done = [f for f in futures if f.done()]
+            if len(done) >= num_returns:
+                break
+            if deadline is not None and _time.monotonic() > deadline:
+                break
+            remaining = (
+                None if deadline is None else deadline - _time.monotonic()
+            )
+            if pollable:
+                remaining = 0.01 if remaining is None else min(remaining, 0.01)
+            if remaining is not None and remaining <= 0:
+                continue
+            notify.wait(remaining)
+    finally:
+        for f in subscribed:
+            f._remove_waiter(notify)
+    # One snapshot, done first: a future completing between two separate
+    # scans would otherwise land in BOTH lists.
+    done_set = {id(f) for f in futures if f.done()}
+    done = [f for f in futures if id(f) in done_set]
+    pending = [f for f in futures if id(f) not in done_set]
+    return done, pending
 
 
 def _worker_main(task_q, result_q, env: Dict[str, str]):
@@ -181,6 +233,11 @@ class WorkerPool:
                 fut._fulfill(
                     None, f"worker process {pid} died while running this task"
                 )
+
+    def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
+        """Locality-aware submit surface shared with the cluster scheduler;
+        a single-host pool has exactly one locality, so the hint is moot."""
+        return self.submit(fn, *args, **kwargs)
 
     def submit(self, fn: Callable, *args, **kwargs) -> TaskFuture:
         import pickle

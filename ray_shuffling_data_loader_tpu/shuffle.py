@@ -115,8 +115,10 @@ def shuffle_map(
     batch = read_parquet_columns(filename)
     end_read = timeit.default_timer()
 
+    # Any file size is legal, including n < num_reducers (some reducers
+    # then get an empty partition) and n == 0 — the reference tolerates
+    # every size too (reference ``shuffle.py:151-163``).
     n = batch.num_rows
-    assert n > num_reducers, (n, num_reducers)
     rng = _map_seed(seed, epoch, file_index)
     assignment = rng.integers(num_reducers, size=n)
     # Stable group-by-reducer: single-pass counting scatter per column via
@@ -169,31 +171,36 @@ def shuffle_reduce(
         stats_collector.call_oneway("reduce_start", epoch)
     start = timeit.default_timer()
     ctx = runtime.ensure_initialized()
-    parts = [ctx.store.get_columns(r) for r in part_refs]
-    total_rows = sum(p.num_rows for p in parts)
-    rng = _reduce_seed(seed, epoch, reduce_index)
-    perm = rng.permutation(total_rows)
-    # Fused concat+permute straight out of the mmapped partitions INTO the
-    # output segment — this stage's only full data pass (put_columns
-    # copy-out eliminated).
-    template = parts[0] if parts else None
-    pending = ctx.store.create_columns(
-        {
-            k: ((total_rows, *template[k].shape[1:]), template[k].dtype)
-            for k in (template or {})
-        }
-    )
+    parts: List[ColumnBatch] = []
     try:
-        ColumnBatch.concat_take(parts, perm, out=pending.columns)
-        out_ref = pending.seal()
+        parts = [ctx.store.get_columns(r) for r in part_refs]
+        total_rows = sum(p.num_rows for p in parts)
+        rng = _reduce_seed(seed, epoch, reduce_index)
+        perm = rng.permutation(total_rows)
+        # Fused concat+permute straight out of the mmapped partitions INTO
+        # the output segment — this stage's only full data pass
+        # (put_columns copy-out eliminated).
+        template = parts[0] if parts else None
+        pending = ctx.store.create_columns(
+            {
+                k: ((total_rows, *template[k].shape[1:]), template[k].dtype)
+                for k in (template or {})
+            }
+        )
+        try:
+            ColumnBatch.concat_take(parts, perm, out=pending.columns)
+            out_ref = pending.seal()
+        finally:
+            pending.abort()  # reclaims the segment on failure; no-op on seal
+        del pending
     finally:
-        pending.abort()  # reclaims the segment on failure; no-op after seal
-    del parts, pending  # drop mmap views before unlinking
-    # Input partitions are NOT freed here — the driver frees them after
-    # the result lands (shuffle_epoch), which keeps this task retryable
-    # on another host after an agent death. Only this host's DCN window
-    # caches are dropped (authoritative copies survive).
-    ctx.store.drop_cache(list(part_refs))
+        # Input partitions are NOT freed here — the driver frees them after
+        # the result lands (shuffle_epoch), which keeps this task retryable
+        # on another host after an agent death. Only this host's DCN window
+        # caches are dropped (authoritative copies survive) — in a finally
+        # so a failed reduce does not leak its fetched windows in /dev/shm.
+        del parts  # drop mmap views before unlinking
+        ctx.store.drop_cache(list(part_refs))
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
@@ -250,8 +257,14 @@ def shuffle_epoch(
         try:
             # Wait for all maps (reduce needs one partition per mapper).
             per_file_refs = [f.result() for f in map_futs]
+            # Locality: each reduce runs on the host already holding the
+            # most of its input-partition rows (cluster mode; the local
+            # pool ignores the hint). Ray gets this from its scheduler;
+            # round-robin alone would cross DCN with ~(N-1)/N of all
+            # partition bytes.
             reduce_futs = [
-                pool.submit(
+                pool.submit_local_to(
+                    [refs[r] for refs in per_file_refs],
                     shuffle_reduce,
                     r,
                     epoch,

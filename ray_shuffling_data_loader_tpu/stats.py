@@ -117,6 +117,7 @@ class StagingStats:
     stall_s: float = 0.0
     stalls: int = 0
     first_batch_s: float = 0.0
+    peak_device_bytes_in_use: int = 0
 
 
 @dataclass
@@ -129,6 +130,12 @@ class TrialStats:
     num_epochs: int = 0
     batch_size: int = 0
     num_trainers: int = 1
+    # Workload configuration (leading reference trial-CSV columns,
+    # reference ``stats.py:336-344``).
+    num_files: int = 0
+    num_row_groups_per_file: int = 0
+    num_reducers: int = 0
+    max_concurrent_epochs: int = 0
     epochs: List[EpochStats] = field(default_factory=list)
     store_samples: List[StoreSample] = field(default_factory=list)
     staging: List[StagingStats] = field(default_factory=list)
@@ -170,31 +177,93 @@ class TrialStats:
         return sum(s.bytes_staged for s in self.staging)
 
     def row(self) -> Dict[str, float]:
+        """The trial-CSV row: the reference's exact fieldname set
+        (reference ``stats.py:335-381``) followed by the TPU-native
+        staging/stall columns (north-star metrics, BASELINE.md)."""
         out = {
+            "num_files": self.num_files,
+            "num_row_groups_per_file": self.num_row_groups_per_file,
+            "num_reducers": self.num_reducers,
+            "num_trainers": self.num_trainers,
+            "num_epochs": self.num_epochs,
+            "max_concurrent_epochs": self.max_concurrent_epochs,
             "trial": self.trial,
             "duration": self.duration,
             "num_rows": self.num_rows,
-            "num_epochs": self.num_epochs,
             "batch_size": self.batch_size,
-            "num_trainers": self.num_trainers,
             "row_throughput": self.row_throughput,
             "batch_throughput": self.batch_throughput,
-            "per_trainer_batch_throughput": self.per_trainer_batch_throughput,
-            "avg_object_store_bytes": self.avg_store_bytes,
-            "max_object_store_bytes": self.max_store_bytes,
-            "total_stall_s": self.total_stall_s,
-            "total_bytes_staged": self.total_bytes_staged,
+            "batch_throughput_per_trainer": self.per_trainer_batch_throughput,
+            "avg_object_store_utilization": self.avg_store_bytes,
+            "max_object_store_utilization": self.max_store_bytes,
         }
-        for k, v in _agg([e.duration for e in self.epochs]).items():
-            out[f"epoch_duration_{k}"] = v
-        for k, v in _agg(
-            [d for e in self.epochs for d in e.map_durations]
-        ).items():
-            out[f"map_task_{k}"] = v
-        for k, v in _agg(
-            [d for e in self.epochs for d in e.reduce_durations]
-        ).items():
-            out[f"reduce_task_{k}"] = v
+
+        def put_agg(name: str, values: Sequence[float]) -> None:
+            for k, v in _agg(values).items():
+                out[f"{k}_{name}"] = v
+
+        put_agg("epoch_duration", [e.duration for e in self.epochs])
+        put_agg(
+            "map_stage_duration",
+            [e.map_stage_duration for e in self.epochs],
+        )
+        put_agg(
+            "reduce_stage_duration",
+            [e.reduce_stage_duration for e in self.epochs],
+        )
+        put_agg(
+            "consume_stage_duration",
+            [
+                max(
+                    (c.time_since_epoch_start for c in e.consume_records),
+                    default=0.0,
+                )
+                for e in self.epochs
+            ],
+        )
+        put_agg(
+            "map_task_duration",
+            [d for e in self.epochs for d in e.map_durations],
+        )
+        put_agg(
+            "read_duration",
+            [d for e in self.epochs for d in e.map_read_durations],
+        )
+        put_agg(
+            "reduce_task_duration",
+            [d for e in self.epochs for d in e.reduce_durations],
+        )
+        put_agg(
+            "time_to_consume",
+            [
+                c.time_since_epoch_start
+                for e in self.epochs
+                for c in e.consume_records
+            ],
+        )
+
+        # TPU-native staging columns (no reference analog; the reference's
+        # closest quantity is the example's trainer batch-wait time,
+        # reference ``ray_torch_shuffle.py:201-230``).
+        put_dispatch_s = sum(s.put_dispatch_s for s in self.staging)
+        out["total_bytes_staged"] = self.total_bytes_staged
+        out["put_dispatch_s"] = put_dispatch_s
+        out["h2d_gbps"] = (
+            self.total_bytes_staged / 1e9 / put_dispatch_s
+            if put_dispatch_s > 0
+            else 0.0
+        )
+        out["total_stall_s"] = self.total_stall_s
+        out["stall_pct"] = (
+            100.0
+            * self.total_stall_s
+            / (self.duration * max(1, len(self.staging)))
+            if self.duration
+            else 0.0
+        )
+        out["peak_hbm_bytes"] = max(
+            (s.peak_device_bytes_in_use for s in self.staging), default=0
+        )
         return out
 
 
@@ -225,6 +294,8 @@ class TrialStatsCollector:
         batch_size: int = 0,
         num_trainers: int = 1,
         trial: int = 0,
+        num_row_groups_per_file: int = 0,
+        max_concurrent_epochs: int = 0,
     ):
         self._num_maps = num_maps_per_epoch
         self._num_reduces = num_reduces_per_epoch
@@ -234,6 +305,10 @@ class TrialStatsCollector:
             num_epochs=num_epochs,
             batch_size=batch_size,
             num_trainers=num_trainers,
+            num_files=num_maps_per_epoch,
+            num_row_groups_per_file=num_row_groups_per_file,
+            num_reducers=num_reduces_per_epoch,
+            max_concurrent_epochs=max_concurrent_epochs,
         )
         self._epochs: Dict[int, EpochStats] = {}
         self._map_started: Dict[int, int] = {}
@@ -304,6 +379,9 @@ class TrialStatsCollector:
                 stall_s=float(staging.get("stall_s", 0.0)),
                 stalls=int(staging.get("stalls", 0)),
                 first_batch_s=float(staging.get("first_batch_s", 0.0)),
+                peak_device_bytes_in_use=int(
+                    staging.get("peak_device_bytes_in_use", 0)
+                ),
             )
         )
 

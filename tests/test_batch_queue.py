@@ -161,6 +161,25 @@ def test_concurrent_put(make_queue):
     assert q.get(rank=0, epoch=0) == 2
 
 
+def test_put_batch_all_or_nothing(make_queue):
+    """A timed-out batched put must leave the queue untouched — no partial
+    enqueue (regression: items put before the timeout used to land)."""
+    q = make_queue(maxsize=2)
+    q.put(rank=0, epoch=0, item="resident")
+    with pytest.raises(Full):
+        q.put_batch(rank=0, epoch=0, items=["a", "b"], timeout=0.2)
+    # Nothing from the failed batch landed.
+    assert q.qsize(rank=0, epoch=0) == 1
+    assert q.get(rank=0, epoch=0) == "resident"
+    # With room, the same batch goes through atomically.
+    q.put_batch(rank=0, epoch=0, items=["a", "b"], timeout=0.2)
+    assert q.get(rank=0, epoch=0) == "a"
+    assert q.get(rank=0, epoch=0) == "b"
+    # A batch larger than maxsize can never fit: immediate Full.
+    with pytest.raises(Full):
+        q.put_batch(rank=0, epoch=0, items=["a", "b", "c"], timeout=0.2)
+
+
 def test_batch(make_queue):
     q = make_queue(maxsize=1)
 

@@ -250,6 +250,147 @@ def test_dead_host_failover(tmp_path):
     )
 
 
+def test_cluster_scheduler_locality_choice(monkeypatch):
+    """Unit: the scheduler places a task on the host owning the most input
+    rows; no owners / unknown owner / disabled env -> no preference."""
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterScheduler
+    from ray_shuffling_data_loader_tpu.runtime.store import ObjectRef
+
+    class FakeAgent:
+        def __init__(self, address):
+            self.address = address
+
+    a = FakeAgent(("tcp", "hostA", 1))
+    b = FakeAgent(("tcp", "hostB", 1))
+    sched = ClusterScheduler(
+        [a, b],
+        {("tcp", "hostA", 9): a, ("tcp", "hostB", 9): b},
+    )
+    try:
+        refs = [
+            ObjectRef("x", 100, owner=("tcp", "hostA", 9), rows=(0, 10)),
+            ObjectRef("y", 100, owner=("tcp", "hostB", 9), rows=(0, 90)),
+        ]
+        assert sched._locality_agent(refs) is b
+        # Whole-segment refs weigh by nbytes.
+        big = ObjectRef("z", 10_000, owner=("tcp", "hostA", 9))
+        assert sched._locality_agent([big]) is a
+        # Ownerless refs give no preference; unknown owners neither.
+        assert sched._locality_agent([ObjectRef("w", 5)]) is None
+        assert (
+            sched._locality_agent(
+                [ObjectRef("v", 5, owner=("tcp", "gone", 9))]
+            )
+            is None
+        )
+        monkeypatch.setenv("RSDL_DISABLE_LOCALITY", "1")
+        assert sched._locality_agent(refs) is None
+    finally:
+        sched.shutdown()
+
+
+LOCALITY_HEAD_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime, ShufflingDataset
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
+
+ctx = runtime.init_cluster(advertise_host="127.0.0.1", num_workers=2)
+with open({addr_file!r} + ".tmp", "w") as f:
+    f.write(ctx.cluster.address)
+os.rename({addr_file!r} + ".tmp", {addr_file!r})
+
+deadline = time.time() + 60
+while len(ctx.cluster.registry.call("hosts")) < 2:
+    if time.time() > deadline:
+        print("VERDICT: FAIL worker never joined", flush=True)
+        sys.exit(1)
+    time.sleep(0.2)
+
+# 3 files over 2 hosts: round-robin maps put files 0,2 on the head and
+# file 1 on the worker, so the head owns 2/3 of every reducer's input —
+# a deterministic skew for the locality scheduler to exploit.
+filenames, _ = generate_data(
+    num_rows=3000, num_files=3, num_row_groups_per_file=1,
+    max_row_group_skew=0.0, data_dir={data_dir!r},
+)
+ds = ShufflingDataset(
+    filenames, num_epochs=1, num_trainers=1, batch_size=500, rank=0,
+    num_reducers=4, seed=17, queue_name="q-locality",
+)
+ds.set_epoch(0)
+keys = sorted(k for b in ds for k in b["key"].tolist())
+ok = keys == list(range(3000))
+if not ok:
+    print("VERDICT: FAIL keys wrong", flush=True)
+hosts = ctx.cluster.registry.call("hosts")
+cross = sum(
+    ActorHandle(tuple(info["store"])).call("fetch_stats")["bytes"]
+    for info in hosts.values()
+)
+print(f"CROSS_BYTES: {{cross}}", flush=True)
+print("VERDICT: " + ("PASS" if ok else "FAIL"), flush=True)
+runtime.shutdown()
+"""
+
+
+def _run_locality_cluster(tmp_path, tag: str, extra_env: dict) -> int:
+    addr_file = str(tmp_path / f"head_address_{tag}")
+    data_dir = str(tmp_path / f"data_{tag}")
+    env = dict(
+        os.environ, RSDL_ADVERTISE_HOST="127.0.0.1", JAX_PLATFORMS="cpu"
+    )
+    env.update(extra_env)
+    head_log = tmp_path / f"head_{tag}.log"
+    worker_log = tmp_path / f"worker_{tag}.log"
+    with open(head_log, "w") as hf, open(worker_log, "w") as wf:
+        head = subprocess.Popen(
+            [sys.executable, "-c", LOCALITY_HEAD_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file, data_dir=data_dir
+            )],
+            stdout=hf, stderr=subprocess.STDOUT, env=env,
+        )
+        worker = subprocess.Popen(
+            [sys.executable, "-c", WORKER_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file
+            )],
+            stdout=wf, stderr=subprocess.STDOUT, env=env,
+        )
+        try:
+            head.wait(timeout=240)
+            worker.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        finally:
+            head.kill()
+            worker.kill()
+            head.wait()
+            worker.wait()
+    out = head_log.read_text()
+    assert "VERDICT: PASS" in out, (
+        f"head[{tag}]:\n{out}\n--- worker:\n{worker_log.read_text()}"
+    )
+    for line in out.splitlines():
+        if line.startswith("CROSS_BYTES:"):
+            return int(line.split(":")[1])
+    raise AssertionError(f"no CROSS_BYTES in head output:\n{out}")
+
+
+def test_locality_scheduling_cuts_cross_host_bytes(tmp_path):
+    """Two-host cluster, skewed input ownership: locality-aware reduce
+    placement must move materially fewer bytes across the DCN than pure
+    round-robin (VERDICT r1 item 5)."""
+    rr = _run_locality_cluster(
+        tmp_path, "rr", {"RSDL_DISABLE_LOCALITY": "1"}
+    )
+    loc = _run_locality_cluster(tmp_path, "loc", {})
+    assert loc < rr * 0.7, (
+        f"locality={loc} bytes vs round-robin={rr} bytes — "
+        "expected a >=30% cross-host reduction"
+    )
+
+
 def test_two_host_cluster_shuffle(tmp_path):
     addr_file = str(tmp_path / "head_address")
     data_dir = str(tmp_path / "data")

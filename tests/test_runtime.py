@@ -175,6 +175,108 @@ def test_pool_wait(local_runtime):
     assert len(done) >= 1
 
 
+def test_wait_event_driven(local_runtime):
+    """wait() blocks on completion notification, not a spin loop: an
+    unfulfilled future times out without burning CPU, and a fulfillment
+    mid-wait wakes the waiter promptly."""
+    import os as _os
+    import time as _time
+
+    from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture
+
+    fut = TaskFuture(0)
+    cpu0 = _os.times()
+    t0 = _time.monotonic()
+    done, pending = wait([fut], num_returns=1, timeout=0.5)
+    waited = _time.monotonic() - t0
+    cpu1 = _os.times()
+    assert done == [] and pending == [fut]
+    assert waited >= 0.45
+    # A 1 ms poll burned ~full core here before; event-driven is near zero.
+    cpu_used = (cpu1.user - cpu0.user) + (cpu1.system - cpu0.system)
+    assert cpu_used < 0.2, f"wait() burned {cpu_used:.3f}s CPU in {waited:.2f}s"
+
+    fut2 = TaskFuture(1)
+    threading.Timer(0.1, lambda: fut2._fulfill("ok", None)).start()
+    t0 = _time.monotonic()
+    done, pending = wait([fut2], num_returns=1, timeout=30)
+    assert done == [fut2] and _time.monotonic() - t0 < 5
+    # No waiters leak on the fulfilled future.
+    assert fut2._waiters == []
+
+
+def test_wait_on_already_done_cluster_future():
+    """Regression: waiting on an already-completed ClusterTaskFuture must
+    not deadlock (add_done_callback fires synchronously when done; the
+    notify path re-takes the waiter lock)."""
+    import concurrent.futures
+
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterTaskFuture
+
+    inner = concurrent.futures.Future()
+    inner.set_result(42)
+    fut = ClusterTaskFuture(inner)
+    done, pending = wait([fut], num_returns=1, timeout=5)
+    assert done == [fut] and pending == []
+    assert fut.result() == 42
+
+
+def test_prefetch_overlaps_foreign_fetches(tmp_path):
+    """``prefetch`` pulls foreign refs' windows concurrently (the
+    ``ray.wait(fetch_local=True)`` analog) and later ``get_columns`` hit
+    the local cache — no extra remote fetch per ref."""
+    from ray_shuffling_data_loader_tpu.runtime.store import (
+        ObjectRef,
+        ObjectStore,
+        serialize_columns,
+    )
+
+    store = ObjectStore("pfsess", shm_dir=str(tmp_path))
+    store.owner_address = ("tcp", "local", 1)
+    payload = serialize_columns({"x": np.arange(32, dtype=np.int64)})
+    state = {"active": 0, "max_active": 0, "fetches": 0}
+    lock = threading.Lock()
+
+    def fake_fetch(ref):
+        with lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"], state["active"])
+        time.sleep(0.15)
+        with lock:
+            state["active"] -= 1
+            state["fetches"] += 1
+        return payload
+
+    store.remote_fetch = fake_fetch
+    refs = [
+        ObjectRef(
+            object_id=f"othersess-{i:02d}",
+            nbytes=len(payload),
+            session="othersess",
+            owner=("tcp", "remote", 2),
+        )
+        for i in range(4)
+    ]
+    t0 = time.monotonic()
+    futs = store.prefetch(refs)
+    assert len(futs) == 4
+    for f in futs:
+        f.result(timeout=30)
+    elapsed = time.monotonic() - t0
+    # 4 fetches of 0.15 s each: serial would be >= 0.6 s.
+    assert state["max_active"] >= 2, "fetches never overlapped"
+    assert elapsed < 0.45, f"prefetch looks serial: {elapsed:.2f}s"
+    assert state["fetches"] == 4
+    # Consumption now hits the cache: no new remote fetches.
+    for ref in refs:
+        cb = store.get_columns(ref)
+        np.testing.assert_array_equal(cb["x"], np.arange(32))
+    assert state["fetches"] == 4
+    # Already-cached refs are skipped entirely.
+    assert store.prefetch(refs) == []
+    store.free(refs)
+
+
 # -- actors -----------------------------------------------------------------
 
 

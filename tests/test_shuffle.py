@@ -140,6 +140,42 @@ def test_shuffle_error_propagates_without_hang(local_runtime, small_dataset):
     assert consumer.done[(0, 0)] and consumer.done[(0, 1)]
 
 
+def test_small_file_fewer_rows_than_reducers(local_runtime, tmp_path):
+    """Files with <= num_reducers rows are legal (the reference handles any
+    size, reference ``shuffle.py:151-163``); regression for the former
+    hard assert at map time."""
+    import pandas as pd
+
+    path = str(tmp_path / "tiny.parquet")
+    pd.DataFrame({"key": np.arange(3, dtype=np.int64)}).to_parquet(path)
+    num_reducers = 8
+    refs = shuffle_map(path, 0, num_reducers, epoch=0, seed=1)
+    assert len(refs) == num_reducers
+    store = runtime.get_context().store
+    all_keys = []
+    for ref in refs:
+        all_keys.extend(store.get_columns(ref)["key"].tolist())
+    assert sorted(all_keys) == [0, 1, 2]
+    # Empty partitions still reduce cleanly.
+    out = shuffle_reduce(0, epoch=0, seed=1, part_refs=refs)
+    store.free(refs)
+    store.free(out)
+
+
+def test_shuffle_empty_file(local_runtime, tmp_path):
+    """A zero-row Parquet file shuffles to zero rows, end to end."""
+    import pandas as pd
+
+    path = str(tmp_path / "empty.parquet")
+    pd.DataFrame({"key": np.array([], dtype=np.int64)}).to_parquet(path)
+    consumer = CollectingConsumer()
+    shuffle(
+        [path], consumer, num_epochs=1, num_reducers=2, num_trainers=1, seed=0
+    )
+    assert consumer.done[(0, 0)]
+    assert consumer.keys[(0, 0)] == []
+
+
 def test_epochs_differ(local_runtime, small_dataset):
     consumer = CollectingConsumer()
     shuffle(

@@ -1,0 +1,137 @@
+"""Store capacity budgeting + disk spill (SURVEY §7 hard-part 4).
+
+The reference provisions a 110 GiB object store per node with spilling
+deliberately disabled (reference ``benchmarks/cluster.yaml:171-181``) — a
+dataset over budget dies. Here shared-memory residency is capped
+(``RSDL_STORE_CAPACITY_BYTES`` / ``RSDL_STORE_CAPACITY_FRACTION``) and
+over-budget segments transparently land in a disk-backed spill dir, so a
+dataset larger than the cap completes instead of ENOSPC-ing mid-epoch."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu.runtime.store import ObjectStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def capped_store(tmp_path, monkeypatch):
+    shm = tmp_path / "shm"
+    spill = tmp_path / "spill"
+    shm.mkdir()
+    monkeypatch.setenv("RSDL_SPILL_DIR", str(spill))
+    store = ObjectStore("spillsess", shm_dir=str(shm))
+    store.spill_dir = str(spill)
+    store.capacity_bytes = 300_000
+    yield store
+    store.cleanup()
+
+
+def test_over_budget_segments_spill_and_read_back(capped_store):
+    store = capped_store
+    refs = []
+    for i in range(10):  # 10 x ~80 KB >> 300 KB cap
+        refs.append(
+            store.put_columns(
+                {"x": np.arange(10_000, dtype=np.int64) + i}
+            )
+        )
+    stats = store.store_stats()
+    assert stats.spill_bytes > 0, "nothing spilled despite 2.6x the cap"
+    shm_bytes = stats.total_bytes - stats.spill_bytes
+    # shm residency respects the cap (one segment of slack for the race
+    # window documented in _shm_session_bytes).
+    assert shm_bytes <= store.capacity_bytes + 90_000
+    # Every segment reads back correctly regardless of placement.
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            store.get_columns(ref)["x"], np.arange(10_000, dtype=np.int64) + i
+        )
+    store.free(refs)
+    stats = store.store_stats()
+    assert stats.num_objects == 0 and stats.total_bytes == 0
+
+
+def test_spilled_publish_slices_windows(capped_store):
+    store = capped_store
+    # Fill shm past the cap, then publish a sliced segment: the hardlinked
+    # window refs must work from the spill dir too.
+    filler = [
+        store.put_columns({"x": np.zeros(10_000, dtype=np.int64)})
+        for _ in range(5)
+    ]
+    pending = store.create_columns({"k": ((50_000,), np.dtype(np.int64))})
+    pending.columns["k"][...] = np.arange(50_000)
+    refs = pending.publish_slices([(0, 40), (40, 50_000)])
+    assert os.path.dirname(pending._path) == store.spill_dir
+    np.testing.assert_array_equal(
+        store.get_columns(refs[0])["k"], np.arange(40)
+    )
+    np.testing.assert_array_equal(
+        store.get_columns(refs[1])["k"], np.arange(40, 50_000)
+    )
+    store.free(filler)
+    store.free(refs)
+    assert store.store_stats().num_objects == 0
+
+
+_E2E_SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import ShufflingDataset, runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+def main():
+    runtime.init(num_workers=2)
+    filenames, num_bytes = generate_data(20_000, 4, 1, 0.0, {data_dir!r})
+    # The capacity env (set by the test) is ~half of one epoch's working
+    # set: the shuffle must spill and still deliver exactly once.
+    ds = ShufflingDataset(
+        filenames, num_epochs=2, num_trainers=1, batch_size=4_000,
+        rank=0, num_reducers=4, seed=3,
+    )
+    for epoch in range(2):
+        ds.set_epoch(epoch)
+        keys = sorted(k for b in ds for k in b["key"].tolist())
+        assert keys == list(range(20_000)), len(keys)
+    stats = runtime.store_stats()
+    assert stats.num_objects == 0, f"leak: {{stats}}"
+    runtime.shutdown()
+    print("SPILL_E2E_PASS", flush=True)
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def test_shuffle_completes_with_dataset_over_capacity(tmp_path):
+    """End-to-end: dataset working set ~2x the shm budget completes
+    (VERDICT r1 item 6 'Done' criterion) with spill active."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # 20k rows x ~168 B ~= 3.4 MB logical; map partitions + reduce
+        # outputs double that per epoch. 1.5 MB forces heavy spill.
+        RSDL_STORE_CAPACITY_BYTES="1500000",
+        RSDL_SPILL_DIR=str(tmp_path / "spill"),
+        RSDL_SHM_DIR=str(tmp_path / "shm"),
+    )
+    os.makedirs(tmp_path / "shm")
+    script = _E2E_SCRIPT.format(
+        repo=_REPO, data_dir=str(tmp_path / "data")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-u", "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0 and "SPILL_E2E_PASS" in proc.stdout, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    )
