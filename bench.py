@@ -137,12 +137,21 @@ def _shm_free_bytes() -> int:
         return 1 << 62
 
 
-def _sized_workload():
+def _sized_workload(platform: str):
     """Pick (num_rows, dataset_gb): TARGET_GB unless /dev/shm headroom
     forces smaller. Peak store residency is ~2x dataset (one epoch's map
     partitions + reducer outputs) x up to 2 epochs in flight; require 5x
-    so the bench never ENOSPCs mid-epoch."""
-    target_bytes = int(TARGET_GB * 1e9)
+    so the bench never ENOSPCs mid-epoch.
+
+    CPU failover shrinks the workload (``RSDL_BENCH_CPU_GB``, default
+    0.25 GB): the real train step is ~3 orders slower without the MXU and
+    a 10 GB run would blow any reasonable bench window."""
+    target_gb = TARGET_GB
+    if platform == "cpu":
+        target_gb = min(
+            target_gb, float(os.environ.get("RSDL_BENCH_CPU_GB", "0.25"))
+        )
+    target_bytes = int(target_gb * 1e9)
     headroom = _shm_free_bytes()
     budget = int(headroom / 5)
     scaled = min(target_bytes, budget)
@@ -248,7 +257,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # I/O (parquet decode) and memory passes, and they must overlap the
     # TPU-side train steps.
     ctx = runtime.init(num_workers=max(4, os.cpu_count() or 1))
-    num_rows, scaled_down = _sized_workload()
+    num_rows, scaled_down = _sized_workload(platform)
     filenames, dataset_bytes = _get_data(num_rows)
 
     peak_gbps = _measure_peak_h2d_gbps()

@@ -380,16 +380,36 @@ class ClusterScheduler:
             except Exception:
                 pass
 
+    def _submit_once(self, agent: ActorHandle, fn, args, kwargs):
+        """One submit attempt with death confirmation: ActorHandle wraps
+        ANY ConnectionError/OSError into ActorDiedError, so a transient
+        TCP reset would otherwise permanently evict a healthy host from
+        both the rotation and the membership table. Before dropping,
+        confirm with a ping on a fresh connection; an alive agent gets
+        the call retried instead of its host evicted."""
+        try:
+            return True, agent.call("submit", fn, args, kwargs)
+        except ActorDiedError:
+            if agent.ping(timeout=5.0):
+                try:
+                    # Alive — the error was a transient connection drop.
+                    # Task bodies are idempotent over the store, so a
+                    # retry after an ambiguous failure is safe.
+                    return True, agent.call("submit", fn, args, kwargs)
+                except ActorDiedError:
+                    pass
+            self._drop_agent(agent)
+            return False, None
+
     def _run(self, fn, args, kwargs):
         # Task bodies are idempotent pure functions over the store (map/
         # reduce stages), so retrying on another host after an agent death
         # is safe; at most len(agents) attempts.
         while True:
             agent = self._next_agent()
-            try:
-                return agent.call("submit", fn, args, kwargs)
-            except ActorDiedError:
-                self._drop_agent(agent)
+            ok, result = self._submit_once(agent, fn, args, kwargs)
+            if ok:
+                return result
 
     def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
         inner = self._executor.submit(self._run, fn, args, kwargs)
@@ -428,10 +448,9 @@ class ClusterScheduler:
 
     def _run_preferring(self, preferred, fn, args, kwargs):
         if preferred is not None:
-            try:
-                return preferred.call("submit", fn, args, kwargs)
-            except ActorDiedError:
-                self._drop_agent(preferred)
+            ok, result = self._submit_once(preferred, fn, args, kwargs)
+            if ok:
+                return result
         return self._run(fn, args, kwargs)
 
     def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
@@ -611,6 +630,21 @@ class ClusterClient:
             tuple(record["address"]), pid=record.get("pid"), name=name
         )
 
+    def reregister(self) -> None:
+        """(Re-)announce this host to the registry. ``register_host`` is an
+        idempotent upsert, so the periodic heartbeat in ``serve_forever``
+        re-admits a host the scheduler evicted on a false-positive death
+        (e.g. a transient TCP reset) — the rejoin path ADVICE r1 called
+        for. Schedulers pick the host back up on their next membership
+        refresh."""
+        self.registry.call(
+            "register_host",
+            self.host_id,
+            list(self.agent.address),
+            list(self.store_server.address),
+            self.agent.call("num_workers"),
+        )
+
     def leave(self) -> None:
         try:
             self.registry.call_oneway("unregister_host", self.host_id)
@@ -650,18 +684,31 @@ def start_host_services(
     return agent, store_server
 
 
-def serve_forever(poll_s: float = 1.0) -> None:
+def serve_forever(
+    poll_s: float = 1.0, heartbeat_s: float = 10.0
+) -> None:
     """Block while this worker host's services run; returns when the
-    registry becomes unreachable (head shut down)."""
+    registry becomes unreachable (head shut down).
+
+    Every ``heartbeat_s`` the host re-registers with the registry — the
+    membership heartbeat that re-admits a live host evicted by a
+    false-positive death verdict (see ``ClusterClient.reregister``)."""
     from . import get_context
 
     ctx = get_context()
     if ctx.cluster is None:
         raise RuntimeError("not joined to a cluster")
+    last_beat = time.monotonic()
     while True:
         time.sleep(poll_s)
         if not ctx.cluster.registry.ping(timeout=5.0):
             return
+        if time.monotonic() - last_beat >= heartbeat_s:
+            last_beat = time.monotonic()
+            try:
+                ctx.cluster.reregister()
+            except ActorDiedError:
+                return
 
 
 def _main(argv: List[str]) -> int:

@@ -7,9 +7,14 @@ control plane (reference depends on Ray core for all RPC, ``setup.py:14-20``).
 
 TCP security: frames are pickles, so accepting them from arbitrary peers
 would be remote code execution. Every TCP connection therefore starts with
-a bearer-token hello (``$RSDL_CLUSTER_TOKEN``, minted by ``init_cluster``
-and carried in the ``tcp://host:port/<token>`` join address); servers drop
-non-matching peers before touching pickle. Unix sockets rely on the 0o700
+an HMAC challenge-response on the cluster secret (``$RSDL_CLUSTER_TOKEN``,
+minted by ``init_cluster`` and carried in the ``tcp://host:port/<token>``
+join address): the server sends a random nonce, the client answers
+``HMAC-SHA256(token, nonce)``, and non-matching peers are dropped before
+any pickle is touched. The secret itself never crosses the wire, so a DCN
+observer (or a copy of a logged join address *after* rotation) cannot
+replay its way in; possession of the current token remains the trust
+anchor — run clusters inside a private VPC. Unix sockets rely on the 0o700
 runtime directory instead, like Ray's on-host sockets.
 """
 
@@ -25,6 +30,7 @@ from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
 _AUTH_MAGIC = b"RSDLAUTH"
+_NONCE_LEN = 16
 
 # Address = ("unix", path) | ("tcp", host, port)
 Address = Tuple
@@ -35,15 +41,37 @@ def cluster_token() -> Optional[bytes]:
     return token.encode() if token else None
 
 
-def _auth_blob(token: bytes) -> bytes:
-    return _AUTH_MAGIC + token
+def _challenge() -> bytes:
+    return _AUTH_MAGIC + os.urandom(_NONCE_LEN)
 
 
-def send_auth(sock: socket.socket) -> None:
-    token = cluster_token()
-    if token is not None:
-        payload = _auth_blob(token)
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+def _response(token: bytes, challenge: bytes) -> bytes:
+    return hmac.new(token, challenge, "sha256").digest()
+
+
+def _answer_challenge_sync(sock: socket.socket, token: bytes) -> None:
+    """Client side, blocking socket: read the server's nonce, answer with
+    the keyed digest."""
+    challenge = _recv_exact_sock(sock, _LEN.size)
+    (length,) = _LEN.unpack(challenge)
+    if length != len(_AUTH_MAGIC) + _NONCE_LEN:
+        raise ConnectionError("malformed auth challenge")
+    blob = _recv_exact_sock(sock, length)
+    if not blob.startswith(_AUTH_MAGIC):
+        raise ConnectionError("malformed auth challenge")
+    answer = _response(token, blob)
+    sock.sendall(_LEN.pack(len(answer)) + answer)
+
+
+def _recv_exact_sock(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("connection closed by peer")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
 
 
 def dumps(obj: Any) -> bytes:
@@ -67,7 +95,12 @@ class Connection:
         elif address[0] == "tcp":
             self.sock = socket.create_connection((address[1], address[2]))
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            send_auth(self.sock)
+            token = cluster_token()
+            if token is not None:
+                # Don't hang forever on a server that never challenges.
+                self.sock.settimeout(30.0)
+                _answer_challenge_sync(self.sock, token)
+                self.sock.settimeout(None)
         else:
             raise ValueError(f"unknown address scheme: {address!r}")
         if timeout is not None:
@@ -122,8 +155,17 @@ async def open_connection(address: Address):
         )
         token = cluster_token()
         if token is not None:
-            payload = _auth_blob(token)
-            writer.write(_LEN.pack(len(payload)) + payload)
+            header = await asyncio.wait_for(
+                reader.readexactly(_LEN.size), 30.0
+            )
+            (length,) = _LEN.unpack(header)
+            if length != len(_AUTH_MAGIC) + _NONCE_LEN:
+                raise ConnectionError("malformed auth challenge")
+            blob = await reader.readexactly(length)
+            if not blob.startswith(_AUTH_MAGIC):
+                raise ConnectionError("malformed auth challenge")
+            answer = _response(token, blob)
+            writer.write(_LEN.pack(len(answer)) + answer)
             await writer.drain()
         return reader, writer
     raise ValueError(f"unknown address scheme: {address!r}")
@@ -136,19 +178,30 @@ async def start_server(address: Address, handler):
         token = cluster_token()
 
         async def tcp_handler(reader, writer):
-            # Gate BEFORE any pickle touches peer bytes: first frame must
-            # be the bearer token; anything else drops the connection.
+            # Gate BEFORE any pickle touches peer bytes: challenge the
+            # peer with a nonce; the first frame back must be the keyed
+            # digest. 10 s auth deadline so half-open peers can't pin
+            # server tasks.
             if token is not None:
                 try:
-                    header = await reader.readexactly(_LEN.size)
+                    challenge = _challenge()
+                    writer.write(_LEN.pack(len(challenge)) + challenge)
+                    await writer.drain()
+                    header = await asyncio.wait_for(
+                        reader.readexactly(_LEN.size), 10.0
+                    )
                     (length,) = _LEN.unpack(header)
                     if length > 4096:
                         raise ConnectionError("oversized auth frame")
-                    blob = await reader.readexactly(length)
-                    if not hmac.compare_digest(blob, _auth_blob(token)):
-                        raise ConnectionError("bad cluster token")
+                    blob = await asyncio.wait_for(
+                        reader.readexactly(length), 10.0
+                    )
+                    expected = _response(token, challenge)
+                    if not hmac.compare_digest(blob, expected):
+                        raise ConnectionError("bad auth response")
                 except (
                     asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,
                     ConnectionError,
                     OSError,
                 ):

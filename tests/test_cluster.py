@@ -108,8 +108,10 @@ runtime.shutdown()
 
 def test_tcp_actor_requires_cluster_token(tmp_path, monkeypatch):
     """TCP endpoints speak pickle, so unauthenticated peers must be dropped
-    before their first frame is deserialized (transport.py bearer-token
-    hello); authorized handles work normally."""
+    before their first frame is deserialized. Auth is an HMAC
+    challenge-response (transport.py): the server sends a nonce and only a
+    peer holding the cluster secret can answer — the secret itself never
+    crosses the wire."""
     import pickle
     import socket
     import struct
@@ -122,22 +124,28 @@ def test_tcp_actor_requires_cluster_token(tmp_path, monkeypatch):
         Echo, runtime_dir=str(tmp_path), host="127.0.0.1"
     )
     try:
-        # Authorized: the handle's connection sends the token hello.
+        # Authorized: the handle answers the server's challenge.
         assert handle.call("echo", 41) == 41
 
-        # Unauthorized: raw frame without the hello -> connection dropped,
-        # no reply.
+        # Unauthorized: a peer that ignores the challenge and sends a raw
+        # request frame is dropped without a reply. The server's challenge
+        # frame must not contain the secret.
         _, host, port = handle.address
         sock = socket.create_connection((host, port), timeout=5)
         try:
+            sock.settimeout(5)
+            header = sock.recv(8)
+            (length,) = struct.unpack("<Q", header)
+            challenge = sock.recv(length)
+            assert challenge.startswith(b"RSDLAUTH")
+            assert b"sekrit-token" not in challenge  # secret stays local
             payload = pickle.dumps((1, "echo", (42,), {}, False))
             sock.sendall(struct.pack("<Q", len(payload)) + payload)
-            sock.settimeout(5)
             assert sock.recv(1) == b""  # server closed without answering
         finally:
             sock.close()
 
-        # Wrong token: also dropped.
+        # Wrong token: the digest won't verify; also dropped.
         monkeypatch.setenv("RSDL_CLUSTER_TOKEN", "wrong")
         from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
 
@@ -285,6 +293,59 @@ def test_cluster_scheduler_locality_choice(monkeypatch):
         )
         monkeypatch.setenv("RSDL_DISABLE_LOCALITY", "1")
         assert sched._locality_agent(refs) is None
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_confirms_death_before_evicting():
+    """A transient connection error (ActorHandle wraps every
+    ConnectionError/OSError into ActorDiedError) must NOT evict a live
+    host: the scheduler pings on a fresh connection and retries. Only an
+    unreachable agent is dropped (ADVICE r1, medium)."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorDiedError
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterScheduler
+
+    class FlakyAgent:
+        """First call hits a connection reset; the host is alive."""
+
+        address = ("tcp", "flaky", 1)
+
+        def __init__(self):
+            self.calls = 0
+
+        def call(self, method, *args):
+            self.calls += 1
+            if self.calls == 1:
+                raise ActorDiedError("transient reset")
+            return "ok"
+
+        def ping(self, timeout=None):
+            return True
+
+    class DeadAgent:
+        address = ("tcp", "dead", 1)
+
+        def call(self, method, *args):
+            raise ActorDiedError("down")
+
+        def ping(self, timeout=None):
+            return False
+
+    flaky = FlakyAgent()
+    sched = ClusterScheduler([flaky])
+    try:
+        ok, result = sched._submit_once(flaky, None, (), {})
+        assert ok and result == "ok"
+        assert sched.agent_addresses == {flaky.address}  # NOT evicted
+    finally:
+        sched.shutdown()
+
+    dead = DeadAgent()
+    sched = ClusterScheduler([flaky, dead])
+    try:
+        ok, _ = sched._submit_once(dead, None, (), {})
+        assert not ok
+        assert sched.agent_addresses == {flaky.address}  # dead one dropped
     finally:
         sched.shutdown()
 
