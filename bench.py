@@ -272,6 +272,11 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     labels0 = jax.device_put(jnp.zeros((BATCH_SIZE,), jnp.float32), bsh)
 
     def build_and_warm(use_pallas):
+        """Init state, jit the step, and execute one warm-up step — with
+        the warm-up batch placed exactly as real batches arrive
+        (committed, mesh-sharded): input sharding is part of the jit
+        cache key, so an uncommitted warm-up would leave the first timed
+        step to recompile. Returns the post-warm-up (state, step_fn)."""
         model = TabularDLRM(
             vocab_sizes={c: DATA_SPEC[c][1] for c in feature_columns},
             embed_dim=EMBED_DIM,
@@ -279,24 +284,64 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         )
         state, shardings = init_state(model, optimizer, mesh, example)
         step_fn = make_train_step(model, optimizer, mesh, shardings)
-        # Warm up compilation off the clock — with the warm-up batch placed
-        # exactly as real batches arrive (committed, mesh-sharded): input
-        # sharding is part of the jit cache key, so an uncommitted warm-up
-        # would leave the first timed step to recompile.
         state, _ = step_fn(state, example_dev, labels0)
         jax.block_until_ready(state.params)
         return state, step_fn
 
     # Auto: fused Pallas interaction on single-chip TPU, XLA reference
-    # elsewhere. The warm-up compile above exercises the kernel; if Mosaic
-    # rejects it on this runtime, fall back to the reference lowering
-    # rather than losing the round's number.
-    pallas_mode = "auto"
-    try:
-        state, step_fn = build_and_warm(None)
-    except Exception as exc:
-        _log(f"pallas warm-up failed ({exc!r:.200}); reference interaction")
-        pallas_mode = "fallback-reference"
+    # elsewhere. A Mosaic/libtpu compile failure must not cost the round
+    # its number — and a compile can HANG (wedged remote-compile helper),
+    # not just raise — so the pallas build runs on a watchdog thread with
+    # a hard deadline; on timeout or error the main process builds the
+    # reference-interaction step instead. The thread owns its OWN state
+    # (no donation race with the fallback's), and checks the abandoned
+    # flag before publishing so a late-completing compile frees its HBM
+    # immediately instead of pinning a dead duplicate for the whole run.
+    # RSDL_BENCH_PALLAS=off skips the attempt, =on disables the fallback.
+    pallas_env = os.environ.get("RSDL_BENCH_PALLAS", "auto")
+    pallas_mode = "off"
+    state = step_fn = None
+    if pallas_env != "off":
+        pallas_mode = "auto"
+        budget_s = float(os.environ.get("RSDL_BENCH_PALLAS_TIMEOUT_S", "300"))
+        box = {}
+        abandoned = threading.Event()
+
+        def _warm_pallas():
+            try:
+                result = build_and_warm(None)
+            except Exception as exc:  # noqa: BLE001 — recorded, fallback
+                box["error"] = exc
+                return
+            if not abandoned.is_set():
+                box["result"] = result
+            # else: drop the refs — state/executable free immediately.
+
+        warm_thread = threading.Thread(
+            target=_warm_pallas, name="pallas-warm", daemon=True
+        )
+        warm_thread.start()
+        warm_thread.join(budget_s)
+        if "result" not in box:
+            # Stop any later publish, then re-check: a result that landed
+            # in the gap is used; after the flag no publish can occur.
+            abandoned.set()
+        if "result" in box:
+            state, step_fn = box["result"]
+        elif pallas_env == "on":
+            raise RuntimeError(
+                f"pallas warm-up failed with RSDL_BENCH_PALLAS=on: "
+                f"{box.get('error', f'hung >{budget_s:.0f}s')!r}"
+            )
+        else:
+            why = (
+                f"{box['error']!r:.2000}"
+                if "error" in box
+                else f"hung >{budget_s:.0f}s (left on watchdog thread)"
+            )
+            _log(f"pallas warm-up failed ({why}); reference interaction")
+            pallas_mode = "fallback-reference"
+    if step_fn is None:
         state, step_fn = build_and_warm(False)
 
     ds = JaxShufflingDataset(
@@ -362,6 +407,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "total_s": round(total_s, 2),
         "loss": round(float(metrics["loss"]), 4),
         "num_chips": num_chips,
+        "host_cpus": os.cpu_count(),
         "backend": platform,
         "pallas": pallas_mode,
         "peak_hbm_gb": round(

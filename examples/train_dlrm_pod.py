@@ -73,6 +73,12 @@ def parse_args(argv=None):
         "--process-id", type=int, default=None, help=argparse.SUPPRESS
     )
     p.add_argument(
+        "--platform",
+        default=None,
+        help="Pin the JAX platform via the config API (e.g. 'cpu'; "
+        "JAX_PLATFORMS alone is overridden by experimental TPU plugins).",
+    )
+    p.add_argument(
         "--simulate-pod",
         type=int,
         default=None,
@@ -85,6 +91,12 @@ def parse_args(argv=None):
 
 def train_main(args) -> int:
     import jax
+
+    if args.platform:
+        # The config API, not JAX_PLATFORMS: experimental TPU plugins
+        # override the env var and would still try (and possibly hang on)
+        # accelerator bring-up in a CPU smoke run.
+        jax.config.update("jax_platforms", args.platform)
 
     # 1. Pod discovery. On Cloud TPU, initialize() needs no arguments.
     if args.coordinator:
@@ -118,7 +130,21 @@ def train_main(args) -> int:
 
     rdv = args.rendezvous_dir
     os.makedirs(rdv, exist_ok=True)
-    addr_file = os.path.join(rdv, "cluster_address")
+    # A persistent rendezvous dir may hold a PREVIOUS run's address file;
+    # ranks that matched on a bare filename could join a dead head. Scope
+    # the filename to THIS run with a nonce agreed over jax.distributed
+    # (broadcast from process 0) — stale files can never match it.
+    if world > 1:
+        from jax.experimental import multihost_utils
+
+        nonce = int(
+            multihost_utils.broadcast_one_to_all(
+                jnp.asarray(np.random.randint(0, 2**31), jnp.int32)
+            )
+        )
+        addr_file = os.path.join(rdv, f"cluster_address_{nonce}")
+    else:
+        addr_file = os.path.join(rdv, "cluster_address")
 
     # 2. Shuffle-runtime topology mirrors the pod: host 0 is the cluster
     #    head, everyone else joins over DCN.
@@ -188,23 +214,38 @@ def train_main(args) -> int:
 
     # 4. Train. Every process steps in lockstep on its shard of the global
     #    batch; collectives ride ICI. Ranks can receive different batch
-    #    counts (reducer outputs split by rank), so step counts are synced
-    #    per epoch before the jitted (collective) step runs.
+    #    counts (reducer outputs split by rank), and the jitted step is
+    #    collective — so each step is gated on an all-ranks-have-a-batch
+    #    sync. Batches STREAM through the prefetch ring (materializing a
+    #    whole epoch of device-resident batches would blow the HBM budget
+    #    on a real pod workload and serialize all H2D staging).
     from jax.experimental import multihost_utils
+
+    def _all_have_next(batch) -> bool:
+        flags = multihost_utils.process_allgather(
+            jnp.asarray([0 if batch is None else 1], jnp.int32)
+        ).reshape(-1)
+        return int(flags.min()) == 1
 
     steps_done = 0
     loss = float("nan")
     for epoch in range(args.epochs):
         ds.set_epoch(epoch)
-        batches = list(ds)
-        counts = multihost_utils.process_allgather(
-            jnp.asarray([len(batches)], jnp.int32)
-        ).reshape(-1)
-        steps = int(counts.min())
-        for features, label in batches[:steps]:
+        it = iter(ds)
+        steps = 0
+        batch = next(it, None)
+        while _all_have_next(batch):
+            features, label = batch
             state, metrics = step_fn(state, features, label)
+            steps += 1
             steps_done += 1
-        loss = float(metrics["loss"])
+            batch = next(it, None)
+        if steps:
+            loss = float(metrics["loss"])
+        # Drain any leftover (dropped) batches so their task_done acks
+        # release the epoch window for the next epoch.
+        while batch is not None:
+            batch = next(it, None)
         print(
             f"[pod] rank {rank}: epoch {epoch} done, "
             f"{steps} steps, loss {loss:.4f}",
@@ -249,6 +290,8 @@ def simulate_pod(args) -> int:
             str(args.batch_size),
             "--epochs",
             str(args.epochs),
+            "--platform",
+            args.platform or "cpu",
         ]
         env = dict(os.environ, RSDL_ADVERTISE_HOST="127.0.0.1")
         procs.append(subprocess.Popen(cmd, env=env))

@@ -58,7 +58,11 @@ class TabularDLRM(nn.Module):
                 (self.vocab_sizes[col], self.embed_dim),
                 jnp.float32,
             )
-            idx = features[col].reshape(-1)
+            # Hashing trick: fold ids into the table (a no-op when ids are
+            # in range). Without it, a capped vocab (``vocab_cap`` in
+            # tests/smoke runs) feeds out-of-range ids to ``jnp.take``,
+            # whose default OOB mode FILLS WITH NaN — poisoning the loss.
+            idx = features[col].reshape(-1) % self.vocab_sizes[col]
             embeds.append(
                 jnp.take(table, idx, axis=0).astype(self.compute_dtype)
             )

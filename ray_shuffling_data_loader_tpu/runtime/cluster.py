@@ -390,14 +390,24 @@ class ClusterScheduler:
         try:
             return True, agent.call("submit", fn, args, kwargs)
         except ActorDiedError:
-            if agent.ping(timeout=5.0):
-                try:
-                    # Alive — the error was a transient connection drop.
-                    # Task bodies are idempotent over the store, so a
-                    # retry after an ambiguous failure is safe.
-                    return True, agent.call("submit", fn, args, kwargs)
-                except ActorDiedError:
-                    pass
+            # Escalating ping ladder: a loaded-but-alive host can miss a
+            # single short ping (1-core CI saturates for seconds at a
+            # time), and false eviction is expensive — the scheduler
+            # unregisters the host, in-flight segments leak, and only the
+            # 10 s heartbeat re-admits it. A genuinely dead host fails
+            # each ping fast (connection refused), so the ladder costs
+            # almost nothing when it matters.
+            for ping_timeout in (5.0, 10.0, 20.0):
+                if agent.ping(timeout=ping_timeout):
+                    try:
+                        # Alive — the error was a transient connection
+                        # drop. Task bodies are idempotent over the
+                        # store, so a retry after an ambiguous failure
+                        # is safe.
+                        return True, agent.call("submit", fn, args, kwargs)
+                    except ActorDiedError:
+                        pass
+                    break
             self._drop_agent(agent)
             return False, None
 

@@ -94,13 +94,21 @@ class Connection:
             self.sock.connect(address[1])
         elif address[0] == "tcp":
             self.sock = socket.create_connection((address[1], address[2]))
-            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            token = cluster_token()
-            if token is not None:
-                # Don't hang forever on a server that never challenges.
-                self.sock.settimeout(30.0)
-                _answer_challenge_sync(self.sock, token)
-                self.sock.settimeout(None)
+            try:
+                self.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                token = cluster_token()
+                if token is not None:
+                    # Don't hang forever on a server that never challenges.
+                    self.sock.settimeout(30.0)
+                    _answer_challenge_sync(self.sock, token)
+                    self.sock.settimeout(None)
+            except BaseException:
+                # Auth/handshake failed: a retry loop in the actor layer
+                # must not accumulate leaked fds until EMFILE.
+                self.sock.close()
+                raise
         else:
             raise ValueError(f"unknown address scheme: {address!r}")
         if timeout is not None:
@@ -155,18 +163,24 @@ async def open_connection(address: Address):
         )
         token = cluster_token()
         if token is not None:
-            header = await asyncio.wait_for(
-                reader.readexactly(_LEN.size), 30.0
-            )
-            (length,) = _LEN.unpack(header)
-            if length != len(_AUTH_MAGIC) + _NONCE_LEN:
-                raise ConnectionError("malformed auth challenge")
-            blob = await reader.readexactly(length)
-            if not blob.startswith(_AUTH_MAGIC):
-                raise ConnectionError("malformed auth challenge")
-            answer = _response(token, blob)
-            writer.write(_LEN.pack(len(answer)) + answer)
-            await writer.drain()
+            try:
+                header = await asyncio.wait_for(
+                    reader.readexactly(_LEN.size), 30.0
+                )
+                (length,) = _LEN.unpack(header)
+                if length != len(_AUTH_MAGIC) + _NONCE_LEN:
+                    raise ConnectionError("malformed auth challenge")
+                blob = await reader.readexactly(length)
+                if not blob.startswith(_AUTH_MAGIC):
+                    raise ConnectionError("malformed auth challenge")
+                answer = _response(token, blob)
+                writer.write(_LEN.pack(len(answer)) + answer)
+                await writer.drain()
+            except BaseException:
+                # Close the transport on auth failure so retry loops don't
+                # leak fds / leave destroyed-task noise behind.
+                writer.close()
+                raise
         return reader, writer
     raise ValueError(f"unknown address scheme: {address!r}")
 

@@ -442,10 +442,29 @@ def test_locality_scheduling_cuts_cross_host_bytes(tmp_path):
     """Two-host cluster, skewed input ownership: locality-aware reduce
     placement must move materially fewer bytes across the DCN than pure
     round-robin (VERDICT r1 item 5)."""
-    rr = _run_locality_cluster(
-        tmp_path, "rr", {"RSDL_DISABLE_LOCALITY": "1"}
-    )
-    loc = _run_locality_cluster(tmp_path, "loc", {})
+    # With two healthy hosts and skewed ownership, EVERY healthy run moves
+    # bytes across hosts: round-robin reduce placement obviously, and the
+    # locality run too (file 1 maps on the worker, so even all-reduces-on-
+    # head still pulls that partition across). A measurement of 0 means
+    # the run degenerated — the worker host was evicted under CPU
+    # saturation and everything ran locally — which invalidates the
+    # comparison rather than informing it. Retry a couple of times before
+    # declaring the environment unusable.
+    def _measure(tag: str, extra_env: dict) -> int:
+        for attempt in range(3):
+            cross = _run_locality_cluster(
+                tmp_path, f"{tag}{attempt}", extra_env
+            )
+            if cross > 0:
+                return cross
+        pytest.skip(
+            f"cluster degenerated to a single host in every {tag!r} run "
+            "(CPU-saturated environment); locality comparison needs two "
+            "live hosts"
+        )
+
+    rr = _measure("rr", {"RSDL_DISABLE_LOCALITY": "1"})
+    loc = _measure("loc", {})
     assert loc < rr * 0.7, (
         f"locality={loc} bytes vs round-robin={rr} bytes — "
         "expected a >=30% cross-host reduction"
