@@ -305,6 +305,11 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         pallas_mode = "auto"
         budget_s = float(os.environ.get("RSDL_BENCH_PALLAS_TIMEOUT_S", "300"))
         box = {}
+        # One mutex serializes publish vs abandon: without it the thread
+        # could pass its flag check, get preempted, and publish AFTER the
+        # main thread chose the fallback — pinning a dead duplicate state
+        # in HBM for the whole run.
+        decision = threading.Lock()
         abandoned = threading.Event()
 
         def _warm_pallas():
@@ -313,19 +318,21 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             except Exception as exc:  # noqa: BLE001 — recorded, fallback
                 box["error"] = exc
                 return
-            if not abandoned.is_set():
-                box["result"] = result
-            # else: drop the refs — state/executable free immediately.
+            with decision:
+                if not abandoned.is_set():
+                    box["result"] = result
+                # else: drop the refs — state/executable free immediately.
 
         warm_thread = threading.Thread(
             target=_warm_pallas, name="pallas-warm", daemon=True
         )
         warm_thread.start()
         warm_thread.join(budget_s)
-        if "result" not in box:
-            # Stop any later publish, then re-check: a result that landed
-            # in the gap is used; after the flag no publish can occur.
-            abandoned.set()
+        with decision:
+            if "result" not in box:
+                # A result that landed before this point is used; after
+                # the flag no publish can occur.
+                abandoned.set()
         if "result" in box:
             state, step_fn = box["result"]
         elif pallas_env == "on":

@@ -96,7 +96,15 @@ class ShufflingDataset:
         seed: int = 0,
         queue_name: str = DEFAULT_QUEUE_NAME,
         start_epoch: int = 0,
+        narrow_to_32: bool = False,
     ):
+        """``narrow_to_32``: cast 64-bit columns to 32-bit at Parquet
+        decode time, inside the map tasks. Every downstream pass
+        (partition scatter, concat+permute, shared-memory residency,
+        cross-host fetch) then moves half the bytes. Only safe when
+        values fit (int32 ids / float32 labels) — the device path
+        (:class:`~.jax_dataset.JaxShufflingDataset`) turns it on because
+        it narrows to 32-bit at staging anyway."""
         runtime.ensure_initialized()
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
@@ -125,6 +133,7 @@ class ShufflingDataset:
                         num_trainers,
                         seed=seed,
                         start_epoch=start_epoch,
+                        narrow_to_32=narrow_to_32,
                     )
                 except BaseException as exc:  # surfaced at iterator end
                     result.error = exc

@@ -181,6 +181,9 @@ class JaxShufflingDataset:
             seed=seed,
             queue_name=queue_name,
             start_epoch=start_epoch,
+            # The device path narrows to 32-bit at staging regardless, so
+            # narrowing at decode halves every host-side pass for free.
+            narrow_to_32=True,
         )
         self._spec = JaxBatchSpec(
             feature_columns=feature_columns,
@@ -195,6 +198,7 @@ class JaxShufflingDataset:
         self.mesh = mesh
         self.batch_axis = batch_axis
         self._prefetch_depth = max(1, prefetch_depth)
+        self._unpack_cache: Dict[Any, Any] = {}
         self.stats = HostToDeviceStats()
 
     # -- spec application ---------------------------------------------------
@@ -209,31 +213,113 @@ class JaxShufflingDataset:
         return arr
 
     def _stage(self, cb: ColumnBatch):
-        """Convert one host batch and dispatch its async H2D transfer."""
+        """Convert one host batch and dispatch its async H2D transfer.
+
+        Fast path: when every column is a flat 4-byte-wide vector (the
+        DLRM norm after int64→int32 narrowing), the whole batch is packed
+        into ONE contiguous ``[n_cols, batch]`` int32 buffer and staged
+        with a single ``device_put``, then unpacked on-device by one
+        jitted computation. Per-column puts cost a fixed host↔device
+        round-trip each — over a high-latency link (e.g. a tunneled
+        device) 21 small puts per batch were ~10x slower than one big
+        one. Heterogeneous shapes/dtypes fall back to per-column staging.
+        """
         spec = self._spec
         host: Dict[str, np.ndarray] = {}
+        packable = True
         for col, dtype, shape in zip(
             spec.feature_columns, spec.feature_types, spec.feature_shapes
         ):
-            host[col] = self._device_view(cb[col], dtype, shape)
+            arr = self._device_view(cb[col], dtype, shape)
+            host[col] = arr
+            packable = packable and arr.ndim == 1 and arr.dtype.itemsize == 4
         label = self._device_view(
             cb[spec.label_column], spec.label_type, spec.label_shape
         )
+        packable = (
+            packable
+            and label.ndim == 1
+            and label.dtype.itemsize == 4
+            and len({a.shape[0] for a in host.values()} | {label.shape[0]})
+            == 1
+            # The on-device unpack is a jitted (SPMD-collective under
+            # multi-controller) computation; ranks stage at independent
+            # rates, so the packed path is single-process only.
+            and jax.process_count() == 1
+        )
 
         t0 = time.perf_counter()
-        features = {}
-        nbytes = 0
-        for col, arr in host.items():
-            features[col] = self._put(arr)
-            nbytes += arr.nbytes
-        label_arr = self._put(label)
-        nbytes += label.nbytes
+        if packable:
+            features, label_arr, nbytes = self._stage_packed(host, label)
+        else:
+            features = {}
+            nbytes = 0
+            for col, arr in host.items():
+                features[col] = self._put(arr)
+                nbytes += arr.nbytes
+            label_arr = self._put(label)
+            nbytes += label.nbytes
         self.stats.put_dispatch_s += time.perf_counter() - t0
         self.stats.bytes_staged += nbytes
         self.stats.batches_staged += 1
         if self.stats.batches_staged % 8 == 0:
             self.stats.sample_device_memory()
         return features, label_arr
+
+    def _stage_packed(self, host: Dict[str, np.ndarray], label: np.ndarray):
+        """One transfer for the whole batch: bit-pack all 4-byte columns
+        as int32 rows of a ``[n_cols+1, batch]`` buffer (float rows are
+        bitcast back on device)."""
+        names = tuple(host)
+        batch = label.shape[0]
+        packed = np.empty((len(names) + 1, batch), np.int32)
+        for i, name in enumerate(names):
+            packed[i] = host[name].view(np.int32)
+        packed[-1] = label.view(np.int32)
+        sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
+        packed_dev = jax.device_put(packed, sharding)
+        unpack = self._get_unpack(
+            names,
+            tuple(str(host[n].dtype) for n in names),
+            str(label.dtype),
+        )
+        features, label_arr = unpack(packed_dev)
+        return features, label_arr, packed.nbytes
+
+    def _get_unpack(self, names, dtypes, label_dtype):
+        """Jitted on-device unpack for the packed layout: row slices +
+        bitcasts, executed as ONE device computation (a single dispatch
+        round-trip, vs one per column)."""
+        key = (names, dtypes, label_dtype)
+        fn = self._unpack_cache.get(key)
+        if fn is None:
+            row_sharding = NamedSharding(self.mesh, P(self.batch_axis))
+
+            def unpack(packed):
+                feats = {}
+                for i, (name, dt) in enumerate(zip(names, dtypes)):
+                    row = packed[i]
+                    if dt != "int32":
+                        row = jax.lax.bitcast_convert_type(
+                            row, jnp.dtype(dt)
+                        )
+                    feats[name] = row
+                lab = packed[-1]
+                if label_dtype != "int32":
+                    lab = jax.lax.bitcast_convert_type(
+                        lab, jnp.dtype(label_dtype)
+                    )
+                return feats, lab
+
+            fn = jax.jit(
+                unpack,
+                out_shardings=(
+                    {name: row_sharding for name in names},
+                    row_sharding,
+                ),
+            )
+            self._unpack_cache[key] = fn
+        return fn
 
     def _put(self, arr: np.ndarray):
         sharding = NamedSharding(

@@ -25,8 +25,9 @@ pytestmark = pytest.mark.skipif(
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _TPU_SCRIPT = r"""
+import os
 import sys
-sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.environ["RSDL_TEST_REPO"])
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -69,8 +70,11 @@ print("TPU_OPS_OK", err, gerr)
 def test_pallas_compiled_on_tpu():
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the TPU plugin come up
+    # The repo path rides an env var: the script body contains f-strings,
+    # so str.format would mangle their braces.
+    env["RSDL_TEST_REPO"] = _REPO
     proc = subprocess.run(
-        [sys.executable, "-c", _TPU_SCRIPT.format(repo=_REPO)],
+        [sys.executable, "-c", _TPU_SCRIPT],
         capture_output=True,
         text=True,
         timeout=600,
