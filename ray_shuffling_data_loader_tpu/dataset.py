@@ -97,6 +97,8 @@ class ShufflingDataset:
         queue_name: str = DEFAULT_QUEUE_NAME,
         start_epoch: int = 0,
         narrow_to_32: bool = False,
+        cache_decoded: Optional[bool] = None,
+        stats_collector=None,
     ):
         """``narrow_to_32``: cast 64-bit columns to 32-bit at Parquet
         decode time, inside the map tasks. Every downstream pass
@@ -134,6 +136,8 @@ class ShufflingDataset:
                         seed=seed,
                         start_epoch=start_epoch,
                         narrow_to_32=narrow_to_32,
+                        cache_decoded=cache_decoded,
+                        stats_collector=stats_collector,
                     )
                 except BaseException as exc:  # surfaced at iterator end
                     result.error = exc

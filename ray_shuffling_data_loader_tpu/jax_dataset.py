@@ -168,6 +168,8 @@ class JaxShufflingDataset:
         batch_axis: str = "data",
         prefetch_depth: int = 2,
         start_epoch: int = 0,
+        cache_decoded: Optional[bool] = None,
+        stats_collector=None,
     ):
         self._ds = ShufflingDataset(
             filenames,
@@ -184,6 +186,8 @@ class JaxShufflingDataset:
             # The device path narrows to 32-bit at staging regardless, so
             # narrowing at decode halves every host-side pass for free.
             narrow_to_32=True,
+            cache_decoded=cache_decoded,
+            stats_collector=stats_collector,
         )
         self._spec = JaxBatchSpec(
             feature_columns=feature_columns,

@@ -34,6 +34,7 @@ numpy RNG, ``shuffle.py:156,194``) and which the exactly-once tests rely on.
 
 from __future__ import annotations
 
+import os
 import threading
 import timeit
 from typing import List, Optional, Sequence
@@ -124,27 +125,58 @@ def shuffle_map(
     seed: int,
     stats_collector=None,
     narrow_to_32: bool = False,
-) -> List[ObjectRef]:
+    cache_ref: Optional[ObjectRef] = None,
+    publish_cache: bool = False,
+):
     """Map stage: load one file, randomly partition its rows across reducers.
 
     Returns ``num_reducers`` store refs (reference ``shuffle_map`` returns
-    ``num_returns=num_reducers`` object refs, ``shuffle.py:129-168``).
+    ``num_returns=num_reducers`` object refs, ``shuffle.py:129-168``) —
+    or, with ``publish_cache``, the tuple ``(refs, decoded_cache_ref)``.
 
     ``narrow_to_32`` casts 64-bit columns to 32-bit right after decode —
     one extra cheap pass here so the partition scatter, reduce
     concat+permute, store residency, and DCN fetches all move half the
     bytes. Integer columns are range-checked (a ValueError beats silent
     wraparound); float columns narrow lossily by design.
+
+    Decode caching (no reference analog — the reference re-decodes every
+    file every epoch): with ``publish_cache`` the decoded (and narrowed)
+    columns are also written once to the store and the ref returned;
+    later epochs pass it back as ``cache_ref`` and partition straight
+    from the mmapped segment, skipping Parquet decode entirely.
     """
     if stats_collector is not None:
         stats_collector.call_oneway("map_start", epoch)
     start = timeit.default_timer()
     ctx = runtime.ensure_initialized()
-    batch = read_parquet_columns(filename)
-    if narrow_to_32:
-        batch = ColumnBatch(
-            {k: _narrow_column(k, v) for k, v in batch.columns.items()}
-        )
+    new_cache_ref = None
+    if cache_ref is not None:
+        batch = ctx.store.get_columns(cache_ref)
+    else:
+        batch = read_parquet_columns(filename)
+        if narrow_to_32:
+            batch = ColumnBatch(
+                {k: _narrow_column(k, v) for k, v in batch.columns.items()}
+            )
+        if publish_cache:
+            # The cache is purely an optimization: a failed publish
+            # (ENOSPC etc.) degrades to plain per-epoch decode — it must
+            # never sink the run (claim_or_wait treats a None ref as
+            # "decode yourself").
+            try:
+                cache_pending = ctx.store.create_columns(
+                    {k: (v.shape, v.dtype) for k, v in batch.columns.items()}
+                )
+                try:
+                    for k, v in batch.columns.items():
+                        np.copyto(cache_pending.columns[k], v)
+                    new_cache_ref = cache_pending.seal()
+                finally:
+                    cache_pending.abort()
+                del cache_pending
+            except Exception:
+                new_cache_ref = None
     end_read = timeit.default_timer()
 
     # Any file size is legal, including n < num_reducers (some reducers
@@ -178,11 +210,14 @@ def shuffle_map(
         # a successful publish.
         pending.abort()
     del pending  # drop writable views before readers map the segment
+    del batch  # drop (possibly mmapped-cache) views before returning
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway(
             "map_done", epoch, duration, end_read - start
         )
+    if publish_cache:
+        return refs, new_cache_ref
     return refs
 
 
@@ -244,6 +279,82 @@ def shuffle_reduce(
 # ---------------------------------------------------------------------------
 
 
+class _DecodeCache:
+    """Driver-side registry of per-file decoded-column cache refs.
+
+    The FIRST epoch to submit a map for file ``i`` claims publishing; a
+    later epoch's submission blocks on that map's future (same-file
+    chaining only — its data cannot exist earlier anyway) and partitions
+    from the cached segment instead of re-decoding Parquet.
+    """
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._futs: dict = {}  # file index -> publishing map TaskFuture
+
+    def claim_or_wait(self, index: int):
+        """Returns ``(cache_ref, publish)`` for file ``index``: the first
+        caller gets ``(None, True)``; later callers block until the
+        publisher's map resolves and get ``(ref, False)``. A publisher
+        failure (its retry will have published nothing) degrades to
+        plain decode."""
+        if not self.enabled:
+            return None, False
+        with self._lock:
+            fut = self._futs.get(index)
+            if fut is None:
+                return None, True
+        try:
+            _, ref = fut.result()
+            return ref, False
+        except Exception:
+            return None, False
+
+    def register(self, index: int, fut) -> None:
+        with self._lock:
+            self._futs[index] = fut
+
+    def free_all(self) -> None:
+        refs = []
+        with self._lock:
+            futs, self._futs = dict(self._futs), {}
+        for fut in futs.values():
+            try:
+                _, ref = fut.result()
+                if ref is not None:
+                    refs.append(ref)
+            except Exception:
+                pass
+        if refs:
+            try:
+                runtime.get_context().store.free(refs)
+            except Exception:
+                pass
+
+
+def _decode_cache_auto(filenames: List[str], num_epochs: int) -> bool:
+    """Auto policy: cache when more than one epoch will read the files AND
+    the (roughly estimated) decoded size fits comfortably inside the
+    store's capacity budget alongside ~2 epochs of in-flight shuffle
+    state. Snappy DATA_SPEC expands ~3.6x on decode; 6x is the
+    conservative planning factor, and a wrong guess only shifts segments
+    into the spill tier rather than breaking anything. When the budget is
+    unknowable (``capacity_bytes`` None — budgeting disabled, statvfs
+    failure, or spill dir on the same tmpfs), there IS no spill tier to
+    absorb a wrong guess, so auto stays off."""
+    if num_epochs < 2:
+        return False
+    try:
+        est = sum(os.path.getsize(f) for f in filenames) * 6
+    except OSError:
+        return False
+    cap = runtime.get_context().store.capacity_bytes
+    if cap is None:
+        return False
+    return est < 0.35 * cap
+
+
 def shuffle_epoch(
     epoch: int,
     filenames: List[str],
@@ -253,6 +364,7 @@ def shuffle_epoch(
     seed: int = 0,
     stats_collector=None,
     narrow_to_32: bool = False,
+    decode_cache: Optional[_DecodeCache] = None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
 
@@ -267,9 +379,13 @@ def shuffle_epoch(
     # Cluster mode scatters stages across every host's workers; single-host
     # falls back to the local pool (same submit surface).
     pool = runtime.get_context().scheduler
-    map_futs: List[TaskFuture] = [
-        pool.submit(
-            shuffle_map,
+    if decode_cache is None:
+        decode_cache = _DecodeCache(enabled=False)
+    map_futs: List[TaskFuture] = []
+    map_published: List[bool] = []
+    for i, fname in enumerate(filenames):
+        cache_ref, publish = decode_cache.claim_or_wait(i)
+        args = (
             fname,
             i,
             num_reducers,
@@ -277,9 +393,19 @@ def shuffle_epoch(
             seed,
             stats_collector,
             narrow_to_32,
+            cache_ref,
+            publish,
         )
-        for i, fname in enumerate(filenames)
-    ]
+        if cache_ref is not None:
+            # Locality: run the map on the host that owns the cached
+            # decode (cluster mode; the local pool ignores the hint).
+            fut = pool.submit_local_to([cache_ref], shuffle_map, *args)
+        else:
+            fut = pool.submit(shuffle_map, *args)
+        if publish:
+            decode_cache.register(i, fut)
+        map_futs.append(fut)
+        map_published.append(publish)
 
     # Rank assignment: contiguous split of reducer indices across trainers
     # (reference np.array_split, shuffle.py:125).
@@ -296,7 +422,11 @@ def shuffle_epoch(
         done_ranks = set()
         try:
             # Wait for all maps (reduce needs one partition per mapper).
-            per_file_refs = [f.result() for f in map_futs]
+            # Publishing maps return (refs, cache_ref); unwrap those.
+            per_file_refs = [
+                f.result()[0] if pub else f.result()
+                for f, pub in zip(map_futs, map_published)
+            ]
             # Locality: each reduce runs on the host already holding the
             # most of its input-partition rows (cluster mode; the local
             # pool ignores the hint). Ray gets this from its scheduler;
@@ -388,6 +518,7 @@ def shuffle(
     stats_collector=None,
     start_epoch: int = 0,
     narrow_to_32: bool = False,
+    cache_decoded: Optional[bool] = None,
 ) -> float:
     """Shuffle the dataset every epoch; returns total wall-clock duration.
 
@@ -396,11 +527,18 @@ def shuffle(
     launch that epoch's map/reduce/delivery pipeline. ``start_epoch`` skips
     fully-consumed epochs when resuming from a checkpoint (epoch indices
     stay absolute so per-epoch permutations match the original run).
+
+    ``cache_decoded``: keep each file's decoded columns in the store after
+    the first epoch so later epochs skip Parquet decode (None = auto:
+    on when multiple epochs run and the estimate fits the store budget).
     """
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
         raise ValueError("no input files to shuffle")
     runtime.ensure_initialized()
+    if cache_decoded is None:
+        cache_decoded = _decode_cache_auto(filenames, num_epochs - start_epoch)
+    decode_cache = _DecodeCache(enabled=cache_decoded)
     start = timeit.default_timer()
     threads = []
     for epoch in range(start_epoch, num_epochs):
@@ -422,10 +560,12 @@ def shuffle(
                 seed=seed,
                 stats_collector=stats_collector,
                 narrow_to_32=narrow_to_32,
+                decode_cache=decode_cache,
             )
         )
     for t in threads:
         t.join()
+    decode_cache.free_all()
     batch_consumer.wait_until_all_epochs_done()
     for t in threads:
         if t.error is not None:

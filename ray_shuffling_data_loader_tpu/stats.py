@@ -415,6 +415,13 @@ class TrialStatsCollector:
                 return False
         return True
 
+    def snapshot(self) -> TrialStats:
+        """Current stats without awaiting completion — for callers (like
+        the repo bench) that drive consumption themselves and never send
+        ``consume`` records, which ``get_stats`` would wait for."""
+        self.stats.epochs = [self._epochs[e] for e in sorted(self._epochs)]
+        return self.stats
+
     async def get_stats(self, timeout: Optional[float] = None) -> TrialStats:
         """Await trial completion — the done signal AND every per-task report
         (oneway frames from worker connections may trail ``trial_done``) —
