@@ -33,6 +33,7 @@ if /dev/shm headroom demands it. Generated Parquet is cached under
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -304,10 +305,19 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # flag before publishing so a late-completing compile frees its HBM
     # immediately instead of pinning a dead duplicate for the whole run.
     # RSDL_BENCH_PALLAS=off skips the attempt, =on disables the fallback.
+    # Loader-isolation mode (reference --mock-train-step-time,
+    # ray_torch_shuffle.py:214): the train step is a fixed sleep, so skip
+    # model build + compile + warm-up entirely — they would cost ~10 s of
+    # startup (CPU backend) to produce a step_fn the loop never calls.
+    mock_step_env = os.environ.get("RSDL_BENCH_MOCK_STEP_S")
+    mock_step_s = float(mock_step_env) if mock_step_env else None
+
     pallas_env = os.environ.get("RSDL_BENCH_PALLAS", "auto")
     pallas_mode = "off"
     state = step_fn = None
-    if pallas_env != "off":
+    if mock_step_s is not None:
+        pallas_mode = "mocked-step"
+    elif pallas_env != "off":
         pallas_mode = "auto"
         budget_s = float(os.environ.get("RSDL_BENCH_PALLAS_TIMEOUT_S", "300"))
         box = {}
@@ -354,7 +364,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             )
             _log(f"pallas warm-up failed ({why}); reference interaction")
             pallas_mode = "fallback-reference"
-    if step_fn is None:
+    if step_fn is None and mock_step_s is None:
         state, step_fn = build_and_warm(False)
 
     from ray_shuffling_data_loader_tpu.stats import TrialStatsCollector
@@ -402,12 +412,16 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         ds.set_epoch(epoch)
         for features, label in ds:
             t0 = time.perf_counter()
-            state, metrics = step_fn(state, features, label)
-            jax.block_until_ready(state.step)
+            if mock_step_s is not None:
+                time.sleep(mock_step_s)
+            else:
+                state, metrics = step_fn(state, features, label)
+                jax.block_until_ready(state.step)
             step_time += time.perf_counter() - t0
             num_steps += 1
     total_s = time.perf_counter() - t_start
-    jax.block_until_ready(state.params)
+    if state is not None:
+        jax.block_until_ready(state.params)
     if profile_dir:
         jax.profiler.stop_trace()
     sampler.stop()
@@ -458,11 +472,17 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "steps": num_steps,
         "step_time_s": round(step_time, 2),
         "total_s": round(total_s, 2),
-        "loss": round(float(metrics["loss"]), 4),
+        # None (-> JSON null) when no real step ran: json.dumps would
+        # otherwise emit the literal NaN, which strict parsers reject.
+        "loss": (
+            round(float(metrics["loss"]), 4)
+            if math.isfinite(float(metrics["loss"]))
+            else None
+        ),
         "num_chips": num_chips,
         "host_cpus": os.cpu_count(),
         "backend": platform,
-        "pallas": pallas_mode,
+        "pallas": pallas_mode if mock_step_s is None else "mocked-step",
         "peak_hbm_gb": round(
             stats.get("peak_device_bytes_in_use", 0) / 1e9, 3
         ),

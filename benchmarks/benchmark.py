@@ -171,6 +171,8 @@ def run_trial(
                 args.num_trainers,
                 seed=args.seed + trial,
                 stats_collector=collector,
+                narrow_to_32=args.narrow_to_32,
+                cache_decoded=args.cache_decoded,
             )
     else:
         duration = shuffle(
@@ -180,6 +182,8 @@ def run_trial(
             args.num_reducers,
             args.num_trainers,
             seed=args.seed + trial,
+            narrow_to_32=args.narrow_to_32,
+            cache_decoded=args.cache_decoded,
         )
     print(
         f"Trial {trial} done in {duration:.2f}s "
@@ -226,6 +230,27 @@ def parse_args(argv=None):
     p.add_argument("--no-overwrite-stats", action="store_true")
     p.add_argument("--store-stats-sample-period", type=float, default=5.0)
     p.add_argument("--num-workers", type=int, default=None)
+    p.add_argument(
+        "--narrow-to-32",
+        action="store_true",
+        help="Cast 64-bit columns to 32-bit at decode (halves bytes "
+        "through every shuffle pass; ids must fit int32).",
+    )
+    cache = p.add_mutually_exclusive_group()
+    cache.add_argument(
+        "--cache-decoded",
+        dest="cache_decoded",
+        action="store_true",
+        default=None,
+        help="Keep decoded columns in the store across epochs "
+        "(default: auto by store budget).",
+    )
+    cache.add_argument(
+        "--no-cache-decoded",
+        dest="cache_decoded",
+        action="store_false",
+        help="Force per-epoch Parquet decode.",
+    )
     p.add_argument(
         "--address",
         type=str,

@@ -1,0 +1,54 @@
+"""Contract test for the repo bench: ``bench.py`` must print exactly one
+parseable JSON line with the driver-required keys, even when the
+accelerator is unreachable (CPU failover).
+
+The bench is the round's key artifact (round 1 was lost to a bring-up
+crash); this pins its output contract. Mock-step mode keeps it fast —
+a real 250k-row DLRM step on the CPU backend costs ~10 s each.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline", "backend"}
+
+
+def test_bench_emits_contract_json(tmp_path):
+    env = dict(
+        os.environ,
+        # Skip the (possibly hung) accelerator probe entirely: one
+        # attempt with a tiny timeout, then CPU failover.
+        RSDL_BENCH_INIT_ATTEMPTS="1",
+        RSDL_BENCH_INIT_TIMEOUT_S="5",
+        RSDL_BENCH_CPU_GB="0.01",
+        RSDL_BENCH_EPOCHS="1",
+        RSDL_BENCH_MOCK_STEP_S="0.01",
+        # One step compile is enough for the contract; the watchdog
+        # thread's second lowering would double the test's wall time.
+        RSDL_BENCH_PALLAS="off",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=str(tmp_path),  # .bench_cache is keyed by CACHE_DIR (abs), ok
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [
+        line for line in proc.stdout.splitlines() if line.startswith("{")
+    ]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {proc.stdout!r}"
+    result = json.loads(lines[0])
+    assert REQUIRED_KEYS <= set(result), result
+    assert result["unit"] == "GB/s/chip"
+    assert result["value"] > 0, result
+    assert "error" not in result, result
+    # Failover must be recorded when the accelerator never came up.
+    if result["backend"] == "cpu":
+        assert "tpu_error" in result, result

@@ -179,3 +179,38 @@ def test_train_on_staged_batches(local_runtime, jax_files):
     assert len(losses) == 4096 // 512
     assert all(np.isfinite(l) for l in losses)
     assert int(state.step) == len(losses)
+
+
+def test_packed_staging_float_features(local_runtime, tmp_path):
+    """The packed H2D path bit-packs float32 columns as int32 rows and
+    bitcasts them back on device — values must round-trip exactly."""
+    import jax
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+
+    filenames, _ = generate_data(4000, 2, 1, 0.0, str(tmp_path / "data"))
+    ds = JaxShufflingDataset(
+        filenames,
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=1000,
+        rank=0,
+        # 'labels' is float64 on disk -> float32 on device: route one
+        # float column through the FEATURE side to hit the bitcast.
+        feature_columns=["embeddings_name0", "labels"],
+        label_column="key",
+        seed=3,
+        queue_name="packed-float",
+    )
+    ds.set_epoch(0)
+    seen_keys = []
+    for features, label in ds:
+        assert features["labels"].dtype == np.float32
+        assert features["embeddings_name0"].dtype == np.int32
+        vals = np.asarray(features["labels"])
+        assert np.isfinite(vals).all()
+        assert (vals >= 0).all() and (vals <= 1).all()
+        seen_keys.extend(np.asarray(label).tolist())
+    assert sorted(seen_keys) == list(range(4000))
