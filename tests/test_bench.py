@@ -20,16 +20,20 @@ REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline", "backend"}
 def test_bench_emits_contract_json(tmp_path):
     env = dict(
         os.environ,
-        # Skip the (possibly hung) accelerator probe entirely: one
-        # attempt with a tiny timeout, then CPU failover.
+        # Force the failover path DETERMINISTICALLY, independent of this
+        # host's accelerator state: the probe subprocess inherits a bogus
+        # platform and must fail, after which the bench pins CPU itself.
+        # (Without this, the test's outcome would depend on whether a
+        # TPU plugin happens to be present/healthy/wedged.)
+        JAX_PLATFORMS="rsdl_no_such_platform",
         RSDL_BENCH_INIT_ATTEMPTS="1",
-        RSDL_BENCH_INIT_TIMEOUT_S="5",
+        RSDL_BENCH_INIT_TIMEOUT_S="30",
+        RSDL_BENCH_GB="0.01",
         RSDL_BENCH_CPU_GB="0.01",
         RSDL_BENCH_EPOCHS="1",
+        # Mock mode bypasses model build/compile/warm-up entirely; the
+        # contract under test is the JSON line, not the train step.
         RSDL_BENCH_MOCK_STEP_S="0.01",
-        # One step compile is enough for the contract; the watchdog
-        # thread's second lowering would double the test's wall time.
-        RSDL_BENCH_PALLAS="off",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],

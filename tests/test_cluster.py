@@ -520,3 +520,79 @@ def test_two_host_cluster_shuffle(tmp_path):
         f"head output:\n{head_out}\n--- worker output:\n{worker_out}"
     )
     assert "joined" in worker_out, worker_out
+
+
+CACHE_HEAD_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime, ShufflingDataset
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+ctx = runtime.init_cluster(advertise_host="127.0.0.1", num_workers=2)
+with open({addr_file!r} + ".tmp", "w") as f:
+    f.write(ctx.cluster.address)
+os.rename({addr_file!r} + ".tmp", {addr_file!r})
+deadline = time.time() + 60
+while len(ctx.cluster.registry.call("hosts")) < 2:
+    if time.time() > deadline:
+        print("VERDICT: FAIL worker never joined", flush=True)
+        sys.exit(1)
+    time.sleep(0.2)
+filenames, _ = generate_data(
+    num_rows=300000, num_files=6, num_row_groups_per_file=1,
+    max_row_group_skew=0.0, data_dir={data_dir!r},
+)
+ds = ShufflingDataset(
+    filenames, num_epochs=2, num_trainers=1, batch_size=50000, rank=0,
+    num_reducers=4, seed=23, queue_name="ccd-test",
+    narrow_to_32=True, cache_decoded=True,
+)
+ok = True
+for epoch in range(2):
+    ds.set_epoch(epoch)
+    keys = sorted(k for b in ds for k in b["key"].tolist())
+    if keys != list(range(300000)):
+        ok = False
+print("VERDICT: " + ("PASS" if ok else "FAIL"), flush=True)
+runtime.shutdown()
+"""
+
+
+def test_cluster_decode_cache_exactly_once(tmp_path):
+    """Two-host cluster with 32-bit narrowing AND the cross-epoch decode
+    cache: later-epoch maps are locality-steered to the cache's owner and
+    may fetch it over the (loopback) DCN — every row must still arrive
+    exactly once per epoch."""
+    addr_file = str(tmp_path / "head_address_cache")
+    data_dir = str(tmp_path / "data_cache")
+    env = dict(
+        os.environ, RSDL_ADVERTISE_HOST="127.0.0.1", JAX_PLATFORMS="cpu"
+    )
+    head_log = tmp_path / "head_cache.log"
+    worker_log = tmp_path / "worker_cache.log"
+    with open(head_log, "w") as hf, open(worker_log, "w") as wf:
+        head = subprocess.Popen(
+            [sys.executable, "-c", CACHE_HEAD_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file, data_dir=data_dir
+            )],
+            stdout=hf, stderr=subprocess.STDOUT, env=env,
+        )
+        worker = subprocess.Popen(
+            [sys.executable, "-c", WORKER_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file
+            )],
+            stdout=wf, stderr=subprocess.STDOUT, env=env,
+        )
+        try:
+            head.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            pass
+        finally:
+            head.kill()
+            worker.kill()
+            head.wait()
+            worker.wait()
+    out = head_log.read_text()
+    assert "VERDICT: PASS" in out, (
+        f"head:\n{out}\n--- worker:\n{worker_log.read_text()}"
+    )
