@@ -66,6 +66,23 @@ def _mean(xs) -> float:
     return float(sum(xs) / len(xs)) if xs else 0.0
 
 
+METRIC = "Shuffle GB/s/chip + trainer stall % on synthetic Parquet"
+
+
+def _error_result(platform, msg: str) -> dict:
+    """The failure shape of the one-JSON-line contract (shared by the
+    stall watchdog and main()'s last-resort handler so the contract has
+    exactly one definition)."""
+    return {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "GB/s/chip",
+        "vs_baseline": 0.0,
+        "backend": platform,
+        "error": msg[:300],
+    }
+
+
 # -- hardened backend bring-up ----------------------------------------------
 
 
@@ -407,6 +424,47 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
+    # Mid-run stall watchdog: the accelerator tunnel can wedge AFTER
+    # bring-up (observed: device_put/step hang indefinitely mid-session).
+    # A hung bench loses the round's number entirely — the watchdog
+    # prints a machine-readable error JSON and exits instead. The
+    # timeout is per-batch progress, sized to survive a full cold epoch
+    # gap on a slow host.
+    stall_timeout_s = float(
+        os.environ.get("RSDL_BENCH_STALL_TIMEOUT_S", "900")
+    )
+    last_progress = [time.monotonic()]
+
+    check_s = min(30.0, max(1.0, stall_timeout_s / 4))
+
+    def _stall_watchdog():
+        while True:
+            time.sleep(check_s)
+            idle = time.monotonic() - last_progress[0]
+            if idle > stall_timeout_s:
+                print(
+                    json.dumps(
+                        _error_result(
+                            platform,
+                            f"no batch progress for {idle:.0f}s "
+                            "(accelerator wedged mid-run?); watchdog exit",
+                        )
+                    ),
+                    flush=True,
+                )
+                if profile_dir:
+                    # The trace of the wedged run is the one artifact
+                    # that shows WHERE it wedged; flush it if possible.
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:
+                        pass
+                os._exit(0)  # the JSON line IS the contract; rc!=0 reads as a crash
+
+    threading.Thread(
+        target=_stall_watchdog, name="stall-watchdog", daemon=True
+    ).start()
+
     t_start = time.perf_counter()
     step_time = 0.0
     num_steps = 0
@@ -422,7 +480,11 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 jax.block_until_ready(state.step)
             step_time += time.perf_counter() - t0
             num_steps += 1
+            last_progress[0] = time.monotonic()
     total_s = time.perf_counter() - t_start
+    # Disarm the watchdog: the measured region is over, and a second JSON
+    # line racing the real one would break the one-line contract.
+    last_progress[0] = float("inf")
     if state is not None:
         jax.block_until_ready(state.params)
     if profile_dir:
@@ -463,7 +525,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     target = 0.8 * peak_gbps
 
     result = {
-        "metric": "Shuffle GB/s/chip + trainer stall % on synthetic Parquet",
+        "metric": METRIC,
         "value": round(pipeline_gbps, 4),
         "unit": "GB/s/chip",
         "vs_baseline": round(pipeline_gbps / target, 4) if target else 0.0,
@@ -505,16 +567,7 @@ def main() -> None:
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        result = {
-            "metric": (
-                "Shuffle GB/s/chip + trainer stall % on synthetic Parquet"
-            ),
-            "value": 0.0,
-            "unit": "GB/s/chip",
-            "vs_baseline": 0.0,
-            "backend": platform,
-            "error": f"{type(exc).__name__}: {exc}"[:300],
-        }
+        result = _error_result(platform, f"{type(exc).__name__}: {exc}")
         if tpu_error is not None:
             result["tpu_error"] = str(tpu_error)[:300]
     print(json.dumps(result), flush=True)
