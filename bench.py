@@ -433,6 +433,8 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     stall_timeout_s = float(
         os.environ.get("RSDL_BENCH_STALL_TIMEOUT_S", "900")
     )
+    # <= 0 disables the watchdog (the conventional env-knob off switch).
+    watchdog_enabled = math.isfinite(stall_timeout_s) and stall_timeout_s > 0
     last_progress = [time.monotonic()]
 
     check_s = min(30.0, max(1.0, stall_timeout_s / 4))
@@ -442,16 +444,14 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             time.sleep(check_s)
             idle = time.monotonic() - last_progress[0]
             if idle > stall_timeout_s:
-                print(
-                    json.dumps(
-                        _error_result(
-                            platform,
-                            f"no batch progress for {idle:.0f}s "
-                            "(accelerator wedged mid-run?); watchdog exit",
-                        )
-                    ),
-                    flush=True,
+                result = _error_result(
+                    platform,
+                    f"no batch progress for {idle:.0f}s "
+                    "(accelerator wedged mid-run?); watchdog exit",
                 )
+                if tpu_error is not None:
+                    result["tpu_error"] = str(tpu_error)[:300]
+                print(json.dumps(result), flush=True)
                 if profile_dir:
                     # The trace of the wedged run is the one artifact
                     # that shows WHERE it wedged; flush it if possible.
@@ -461,9 +461,10 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                         pass
                 os._exit(0)  # the JSON line IS the contract; rc!=0 reads as a crash
 
-    threading.Thread(
-        target=_stall_watchdog, name="stall-watchdog", daemon=True
-    ).start()
+    if watchdog_enabled:
+        threading.Thread(
+            target=_stall_watchdog, name="stall-watchdog", daemon=True
+        ).start()
 
     t_start = time.perf_counter()
     step_time = 0.0
@@ -482,9 +483,10 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             num_steps += 1
             last_progress[0] = time.monotonic()
     total_s = time.perf_counter() - t_start
-    # Disarm the watchdog: the measured region is over, and a second JSON
-    # line racing the real one would break the one-line contract.
-    last_progress[0] = float("inf")
+    # Finalization below (device sync, profiler stop, stats snapshot) can
+    # wedge exactly like the loop can, so the watchdog stays armed; it
+    # cannot double-print because it os._exit()s right after its line.
+    last_progress[0] = time.monotonic()
     if state is not None:
         jax.block_until_ready(state.params)
     if profile_dir:
@@ -556,6 +558,8 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     }
     if tpu_error is not None:
         result["tpu_error"] = str(tpu_error)[:300]
+    # Disarm only now: everything after this is pure host-side printing.
+    last_progress[0] = float("inf")
     return result
 
 

@@ -203,6 +203,7 @@ class JaxShufflingDataset:
         self.batch_axis = batch_axis
         self._prefetch_depth = max(1, prefetch_depth)
         self._unpack_cache: Dict[Any, Any] = {}
+        self._packed_ok = True
         self.stats = HostToDeviceStats()
 
     # -- spec application ---------------------------------------------------
@@ -253,9 +254,24 @@ class JaxShufflingDataset:
         )
 
         t0 = time.perf_counter()
-        if packable:
-            features, label_arr, nbytes = self._stage_packed(host, label)
-        else:
+        features = None
+        if packable and self._packed_ok:
+            try:
+                features, label_arr, nbytes = self._stage_packed(host, label)
+            except Exception:
+                # Unvalidated backend corner (e.g. a plugin that rejects
+                # the jitted unpack): the packed path is an optimization,
+                # so degrade PERMANENTLY to per-column staging rather
+                # than sinking the run — and only warn once.
+                self._packed_ok = False
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "packed batch staging failed on this backend; "
+                    "falling back to per-column device_put",
+                    exc_info=True,
+                )
+        if features is None:
             features = {}
             nbytes = 0
             for col, arr in host.items():

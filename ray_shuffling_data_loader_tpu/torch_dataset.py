@@ -56,7 +56,14 @@ class TorchShufflingDataset(IterableDataset):
         label_column: Any = None,
         label_shape: Optional[int] = None,
         label_type: Optional[torch.dtype] = None,
+        narrow_to_32: bool = False,
+        cache_decoded: Optional[bool] = None,
     ):
+        """``narrow_to_32`` / ``cache_decoded``: the loader-throughput
+        levers (see :class:`~.dataset.ShufflingDataset`). Off/auto by
+        default here for exact dtype parity with the reference adapter —
+        the tensor spec's ``feature_types`` govern final dtypes either
+        way, so narrowing is safe whenever ids fit int32."""
         super().__init__()
         self._ds = ShufflingDataset(
             filenames,
@@ -69,6 +76,8 @@ class TorchShufflingDataset(IterableDataset):
             max_concurrent_epochs=max_concurrent_epochs,
             seed=seed,
             queue_name=queue_name,
+            narrow_to_32=narrow_to_32,
+            cache_decoded=cache_decoded,
         )
         self._batch_transform = batch_to_tensor_factory(
             feature_columns=feature_columns,
