@@ -244,3 +244,17 @@ def test_dataset_with_decode_cache_exactly_once(local_runtime, small_dataset):
             first_epoch_order = keys
         elif epoch == 1:
             assert keys != first_epoch_order
+
+
+def test_narrow_to_32_rejects_out_of_range(local_runtime, tmp_path):
+    """narrow_to_32 must raise (not silently wrap) on ids outside int32
+    range — wraparound would corrupt training data undetectably."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "big_ids.parquet")
+    pq.write_table(
+        pa.table({"key": [0, 1], "big": [2**31, 5]}), path
+    )
+    with pytest.raises(ValueError, match="outside int32 range"):
+        shuffle_map(path, 0, 2, epoch=0, seed=1, narrow_to_32=True)
