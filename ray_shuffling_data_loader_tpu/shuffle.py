@@ -79,10 +79,16 @@ def read_parquet_columns(filename: str) -> ColumnBatch:
     Single-threaded decode + memory-mapped input: parallelism here comes
     from the worker POOL (one mapper process per file), so Arrow's
     per-read thread pool only adds oversubscription — measured 5x slower
-    with the default ``use_threads=True`` on a saturated host."""
+    with the default ``use_threads=True`` on a saturated host.
+    ``memory_map`` only applies to local paths: Arrow rejects URIs
+    (gs://, s3://) under it, and pods read shared cloud storage."""
     import pyarrow.parquet as pq
 
-    table = pq.read_table(filename, use_threads=False, memory_map=True)
+    from ray_shuffling_data_loader_tpu.utils import is_remote_path
+
+    table = pq.read_table(
+        filename, use_threads=False, memory_map=not is_remote_path(filename)
+    )
     cols = {}
     for name, col in zip(table.column_names, table.columns):
         arr = col.to_numpy(zero_copy_only=False)

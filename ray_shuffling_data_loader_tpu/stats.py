@@ -495,8 +495,7 @@ class ObjectStoreStatsCollector:
 # ---------------------------------------------------------------------------
 
 
-def _is_remote(path: str) -> bool:
-    return "://" in path
+from ray_shuffling_data_loader_tpu.utils import is_remote_path as _is_remote  # noqa: E402
 
 
 def _write_rows(f, rows: List[Dict], write_header: bool) -> None:

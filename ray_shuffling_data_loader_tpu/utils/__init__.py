@@ -1,4 +1,4 @@
-"""Shared utilities: platform pinning, wall-clock timing."""
+"""Shared utilities: platform pinning, wall-clock timing, path kinds."""
 
 from ray_shuffling_data_loader_tpu.utils.platform import (  # noqa: F401
     force_platform_from_env,
@@ -6,4 +6,17 @@ from ray_shuffling_data_loader_tpu.utils.platform import (  # noqa: F401
 )
 from ray_shuffling_data_loader_tpu.utils.timing import timer  # noqa: F401
 
-__all__ = ["force_platform_from_env", "pin_platform", "timer"]
+
+def is_remote_path(path: str) -> bool:
+    """True for URI-style paths (gs://, s3://, ...) that route through a
+    non-local filesystem — one definition, shared by Parquet decode and
+    the fsspec stats writers."""
+    return "://" in path
+
+
+__all__ = [
+    "force_platform_from_env",
+    "is_remote_path",
+    "pin_platform",
+    "timer",
+]
