@@ -96,6 +96,8 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rsdl_take_multi.argtypes = [p, p, c_i64, p, p, c_i64, c_i64, c_int]
     lib.rsdl_take_multi8.argtypes = [p, p, c_i64, p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32.argtypes = [p, p, c_i64, c_int]
+    lib.rsdl_cast_i64_i32_checked.argtypes = [p, p, c_i64, c_int]
+    lib.rsdl_cast_i64_i32_checked.restype = c_int
     lib.rsdl_cast_f64_f32.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_group_rows.argtypes = [p, p, p, c_i64, c_i64, p]
     lib.rsdl_abi_version.restype = c_int
@@ -121,7 +123,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             if candidate and os.path.exists(candidate):
                 try:
                     lib = _declare(ctypes.CDLL(candidate))
-                    if lib.rsdl_abi_version() == 2:
+                    if lib.rsdl_abi_version() == 3:
                         _lib = lib
                         break
                 except (OSError, AttributeError):
@@ -270,6 +272,29 @@ def take_multi(
             len(idx), row_bytes, _NUM_THREADS,
         )
     return out
+
+
+def narrow_i64_checked(arr: np.ndarray) -> Optional[np.ndarray]:
+    """Range-checked ``int64 -> int32`` in ONE fused pass (the numpy route
+    costs three: max scan, min scan, astype). Returns the int32 array, or
+    None when any value falls outside int32 range — the caller decides how
+    to fail. Falls back to the three-pass numpy check without the .so."""
+    if arr.dtype != np.int64:
+        # Not an assert: stripped under PYTHONOPTIMIZE, and a wrong dtype
+        # reaching the C kernel reads past the buffer.
+        raise TypeError(f"narrow_i64_checked expects int64, got {arr.dtype}")
+    lib = _get_lib()
+    if lib is not None and arr.flags.c_contiguous and arr.size:
+        out = np.empty(arr.shape, dtype=np.int32)
+        ok = lib.rsdl_cast_i64_i32_checked(
+            _ptr(arr), _ptr(out), arr.size, _NUM_THREADS
+        )
+        return out if ok else None
+    if arr.size and (
+        arr.max() > np.iinfo(np.int32).max or arr.min() < np.iinfo(np.int32).min
+    ):
+        return None
+    return arr.astype(np.int32)
 
 
 def narrow(arr: np.ndarray, dtype) -> np.ndarray:
