@@ -78,6 +78,15 @@ def parse_args(argv=None):
         help="Steps between checkpoints.",
     )
     p.add_argument(
+        "--loader",
+        choices=("auto", "resident", "mapreduce"),
+        default="auto",
+        help="Batch delivery path: 'resident' shuffles each epoch on "
+        "device (permutation + gather in HBM; needs the packed dataset "
+        "to fit the device budget), 'mapreduce' is the general host "
+        "pipeline, 'auto' picks resident when it fits.",
+    )
+    p.add_argument(
         "--smoke",
         action="store_true",
         help="Tiny CI workload preset (overrides the size knobs).",
@@ -146,6 +155,8 @@ def main(argv=None) -> int:
     )
     from ray_shuffling_data_loader_tpu.parallel.mesh import make_mesh
 
+    from ray_shuffling_data_loader_tpu import resident as resident_mod
+
     runtime.init()
     os.makedirs(args.data_dir, exist_ok=True)
     filenames = get_data(args)
@@ -157,6 +168,23 @@ def main(argv=None) -> int:
     print(f"mesh: {dict(mesh.shape)} on {jax.device_count()} devices")
 
     feature_columns = [c for c in DATA_SPEC if c != LABEL_COLUMN]
+
+    # Loader choice (see resident.py): epoch shuffle on device when the
+    # packed dataset fits the budget, host map/reduce otherwise.
+    if args.loader == "mapreduce":
+        use_resident = False
+    else:
+        fits = resident_mod.fits_device(
+            filenames, len(feature_columns), mesh=mesh, num_rows=args.num_rows
+        )
+        use_resident = args.loader == "resident" or fits
+        if use_resident and not fits:
+            print(
+                "warning: --loader resident forced but the packed dataset "
+                "may exceed the device memory budget"
+            )
+    print(f"loader: {'device-resident' if use_resident else 'map/reduce'}")
+
     model = dlrm_for_data_spec(embed_dim=args.embed_dim)
     optimizer = optax.adam(args.learning_rate)
     example = {
@@ -196,6 +224,27 @@ def main(argv=None) -> int:
             target=state, shardings=state_shardings
         )
         if cursor is not None:
+            # The two loaders produce different (both deterministic)
+            # batch streams, so a resume must keep the loader the
+            # checkpoint was written under. Cursors from before the
+            # resident loader existed carry no key and mean map/reduce.
+            ckpt_loader = (cursor.config or {}).get("loader", "mapreduce")
+            if args.loader not in ("auto", ckpt_loader):
+                raise SystemExit(
+                    f"--loader {args.loader} conflicts with this "
+                    f"checkpoint's batch stream (written under "
+                    f"{ckpt_loader}); resume with --loader {ckpt_loader}"
+                )
+            if use_resident != (ckpt_loader == "resident"):
+                print(
+                    f"checkpoint forces loader {ckpt_loader} (overriding "
+                    f"the auto choice above); if this machine cannot fit "
+                    f"the resident buffer, restart with a fresh "
+                    f"--checkpoint-dir"
+                )
+            use_resident = ckpt_loader == "resident"
+            if "loader" in (cursor.config or {}):
+                stream_config["loader"] = ckpt_loader
             cursor.validate(stream_config)
             state = restored if restored is not None else state
             start_epoch = cursor.epoch
@@ -205,21 +254,37 @@ def main(argv=None) -> int:
                 f"resuming from step {global_step}: epoch {start_epoch}, "
                 f"skipping {resume_skip} already-trained batches"
             )
+        else:
+            stream_config["loader"] = (
+                "resident" if use_resident else "mapreduce"
+            )
 
-    ds = JaxShufflingDataset(
-        filenames,
-        num_epochs=args.epochs,
-        num_trainers=1,
-        batch_size=args.batch_size,
-        rank=0,
-        feature_columns=feature_columns,
-        label_column=LABEL_COLUMN,
-        num_reducers=args.num_reducers,
-        max_concurrent_epochs=args.max_concurrent_epochs,
-        seed=args.seed,
-        mesh=mesh,
-        start_epoch=start_epoch,
-    )
+    if use_resident:
+        ds = resident_mod.DeviceResidentShufflingDataset(
+            filenames,
+            num_epochs=args.epochs,
+            batch_size=args.batch_size,
+            feature_columns=feature_columns,
+            label_column=LABEL_COLUMN,
+            seed=args.seed,
+            mesh=mesh,
+            num_rows=args.num_rows,
+        )
+    else:
+        ds = JaxShufflingDataset(
+            filenames,
+            num_epochs=args.epochs,
+            num_trainers=1,
+            batch_size=args.batch_size,
+            rank=0,
+            feature_columns=feature_columns,
+            label_column=LABEL_COLUMN,
+            num_reducers=args.num_reducers,
+            max_concurrent_epochs=args.max_concurrent_epochs,
+            seed=args.seed,
+            mesh=mesh,
+            start_epoch=start_epoch,
+        )
 
     # Train loop with per-batch wait-time measurement (reference ``_train``,
     # ray_torch_shuffle.py:195-231).

@@ -31,6 +31,7 @@ __all__ = [
     "ShufflingDataset",
     "shuffle",
     "JaxShufflingDataset",
+    "DeviceResidentShufflingDataset",
     "TorchShufflingDataset",
     "BatchCursor",
     "CheckpointManager",
@@ -43,6 +44,12 @@ def __getattr__(name):
         from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
 
         return JaxShufflingDataset
+    if name == "DeviceResidentShufflingDataset":
+        from ray_shuffling_data_loader_tpu.resident import (
+            DeviceResidentShufflingDataset,
+        )
+
+        return DeviceResidentShufflingDataset
     if name == "TorchShufflingDataset":
         from ray_shuffling_data_loader_tpu.torch_dataset import (
             TorchShufflingDataset,

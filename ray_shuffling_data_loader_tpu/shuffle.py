@@ -72,9 +72,12 @@ class BatchConsumer:
 # ---------------------------------------------------------------------------
 
 
-def read_parquet_columns(filename: str) -> ColumnBatch:
+def read_parquet_columns(
+    filename: str, columns: Optional[Sequence[str]] = None
+) -> ColumnBatch:
     """Decode a Parquet file to contiguous numpy columns (Arrow C++ decode
-    stays on host CPUs, per SURVEY §2b).
+    stays on host CPUs, per SURVEY §2b). ``columns`` restricts the decode
+    to a projection (None = all columns).
 
     Single-threaded decode + memory-mapped input: parallelism here comes
     from the worker POOL (one mapper process per file), so Arrow's
@@ -87,7 +90,10 @@ def read_parquet_columns(filename: str) -> ColumnBatch:
     from ray_shuffling_data_loader_tpu.utils import is_remote_path
 
     table = pq.read_table(
-        filename, use_threads=False, memory_map=not is_remote_path(filename)
+        filename,
+        columns=list(columns) if columns is not None else None,
+        use_threads=False,
+        memory_map=not is_remote_path(filename),
     )
     cols = {}
     for name, col in zip(table.column_names, table.columns):
