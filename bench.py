@@ -430,6 +430,12 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 mesh=mesh,
                 seed=SEED,
                 num_rows=num_rows,
+                # The one-time staging pass can exceed the per-batch
+                # stall timeout on a slow host; every staged piece is
+                # liveness progress for the watchdog.
+                progress_cb=lambda: last_progress.__setitem__(
+                    0, time.monotonic()
+                ),
             )
         return JaxShufflingDataset(
             filenames,

@@ -53,6 +53,13 @@ def parse_args(argv=None):
         "(the reference's default mode, ray_torch_shuffle.py:214).",
     )
     # Model / optimization.
+    p.add_argument(
+        "--model",
+        choices=("dlrm", "transformer"),
+        default="dlrm",
+        help="Model family: the flagship DLRM or the TabTransformer "
+        "encoder (models/transformer.py).",
+    )
     p.add_argument("--embed-dim", type=int, default=32)
     p.add_argument("--learning-rate", type=float, default=1e-3)
     p.add_argument(
@@ -185,7 +192,14 @@ def main(argv=None) -> int:
             )
     print(f"loader: {'device-resident' if use_resident else 'map/reduce'}")
 
-    model = dlrm_for_data_spec(embed_dim=args.embed_dim)
+    if args.model == "transformer":
+        from ray_shuffling_data_loader_tpu.models import (
+            transformer_for_data_spec,
+        )
+
+        model = transformer_for_data_spec(embed_dim=args.embed_dim)
+    else:
+        model = dlrm_for_data_spec(embed_dim=args.embed_dim)
     optimizer = optax.adam(args.learning_rate)
     example = {
         c: jnp.zeros((args.batch_size,), jnp.int32) for c in feature_columns

@@ -5,5 +5,17 @@ from ray_shuffling_data_loader_tpu.ops.interaction import (  # noqa: F401
     dot_interaction_reference,
     num_pairs,
 )
+from ray_shuffling_data_loader_tpu.ops.ring_attention import (  # noqa: F401
+    attention_reference,
+    make_ring_attention,
+    ring_attention,
+)
 
-__all__ = ["dot_interaction", "dot_interaction_reference", "num_pairs"]
+__all__ = [
+    "dot_interaction",
+    "dot_interaction_reference",
+    "num_pairs",
+    "attention_reference",
+    "make_ring_attention",
+    "ring_attention",
+]

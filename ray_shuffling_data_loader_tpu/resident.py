@@ -39,7 +39,7 @@ from __future__ import annotations
 import math
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -67,16 +67,7 @@ def _decode_narrow_to_store(filename: str, columns: Sequence[str]):
     batch = read_parquet_columns(filename, columns=columns)
     cols = {name: _narrow_column(name, batch.columns[name]) for name in columns}
     ctx = runtime.ensure_initialized()
-    pending = ctx.store.create_columns(
-        {k: (v.shape, v.dtype) for k, v in cols.items()}
-    )
-    try:
-        for k, v in cols.items():
-            np.copyto(pending.columns[k], v)
-        ref = pending.seal()
-    finally:
-        pending.abort()
-    return ref
+    return ctx.store.put_columns(cols)
 
 
 def dataset_num_rows(filenames: Sequence[str]) -> int:
@@ -108,12 +99,19 @@ def device_memory_budget(
     if env:
         return int(float(env) * 1e9), False
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return int(budget_frac * limit), True
+        platform = dev.platform
     except Exception:
-        pass
+        return None, False
+    if platform != "cpu":
+        # An accelerator that won't report its memory limit gets no
+        # guess: host RAM says nothing about HBM, and an over-admitted
+        # resident buffer OOMs the device mid-staging.
+        return None, False
     try:
         ram = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
         return int(budget_frac * ram), False
@@ -138,6 +136,19 @@ def fits_device(
     count (remote URIs pay a round-trip per file otherwise).
     """
     if jax.process_count() > 1:
+        return False
+    # The mode's entire win is device memory being faster than host
+    # memory. On the CPU backend the "device" IS host RAM (and XLA-CPU
+    # gathers are slow), so auto mode measured ~3x SLOWER than the host
+    # map/reduce pipeline there (BENCHLOG 2026-07-30). Auto therefore
+    # requires a real accelerator; setting RSDL_RESIDENT_BUDGET_GB (or
+    # constructing DeviceResidentShufflingDataset directly) opts in
+    # anyway.
+    try:
+        platform = jax.local_devices()[0].platform
+    except Exception:
+        return False
+    if platform == "cpu" and not os.environ.get("RSDL_RESIDENT_BUDGET_GB"):
         return False
     budget, per_device = device_memory_budget(budget_frac)
     if budget is None:
@@ -200,6 +211,7 @@ class DeviceResidentShufflingDataset:
         lookahead: int = 2,
         piece_rows: int = DEFAULT_PIECE_ROWS,
         num_rows: Optional[int] = None,
+        progress_cb: Optional[Callable[[], None]] = None,
     ):
         if jax.process_count() > 1:
             raise ValueError(
@@ -228,6 +240,9 @@ class DeviceResidentShufflingDataset:
         self._epoch: Optional[int] = None
         self._skip = 0
         self._perm_cache: Dict[int, jax.Array] = {}
+        # Called after every staged piece: lets a long staging pass feed
+        # an external liveness watchdog (the bench arms one).
+        self._progress_cb = progress_cb
         self.stats = HostToDeviceStats()
         self._load(filenames, num_rows)
 
@@ -287,6 +302,8 @@ class DeviceResidentShufflingDataset:
             cursor += fill
             piece = np.empty((ncols, w), np.int32)
             fill = 0
+            if self._progress_cb is not None:
+                self._progress_cb()
 
         for fut in futs:
             ref = fut.result()

@@ -529,14 +529,19 @@ class ObjectStore:
         return PendingColumns(self, object_id, tmp, path, total, mm, views)
 
     def put_columns(self, columns: Mapping[str, np.ndarray]) -> ObjectRef:
-        """Write a columnar batch as one aligned segment; return its ref."""
+        """Write a columnar batch as one aligned segment; return its ref.
+        The segment is reclaimed if the copy-in fails mid-way (``abort``
+        is a no-op after a successful ``seal``)."""
         cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
         pending = self.create_columns(
             {k: (v.shape, v.dtype) for k, v in cols.items()}
         )
-        for k, v in cols.items():
-            pending.columns[k][...] = v
-        return pending.seal()
+        try:
+            for k, v in cols.items():
+                pending.columns[k][...] = v
+            return pending.seal()
+        finally:
+            pending.abort()
 
     def put_bytes(self, data: bytes) -> ObjectRef:
         return self.put_columns({"__bytes__": np.frombuffer(data, np.uint8)})

@@ -191,8 +191,13 @@ def test_num_rows_hint(local_runtime, resident_files):
 
 def test_fits_device_policy(local_runtime, resident_files, monkeypatch):
     assert dataset_num_rows(resident_files) == NUM_ROWS
-    # The tiny test set fits any sane budget.
+    # Auto never picks resident on the CPU backend (the "device" is host
+    # RAM — measured slower than the map/reduce path there) ...
+    monkeypatch.delenv("RSDL_RESIDENT_BUDGET_GB", raising=False)
+    assert fits_device(resident_files, len(FEATURES)) is False
+    # ... unless the operator opts in with an explicit budget.
+    monkeypatch.setenv("RSDL_RESIDENT_BUDGET_GB", "1")
     assert fits_device(resident_files, len(FEATURES)) is True
-    # A 1-byte budget does not.
+    # An explicit budget the dataset exceeds still says no.
     monkeypatch.setenv("RSDL_RESIDENT_BUDGET_GB", "1e-9")
     assert fits_device(resident_files, len(FEATURES)) is False
