@@ -186,10 +186,24 @@ def main(argv=None) -> int:
         )
         use_resident = args.loader == "resident" or fits
         if use_resident and not fits:
-            print(
-                "warning: --loader resident forced but the packed dataset "
-                "may exceed the device memory budget"
-            )
+            # Say WHY auto would have declined, so the one warning that
+            # matters (a genuine budget overrun on a real accelerator)
+            # isn't drowned by deliberate CPU/pod opt-ins.
+            if jax.process_count() > 1:
+                print(
+                    "note: pod resident mode is explicit-construction "
+                    "only; every process must run with --loader resident"
+                )
+            elif jax.local_devices()[0].platform == "cpu":
+                print(
+                    "note: resident loader forced on the CPU backend "
+                    "(auto prefers map/reduce there — see BENCHLOG.md)"
+                )
+            else:
+                print(
+                    "warning: --loader resident forced but the packed "
+                    "dataset may exceed the device memory budget"
+                )
     print(f"loader: {'device-resident' if use_resident else 'map/reduce'}")
 
     if args.model == "transformer":

@@ -114,6 +114,7 @@ def _ring_attention_local(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=None)
 def make_ring_attention(
     mesh: Mesh,
     axis_name: str = "data",
@@ -124,6 +125,10 @@ def make_ring_attention(
     Returns ``fn(q, k, v) -> out`` operating on global arrays of shape
     ``[batch, seq, heads, head_dim]`` sharded (or shardable) along the
     sequence dimension; ``seq`` must divide evenly by the axis size.
+
+    Memoized on ``(mesh, axis_name, causal)`` so repeated calls (incl.
+    the one-shot :func:`ring_attention` wrapper in a step loop) reuse one
+    traced/compiled function instead of re-compiling per call.
     """
     from jax import shard_map
 

@@ -29,9 +29,14 @@ Capability parity with the epoch-shuffle contract (exactly-once per
 epoch, deterministic under a seed, ``drop_last``, disjoint per-rank
 shards, mid-epoch ``skip_batches`` resume) is preserved and tested; the
 epoch-window/queue machinery is unnecessary here because there is no
-host pipeline to backpressure. Datasets that exceed the HBM budget (or
-multi-controller pods) use the general map/reduce path; ``fits_device``
-is the policy gate.
+host pipeline to backpressure. Datasets that exceed the HBM budget use
+the general map/reduce path; ``fits_device`` is the policy gate.
+
+Multi-controller pods are supported opt-in (construct the dataset
+explicitly on every process): each process stages its addressable row
+range and the per-batch gathers cross the pod as XLA collectives — see
+:meth:`DeviceResidentShufflingDataset._load_multiprocess` and
+``tests/test_resident_pod.py``.
 """
 
 from __future__ import annotations
@@ -130,12 +135,14 @@ def fits_device(
     """Policy gate: can the packed dataset live resident in device memory?
 
     The buffer shards over the mesh's batch axis, so the budget applies
-    to the per-device slice. Multi-controller pods are excluded (the
-    resident iterator is single-controller by design). ``num_rows``
+    to the per-device slice. Multi-controller pods never auto-select
+    (pod resident mode is explicit-construction only). ``num_rows``
     skips the Parquet-footer sweep when the caller already knows the
     count (remote URIs pay a round-trip per file otherwise).
     """
     if jax.process_count() > 1:
+        # Pod resident mode exists (``_load_multiprocess``) but stays
+        # opt-in: auto never silently swaps a pod's delivery path.
         return False
     # The mode's entire win is device memory being faster than host
     # memory. On the CPU backend the "device" IS host RAM (and XLA-CPU
@@ -213,10 +220,15 @@ class DeviceResidentShufflingDataset:
         num_rows: Optional[int] = None,
         progress_cb: Optional[Callable[[], None]] = None,
     ):
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and num_trainers != 1:
+            # Multi-controller SPMD: every process executes the SAME
+            # global batch stream and consumes its addressable shard of
+            # each batch — the "rank" concept lives in the sharding, not
+            # in disjoint streams.
             raise ValueError(
-                "DeviceResidentShufflingDataset is single-controller; "
-                "multi-controller pods use the map/reduce path"
+                "multi-controller resident mode is globally SPMD; use "
+                "num_trainers=1 (each process consumes its addressable "
+                "shard of every global batch)"
             )
         if not filenames:
             raise ValueError("no input files")
@@ -257,7 +269,12 @@ class DeviceResidentShufflingDataset:
         host packing, and H2D transfer overlap. The buffer is padded past
         the real row count by one piece so the update never clamps; pad
         rows are never gathered (the permutation covers real rows only).
+
+        Multi-controller pods branch to :meth:`_load_multiprocess`.
         """
+        if jax.process_count() > 1:
+            self._load_multiprocess(filenames, num_rows)
+            return
         t0 = time.perf_counter()
         ctx = runtime.ensure_initialized()
         futs = [
@@ -344,6 +361,115 @@ class DeviceResidentShufflingDataset:
             )
         jax.block_until_ready(buf)
         self._buf = buf
+        self._finalize(t0)
+
+    def _load_multiprocess(
+        self, filenames: List[str], num_rows: Optional[int]
+    ) -> None:
+        """Pod staging: each process decodes and packs exactly the row
+        range its devices address, then one
+        ``jax.make_array_from_process_local_data`` call assembles the
+        global resident buffer. Per-batch gathers over the global
+        permutation then cross the pod as XLA collectives (ICI/DCN) —
+        the pod-scale analog of the reference's cross-node object pulls
+        (``/root/reference/ray_shuffling_data_loader/dataset.py:132-139``),
+        but expressed as SPMD device computation instead of host fetches.
+        """
+        import pyarrow.parquet as pq
+
+        t0 = time.perf_counter()
+        ctx = runtime.ensure_initialized()
+        ncols = len(self._columns)
+        data_shards = self.mesh.shape.get(self.batch_axis, 1)
+        self._col_dtypes = {}
+
+        file_rows = [pq.ParquetFile(f).metadata.num_rows for f in filenames]
+        n = sum(file_rows)
+        if num_rows is not None and num_rows != n:
+            raise ValueError(
+                f"dataset has {n} rows but num_rows says {num_rows}"
+            )
+        self.num_rows = n
+        padded = math.ceil(n / data_shards) * data_shards
+        self._padded_rows = padded
+
+        # Column dtypes must be IDENTICAL on every process (they shape
+        # the jitted gather program), so derive them from the schema, not
+        # from whichever files this process happens to decode.
+        from ray_shuffling_data_loader_tpu.shuffle import narrowed_dtype
+
+        schema = pq.ParquetFile(filenames[0]).schema_arrow
+        for name in self._columns:
+            np_dtype = np.dtype(schema.field(name).type.to_pandas_dtype())
+            narrowed = str(narrowed_dtype(np_dtype))
+            if np.dtype(narrowed).itemsize != 4:
+                raise TypeError(
+                    f"resident mode needs 4-byte columns; {name!r} "
+                    f"decodes to {narrowed}"
+                )
+            self._col_dtypes[name] = narrowed
+
+        # This process's addressable column range of the global buffer.
+        sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
+        imap = sharding.devices_indices_map((ncols, padded))
+        me = jax.process_index()
+        spans = sorted(
+            (idx[1].start or 0, idx[1].stop if idx[1].stop is not None else padded)
+            for dev, idx in imap.items()
+            if dev.process_index == me
+        )
+        lo, hi = spans[0][0], spans[-1][1]
+        if sum(b - a for a, b in spans) != hi - lo:
+            raise ValueError(
+                "this process's addressable shards are not contiguous in "
+                "the row dimension; use a mesh whose batch axis orders "
+                "devices by process"
+            )
+
+        local = np.zeros((ncols, hi - lo), np.int32)
+        offsets = np.concatenate([[0], np.cumsum(file_rows)])
+        want = [
+            i
+            for i in range(len(filenames))
+            if offsets[i + 1] > lo and offsets[i] < min(hi, n)
+        ]
+        # Local pool on purpose: cluster-wide scatter would publish
+        # segments on other hosts and pull them straight back over DCN.
+        futs = {
+            i: ctx.pool.submit(
+                _decode_narrow_to_store, filenames[i], self._columns
+            )
+            for i in want
+        }
+        for i in want:
+            ref = futs[i].result()
+            cb = ctx.store.get_columns(ref)
+            file_lo = max(lo, int(offsets[i]))
+            file_hi = min(hi, int(offsets[i + 1]))
+            src = slice(file_lo - int(offsets[i]), file_hi - int(offsets[i]))
+            dst = slice(file_lo - lo, file_hi - lo)
+            for ci, name in enumerate(self._columns):
+                arr = np.asarray(cb[name])
+                if str(arr.dtype) != self._col_dtypes[name]:
+                    raise TypeError(
+                        f"column {name!r}: file {filenames[i]!r} decodes "
+                        f"to {arr.dtype}, schema says "
+                        f"{self._col_dtypes[name]}"
+                    )
+                local[ci, dst] = arr[src].view(np.int32)
+            self.stats.bytes_staged += ncols * (file_hi - file_lo) * 4
+            del cb
+            ctx.store.free([ref])
+            if self._progress_cb is not None:
+                self._progress_cb()
+        self._buf = jax.make_array_from_process_local_data(
+            sharding, local, (ncols, padded)
+        )
+        jax.block_until_ready(self._buf)
+        self._finalize(t0)
+
+    def _finalize(self, t0: float) -> None:
+        n = self.num_rows
         self.stats.batches_staged = 0
         self.stats.first_batch_s = time.perf_counter() - t0
         self.stats.sample_device_memory()

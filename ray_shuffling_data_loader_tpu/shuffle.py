@@ -102,6 +102,18 @@ def read_parquet_columns(
     return ColumnBatch(cols)
 
 
+def narrowed_dtype(dtype) -> np.dtype:
+    """The 32-bit dtype a column has after decode narrowing — the ONE
+    definition of the narrowing policy (``_narrow_column`` applies it;
+    ``resident._load_multiprocess`` predicts it from the schema)."""
+    dtype = np.dtype(dtype)
+    if dtype == np.int64:
+        return np.dtype(np.int32)
+    if dtype == np.float64:
+        return np.dtype(np.float32)
+    return dtype
+
+
 def _narrow_column(name: str, v: np.ndarray) -> np.ndarray:
     """Cast a 64-bit column to 32 bits, REFUSING silent wraparound: an id
     outside int32 range would corrupt training data undetectably (floats

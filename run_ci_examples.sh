@@ -6,6 +6,7 @@ set -euo pipefail
 cd "$(dirname "$0")"
 export JAX_PLATFORMS=cpu
 python examples/train_dlrm.py --smoke
+python examples/train_dlrm.py --smoke --loader resident --model transformer
 python examples/train_dlrm_multirank.py --num-trainers 2 \
     --num-rows 50000 --num-files 4 --batch-size 5000 --epochs 2
 python -m ray_shuffling_data_loader_tpu.dataset

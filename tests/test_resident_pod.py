@@ -1,0 +1,159 @@
+"""Pod-scale resident shuffle: two real ``jax.distributed`` CPU processes
+(4 virtual devices each → one 8-device global mesh) stage their
+addressable row ranges, assemble the global resident buffer, and run
+globally-SPMD epoch shuffles — per-batch gathers cross the pod as XLA
+collectives. Asserts exactly-once delivery across the two processes'
+addressable shards and cross-process determinism.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["RSDL_T_REPO"])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["RSDL_T_COORD"],
+    num_processes=2,
+    process_id=int(os.environ["RSDL_T_RANK"]),
+)
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+from ray_shuffling_data_loader_tpu.resident import (
+    DeviceResidentShufflingDataset,
+)
+
+rank = int(os.environ["RSDL_T_RANK"])
+rdv = os.environ["RSDL_T_RDV"]
+NUM_ROWS, BATCH = 8000, 1000
+
+# Each process runs its own runtime session: staging is process-local by
+# design (each host decodes the files overlapping its row range).
+runtime.init(num_workers=2)
+if rank == 0:
+    generate_data(NUM_ROWS, 4, 1, 0.0, rdv + "/data_tmp")
+    os.rename(rdv + "/data_tmp", rdv + "/data")
+else:
+    deadline = time.time() + 120
+    while not os.path.isdir(rdv + "/data"):
+        assert time.time() < deadline
+        time.sleep(0.2)
+filenames = sorted(
+    os.path.join(rdv, "data", f)
+    for f in os.listdir(rdv + "/data")
+    if ".parquet" in f
+)
+
+mesh = Mesh(np.array(jax.devices()), ("data",))
+ds = DeviceResidentShufflingDataset(
+    filenames,
+    num_epochs=2,
+    batch_size=BATCH,
+    feature_columns=["key", "embeddings_name0"],
+    label_column="labels",
+    mesh=mesh,
+    seed=11,
+)
+assert ds.num_rows == NUM_ROWS
+
+mean_fn = jax.jit(lambda label: jnp.mean(label))
+out = {"epochs": []}
+for epoch in range(2):
+    ds.set_epoch(epoch)
+    local_keys = []
+    for features, label in ds:
+        key_arr = features["key"]
+        assert key_arr.shape[0] == BATCH  # global batch
+        m = float(mean_fn(label))  # collective across the pod
+        assert np.isfinite(m)
+        for shard in key_arr.addressable_shards:
+            local_keys.extend(np.asarray(shard.data).reshape(-1).tolist())
+    out["epochs"].append(local_keys)
+
+with open(f"{rdv}/keys_{rank}.tmp", "w") as f:
+    json.dump(out, f)
+os.rename(f"{rdv}/keys_{rank}.tmp", f"{rdv}/keys_{rank}")
+multihost_utils.sync_global_devices("done")
+runtime.shutdown()
+print("RESPOD_RANK_DONE", rank, flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_resident_shuffle(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            RSDL_T_REPO=_REPO,
+            RSDL_T_COORD=coord,
+            RSDL_T_RANK=str(rank),
+            RSDL_T_RDV=str(tmp_path),
+        )
+        log = tmp_path / f"rank{rank}.log"
+        logs.append(log)
+        lf = open(log, "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable, "-u", "-c", _WORKER],
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                ),
+                lf,
+            )
+        )
+    try:
+        for proc, _ in procs:
+            proc.wait(timeout=420)
+    finally:
+        for proc, lf in procs:
+            proc.kill()
+            proc.wait()
+            lf.close()
+    outputs = [log.read_text() for log in logs]
+    for rank, out in enumerate(outputs):
+        assert f"RESPOD_RANK_DONE {rank}" in out, (
+            f"rank{rank} log:\n{out[-4000:]}\n--- other rank:\n"
+            f"{outputs[1 - rank][-4000:]}"
+        )
+    results = [
+        json.load(open(tmp_path / f"keys_{rank}")) for rank in range(2)
+    ]
+    for epoch in range(2):
+        k0 = results[0]["epochs"][epoch]
+        k1 = results[1]["epochs"][epoch]
+        # Disjoint addressable shards, together exactly the full dataset.
+        assert len(set(k0)) == len(k0)
+        assert len(set(k1)) == len(k1)
+        assert not (set(k0) & set(k1))
+        assert sorted(k0 + k1) == list(range(8000))
+    # Different epochs shuffle differently.
+    assert results[0]["epochs"][0] != results[0]["epochs"][1]
