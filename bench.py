@@ -595,6 +595,9 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "backend": platform,
         "loader": "resident" if use_resident else "mapreduce",
         "pallas": pallas_mode,
+        # Resident loader: the one-time decode+pack+H2D staging pass;
+        # map/reduce loader: time to the first delivered batch.
+        "first_batch_s": round(stats.get("first_batch_s", 0.0), 2),
         "peak_hbm_gb": round(
             stats.get("peak_device_bytes_in_use", 0) / 1e9, 3
         ),

@@ -200,6 +200,10 @@ class DeviceResidentShufflingDataset:
         lookahead: device batches dispatched ahead of consumption. The
             gathers are async XLA work; 2 keeps one batch materializing
             while one is consumed without holding an epoch of outputs.
+        materialize_epoch: permute the WHOLE epoch with one device gather
+            and cut batches as contiguous slices (None = auto: on when
+            buffer + permuted copy fit 75% of the device budget). Both
+            paths yield the identical batch stream for a given seed.
     """
 
     def __init__(
@@ -219,6 +223,7 @@ class DeviceResidentShufflingDataset:
         piece_rows: int = DEFAULT_PIECE_ROWS,
         num_rows: Optional[int] = None,
         progress_cb: Optional[Callable[[], None]] = None,
+        materialize_epoch: Optional[bool] = None,
     ):
         if jax.process_count() > 1 and num_trainers != 1:
             # Multi-controller SPMD: every process executes the SAME
@@ -252,6 +257,8 @@ class DeviceResidentShufflingDataset:
         self._epoch: Optional[int] = None
         self._skip = 0
         self._perm_cache: Dict[int, jax.Array] = {}
+        self._epoch_buf_cache: Dict[int, jax.Array] = {}
+        self._materialize = materialize_epoch
         # Called after every staged piece: lets a long staging pass feed
         # an external liveness watchdog (the bench arms one).
         self._progress_cb = progress_cb
@@ -413,10 +420,18 @@ class DeviceResidentShufflingDataset:
         sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
         imap = sharding.devices_indices_map((ncols, padded))
         me = jax.process_index()
+        # set(): devices replicated along non-batch mesh axes (e.g. the
+        # model axis) report the SAME span; double-counting them fails
+        # the contiguity sum below.
         spans = sorted(
-            (idx[1].start or 0, idx[1].stop if idx[1].stop is not None else padded)
-            for dev, idx in imap.items()
-            if dev.process_index == me
+            {
+                (
+                    idx[1].start or 0,
+                    idx[1].stop if idx[1].stop is not None else padded,
+                )
+                for dev, idx in imap.items()
+                if dev.process_index == me
+            }
         )
         lo, hi = spans[0][0], spans[-1][1]
         if sum(b - a for a, b in spans) != hi - lo:
@@ -490,42 +505,121 @@ class DeviceResidentShufflingDataset:
         )
         self._gather_cache: Dict[int, object] = {}
 
+        # Epoch materialization policy: ONE whole-epoch gather (then
+        # batches are contiguous slices — no per-batch gather dispatch,
+        # and in pods one collective per epoch instead of per batch) when
+        # buffer + permuted copy both fit; else per-batch gathers. Total
+        # gathered bytes are identical either way — every row moves once
+        # per epoch — so this trades transient memory for dispatch
+        # latency and access locality.
+        if self._materialize is None:
+            ncols = len(self._columns)
+            data_shards = max(1, self.mesh.shape.get(self.batch_axis, 1))
+            per_device_copy = ncols * 4 * self._padded_rows // data_shards
+            limit = in_use = 0
+            try:
+                dstats = jax.local_devices()[0].memory_stats() or {}
+                limit = int(dstats.get("bytes_limit", 0))
+                in_use = int(dstats.get("bytes_in_use", 0))
+            except Exception:
+                pass
+            if limit > 0:
+                # Real accounting: bytes_in_use already includes the
+                # staged buffer AND whatever model/optimizer state the
+                # trainer holds, so the epoch copy is the only increment.
+                self._materialize = in_use + per_device_copy <= 0.75 * limit
+            else:
+                budget, per_device = device_memory_budget(budget_frac=0.75)
+                shards = data_shards if per_device else 1
+                need = 2 * ncols * 4 * self._padded_rows / shards
+                self._materialize = budget is not None and need <= budget
+
+        buf_sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
+        padded = self._padded_rows
+
+        def permute_all(buf, perm):
+            # Pad the permutation up to the buffer width so the permuted
+            # copy shards evenly; pad rows land at the tail, past every
+            # slice any batch can take.
+            full = jnp.concatenate(
+                [perm, jnp.arange(n, padded, dtype=perm.dtype)]
+            )
+            return jnp.take(buf, full, axis=1)
+
+        self._permute_all = jax.jit(permute_all, out_shardings=buf_sharding)
+
+    def _unpack_rows(self):
+        """Shared tail of both batch paths: packed int32 rows → bitcast
+        feature dict + label."""
+        names = self._feature_columns
+        dtypes = [self._col_dtypes[c] for c in self._columns]
+
+        def unpack(rows):
+            feats = {}
+            for i, name in enumerate(names):
+                col = rows[i]
+                if dtypes[i] != "int32":
+                    col = jax.lax.bitcast_convert_type(
+                        col, jnp.dtype(dtypes[i])
+                    )
+                feats[name] = col
+            label = rows[-1]
+            if dtypes[-1] != "int32":
+                label = jax.lax.bitcast_convert_type(
+                    label, jnp.dtype(dtypes[-1])
+                )
+            return feats, label
+
+        return unpack
+
+    def _out_shardings(self):
+        out_sharding = NamedSharding(self.mesh, P(self.batch_axis))
+        return (
+            {name: out_sharding for name in self._feature_columns},
+            out_sharding,
+        )
+
     def _gather_fn(self, width: int):
-        """Jitted batch gather: row-slice of the epoch permutation →
-        one-gather batch → bitcast unpack to the feature dict."""
-        fn = self._gather_cache.get(width)
+        """Jitted batch gather (per-batch path): row-slice of the epoch
+        permutation → one-gather batch → bitcast unpack."""
+        fn = self._gather_cache.get(("gather", width))
         if fn is None:
-            names = self._feature_columns
-            dtypes = [self._col_dtypes[c] for c in self._columns]
-            out_sharding = NamedSharding(self.mesh, P(self.batch_axis))
+            unpack = self._unpack_rows()
 
             def gather(buf, perm, start):
                 idx = jax.lax.dynamic_slice(perm, (start,), (width,))
-                rows = jnp.take(buf, idx, axis=1)
-                feats = {}
-                for i, name in enumerate(names):
-                    col = rows[i]
-                    if dtypes[i] != "int32":
-                        col = jax.lax.bitcast_convert_type(
-                            col, jnp.dtype(dtypes[i])
-                        )
-                    feats[name] = col
-                label = rows[-1]
-                if dtypes[-1] != "int32":
-                    label = jax.lax.bitcast_convert_type(
-                        label, jnp.dtype(dtypes[-1])
-                    )
-                return feats, label
+                return unpack(jnp.take(buf, idx, axis=1))
 
-            fn = jax.jit(
-                gather,
-                out_shardings=(
-                    {name: out_sharding for name in names},
-                    out_sharding,
-                ),
-            )
-            self._gather_cache[width] = fn
+            fn = jax.jit(gather, out_shardings=self._out_shardings())
+            self._gather_cache[("gather", width)] = fn
         return fn
+
+    def _slice_fn(self, width: int):
+        """Jitted batch cut (materialized-epoch path): a contiguous slice
+        of the already-permuted epoch buffer → bitcast unpack."""
+        fn = self._gather_cache.get(("slice", width))
+        if fn is None:
+            unpack = self._unpack_rows()
+            ncols = len(self._columns)
+
+            def cut(ebuf, start):
+                rows = jax.lax.dynamic_slice(
+                    ebuf, (jnp.int32(0), start), (ncols, width)
+                )
+                return unpack(rows)
+
+            fn = jax.jit(cut, out_shardings=self._out_shardings())
+            self._gather_cache[("slice", width)] = fn
+        return fn
+
+    def _epoch_buf(self, epoch: int) -> jax.Array:
+        ebuf = self._epoch_buf_cache.get(epoch)
+        if ebuf is None:
+            # One permuted copy lives at a time.
+            self._epoch_buf_cache.clear()
+            ebuf = self._permute_all(self._buf, self._perm(epoch))
+            self._epoch_buf_cache[epoch] = ebuf
+        return ebuf
 
     # -- iteration ----------------------------------------------------------
 
@@ -556,7 +650,10 @@ class DeviceResidentShufflingDataset:
         if self._epoch is None:
             raise RuntimeError("set_epoch must be called before iterating")
         epoch, skip = self._epoch, self._skip
-        perm = self._perm(epoch)
+        if self._materialize:
+            ebuf = self._epoch_buf(epoch)
+        else:
+            perm = self._perm(epoch)
         b = self.batch_size
         full, rem = divmod(self._rank_rows, b)
         widths = [b] * full
@@ -574,8 +671,11 @@ class DeviceResidentShufflingDataset:
         pending = deque()
         start = self._rank_start + skip * b
         for width in widths[skip:]:
-            fn = self._gather_fn(width)
-            pending.append(fn(self._buf, perm, np.int32(start)))
+            if self._materialize:
+                item = self._slice_fn(width)(ebuf, np.int32(start))
+            else:
+                item = self._gather_fn(width)(self._buf, perm, np.int32(start))
+            pending.append(item)
             start += width
             self.stats.batches_staged += 1
             if self.stats.batches_staged % 32 == 0:

@@ -162,6 +162,21 @@ def test_skip_batches_resume(local_runtime, resident_files):
         assert np.array_equal(a, b)
 
 
+def test_materialized_and_gather_paths_identical(local_runtime, resident_files):
+    """materialize_epoch changes the schedule (one whole-epoch gather vs
+    per-batch gathers), never the stream: same seed -> same batches, so
+    checkpoints resume exactly across the setting."""
+    mat = _make(resident_files, materialize_epoch=True)
+    gat = _make(resident_files, materialize_epoch=False)
+    assert mat._materialize is True and gat._materialize is False
+    for epoch in (0, 1):
+        mat.set_epoch(epoch)
+        gat.set_epoch(epoch)
+        for (fa, la), (fb, lb) in zip(mat, gat):
+            assert np.array_equal(np.asarray(fa["key"]), np.asarray(fb["key"]))
+            assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
 def test_epoch_bounds_and_bad_rank(local_runtime, resident_files):
     ds = _make(resident_files)
     with pytest.raises(ValueError):

@@ -60,7 +60,20 @@ filenames = sorted(
     if ".parquet" in f
 )
 
-mesh = Mesh(np.array(jax.devices()), ("data",))
+# 2-axis mesh on purpose: model-replicated devices report duplicate row
+# spans, which pod staging must deduplicate (dp x tp pods).
+mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+
+
+def shard_keys(arr):
+    # Model-replicated shards hold identical data; count each row span once.
+    seen, keys = set(), []
+    for shard in arr.addressable_shards:
+        idx = tuple((s.start, s.stop) for s in shard.index)
+        if idx not in seen:
+            seen.add(idx)
+            keys.extend(np.asarray(shard.data).reshape(-1).tolist())
+    return keys
 ds = DeviceResidentShufflingDataset(
     filenames,
     num_epochs=2,
@@ -72,8 +85,24 @@ ds = DeviceResidentShufflingDataset(
 )
 assert ds.num_rows == NUM_ROWS
 
+assert ds._materialize is True  # tiny dataset: auto picks one-gather
+
+# Second instance pins the per-batch gather path — the schedule large
+# pod datasets take when the epoch copy does not fit — which must
+# produce the IDENTICAL stream under multi-controller SPMD.
+ds_gather = DeviceResidentShufflingDataset(
+    filenames,
+    num_epochs=2,
+    batch_size=BATCH,
+    feature_columns=["key", "embeddings_name0"],
+    label_column="labels",
+    mesh=mesh,
+    seed=11,
+    materialize_epoch=False,
+)
+
 mean_fn = jax.jit(lambda label: jnp.mean(label))
-out = {"epochs": []}
+out = {"epochs": [], "gather_epochs": []}
 for epoch in range(2):
     ds.set_epoch(epoch)
     local_keys = []
@@ -82,9 +111,14 @@ for epoch in range(2):
         assert key_arr.shape[0] == BATCH  # global batch
         m = float(mean_fn(label))  # collective across the pod
         assert np.isfinite(m)
-        for shard in key_arr.addressable_shards:
-            local_keys.extend(np.asarray(shard.data).reshape(-1).tolist())
+        local_keys.extend(shard_keys(key_arr))
     out["epochs"].append(local_keys)
+
+ds_gather.set_epoch(0)
+gather_keys = []
+for features, _ in ds_gather:
+    gather_keys.extend(shard_keys(features["key"]))
+out["gather_epochs"].append(gather_keys)
 
 with open(f"{rdv}/keys_{rank}.tmp", "w") as f:
     json.dump(out, f)
@@ -157,3 +191,8 @@ def test_two_process_resident_shuffle(tmp_path):
         assert sorted(k0 + k1) == list(range(8000))
     # Different epochs shuffle differently.
     assert results[0]["epochs"][0] != results[0]["epochs"][1]
+    # The per-batch gather schedule yields the identical stream.
+    for rank in range(2):
+        assert (
+            results[rank]["gather_epochs"][0] == results[rank]["epochs"][0]
+        )
