@@ -47,10 +47,12 @@ TARGET_GB = float(os.environ.get("RSDL_BENCH_GB", "10"))
 NUM_FILES = int(os.environ.get("RSDL_BENCH_FILES", "16"))
 ROW_GROUPS_PER_FILE = 2
 BATCH_SIZE = 250_000  # reference benchmark_batch.sh:11
-# 3 epochs: the first pays cold decode + cache publish; the later two
-# show the steady state the per-epoch metric is meant to capture
-# (reference sweeps 10 epochs, benchmark_batch.sh:14).
-NUM_EPOCHS = int(os.environ.get("RSDL_BENCH_EPOCHS", "3"))
+# 10 epochs — the reference sweep's own count (benchmark_batch.sh:12-13).
+# Epoch 1 pays cold decode (+ cache publish / resident staging); the rest
+# are the steady state the per-epoch metric is meant to capture, and the
+# resident loader's one-time staging amortizes exactly as it would in a
+# real multi-epoch job.
+NUM_EPOCHS = int(os.environ.get("RSDL_BENCH_EPOCHS", "10"))
 NUM_REDUCERS = int(os.environ.get("RSDL_BENCH_REDUCERS", "8"))
 EMBED_DIM = 32
 SEED = 0
@@ -169,12 +171,13 @@ def _sized_workload(platform: str):
     so the bench never ENOSPCs mid-epoch.
 
     CPU failover shrinks the workload (``RSDL_BENCH_CPU_GB``, default
-    0.25 GB): the real train step is ~3 orders slower without the MXU and
-    a 10 GB run would blow any reasonable bench window."""
+    0.1 GB — sized so 10 epochs of real 250k-row DLRM steps at CPU speed
+    still finish in minutes): the real train step is ~3 orders slower
+    without the MXU and a 10 GB run would blow any reasonable window."""
     target_gb = TARGET_GB
     if platform == "cpu":
         target_gb = min(
-            target_gb, float(os.environ.get("RSDL_BENCH_CPU_GB", "0.25"))
+            target_gb, float(os.environ.get("RSDL_BENCH_CPU_GB", "0.1"))
         )
     target_bytes = int(target_gb * 1e9)
     headroom = _shm_free_bytes()

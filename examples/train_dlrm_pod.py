@@ -86,6 +86,14 @@ def parse_args(argv=None):
         help="Launch N local processes with a local coordinator (CPU "
         "smoke of the full pod flow).",
     )
+    p.add_argument(
+        "--loader",
+        choices=("mapreduce", "resident"),
+        default="mapreduce",
+        help="'resident' stages each host's addressable row range into "
+        "device memory once and shuffles every epoch on device (needs "
+        "the packed dataset to fit the pod's HBM; see resident.py).",
+    )
     return p.parse_args(argv)
 
 
@@ -184,6 +192,13 @@ def train_main(args) -> int:
             if name.endswith(".snappy")
         )
 
+    # Canonical file order on EVERY rank: rank 0 holds the generator's
+    # numeric-order list, other ranks listdir'd lexicographically — the
+    # resident loader maps row offsets from this order, so divergence
+    # would silently corrupt the global buffer (mapreduce is order-
+    # insensitive, but one canonical order costs nothing).
+    filenames = sorted(filenames)
+
     # 3. Pod-global mesh over EVERY device in the pod; batches assemble as
     #    global arrays, so the train step is one SPMD program.
     mesh = Mesh(np.array(jax.devices()), ("data",))
@@ -198,19 +213,36 @@ def train_main(args) -> int:
     state, shardings = init_state(model, optimizer, mesh, example)
     step_fn = make_train_step(model, optimizer, mesh, shardings)
 
-    ds = JaxShufflingDataset(
-        filenames,
-        num_epochs=args.epochs,
-        num_trainers=world,
-        batch_size=args.batch_size,
-        rank=rank,
-        feature_columns=feature_columns,
-        label_column=LABEL_COLUMN,
-        num_reducers=args.num_reducers,
-        seed=args.seed,
-        mesh=mesh,
-        queue_name="pod-queue",
-    )
+    if args.loader == "resident":
+        from ray_shuffling_data_loader_tpu.resident import (
+            DeviceResidentShufflingDataset,
+        )
+
+        # Every process stages its addressable row range; the buffer
+        # spans the pod and epoch shuffles are SPMD device gathers.
+        ds = DeviceResidentShufflingDataset(
+            filenames,
+            num_epochs=args.epochs,
+            batch_size=args.batch_size,
+            feature_columns=feature_columns,
+            label_column=LABEL_COLUMN,
+            seed=args.seed,
+            mesh=mesh,
+        )
+    else:
+        ds = JaxShufflingDataset(
+            filenames,
+            num_epochs=args.epochs,
+            num_trainers=world,
+            batch_size=args.batch_size,
+            rank=rank,
+            feature_columns=feature_columns,
+            label_column=LABEL_COLUMN,
+            num_reducers=args.num_reducers,
+            seed=args.seed,
+            mesh=mesh,
+            queue_name="pod-queue",
+        )
 
     # 4. Train. Every process steps in lockstep on its shard of the global
     #    batch; collectives ride ICI. Ranks can receive different batch
@@ -292,6 +324,8 @@ def simulate_pod(args) -> int:
             str(args.epochs),
             "--platform",
             args.platform or "cpu",
+            "--loader",
+            args.loader,
         ]
         env = dict(os.environ, RSDL_ADVERTISE_HOST="127.0.0.1")
         procs.append(subprocess.Popen(cmd, env=env))

@@ -503,7 +503,7 @@ class DeviceResidentShufflingDataset:
                 jax.random.fold_in(jax.random.key(self.seed), epoch), n
             )
         )
-        self._gather_cache: Dict[int, object] = {}
+        self._gather_cache: Dict[Tuple[str, int], object] = {}
 
         # Epoch materialization policy: ONE whole-epoch gather (then
         # batches are contiguous slices — no per-batch gather dispatch,
@@ -527,12 +527,28 @@ class DeviceResidentShufflingDataset:
                 # Real accounting: bytes_in_use already includes the
                 # staged buffer AND whatever model/optimizer state the
                 # trainer holds, so the epoch copy is the only increment.
-                self._materialize = in_use + per_device_copy <= 0.75 * limit
+                decision = in_use + per_device_copy <= 0.75 * limit
             else:
                 budget, per_device = device_memory_budget(budget_frac=0.75)
                 shards = data_shards if per_device else 1
                 need = 2 * ncols * 4 * self._padded_rows / shards
-                self._materialize = budget is not None and need <= budget
+                decision = budget is not None and need <= budget
+            if jax.process_count() > 1:
+                # Multi-controller: the two schedules issue DIFFERENT
+                # collectives, so every process must pick the same one.
+                # bytes_in_use varies across hosts (head-process
+                # overhead, allocator jitter) — process 0's call decides
+                # for the pod.
+                from jax.experimental import multihost_utils
+
+                decision = bool(
+                    int(
+                        multihost_utils.broadcast_one_to_all(
+                            jnp.asarray(int(decision), jnp.int32)
+                        )
+                    )
+                )
+            self._materialize = bool(decision)
 
         buf_sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
         padded = self._padded_rows
