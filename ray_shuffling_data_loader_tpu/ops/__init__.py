@@ -7,7 +7,9 @@ from ray_shuffling_data_loader_tpu.ops.interaction import (  # noqa: F401
 )
 from ray_shuffling_data_loader_tpu.ops.ring_attention import (  # noqa: F401
     attention_reference,
+    blockwise_attention,
     make_ring_attention,
+    make_ulysses_attention,
     ring_attention,
 )
 
@@ -16,6 +18,8 @@ __all__ = [
     "dot_interaction_reference",
     "num_pairs",
     "attention_reference",
+    "blockwise_attention",
     "make_ring_attention",
+    "make_ulysses_attention",
     "ring_attention",
 ]

@@ -1,32 +1,33 @@
-"""Ring attention: sequence-parallel exact attention over a mesh axis.
+"""Sequence-parallel exact attention over a mesh axis: ring and Ulysses.
 
 Long-context support for the framework's model layer. The reference has no
 attention anywhere (its workload is tabular row shuffling, SURVEY §5), so
-this op has no reference analog — it exists because a TPU-native framework
-must scale sequence length past one chip's HBM, and the TPU-idiomatic way
-is blockwise attention with K/V chunks rotating around the ICI ring
-(``lax.ppermute``), never materializing the full [T, T] score matrix or
-gathering the full sequence on any device.
+these ops have no reference analog — they exist because a TPU-native
+framework must scale sequence length past one chip's HBM. Two canonical
+schedules, both exact (forward and gradients) vs the dense reference:
 
-Design (the Ring Attention construction of Liu et al., re-derived for
-``shard_map``):
+**Ring** (the Ring Attention construction of Liu et al., re-derived for
+``shard_map``): Q stays put; K/V chunks take ``p`` hops around the ICI
+ring (``lax.ppermute``), each hop accumulating with the online
+(flash-style) softmax — running row max ``m``, normalizer ``l``, and
+un-normalized ``o`` in float32. No device ever gathers the full sequence
+or builds more than a [T/p, T/p] score block, so memory scales with the
+shard, not T — the schedule for sequences that only fit sharded.
 
-* Q, K, V are sharded along the sequence axis of the mesh; each device
-  holds one contiguous chunk of the sequence.
-* The local chunk of Q stays put. K/V chunks take ``p`` hops around the
-  ring; at hop ``i`` a device holds the K/V chunk originally owned by
-  ``(me - i) mod p`` and accumulates its contribution with the online
-  (flash-style) softmax: running row max ``m``, normalizer ``l``, and
-  un-normalized output ``o`` in float32.
-* Causal masking uses global positions reconstructed from the chunk
-  index, so masking is exact across chunk boundaries; the compute for a
-  hop is uniform regardless of masking (no data-dependent control flow —
-  XLA-friendly, at the cost of computing fully-masked blocks).
-* Each ``ppermute`` overlaps with the hop's einsum under XLA async
-  collectives on TPU; accumulation is f32 regardless of input dtype.
+**Ulysses** (all-to-all): one ``all_to_all`` redistributes sequence↔heads
+so each device holds the FULL sequence for H/p heads, attends locally in
+KV chunks (blockwise online softmax — still no [T, T] matrix), and an
+inverse ``all_to_all`` restores sequence shards. Activations DO hold the
+full [T, H/p, D] sequence per device, so T must fit unsharded per head
+group; within that regime it replaces ``p`` ring hops with two bulk
+collectives, which overlap better when per-hop compute is too small to
+hide latency. Requires ``heads % p == 0``.
 
-The op is differentiable (``scan`` + ``ppermute`` transpose cleanly), so
-it drops into a train step unchanged.
+Shared properties: causal masking is exact across chunk boundaries using
+global positions; per-hop/per-chunk compute is mask-independent (no
+data-dependent control flow — XLA-friendly); both differentiate cleanly
+(``scan`` + collectives transpose), so they drop into a train step
+unchanged.
 """
 
 from __future__ import annotations
@@ -63,6 +64,35 @@ def attention_reference(
     return out.astype(q.dtype)
 
 
+def _online_update(o, m, l, s, v_c):
+    """One flash-style accumulation step: fold score block ``s``
+    ([b, h, tq, ck]) and its values ``v_c`` ([b, ck, h, d]) into the
+    running (un-normalized output, row max, normalizer)."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)  # rescale of prior accumulation
+    p_ij = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + jnp.sum(p_ij, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p_ij, v_c.astype(jnp.float32)
+    )
+    return o_new, m_new, l_new
+
+
+def _accum_init(b, h, tq, d):
+    return (
+        jnp.zeros((b, h, tq, d), jnp.float32),
+        jnp.full((b, h, tq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, tq), jnp.float32),
+    )
+
+
+def _accum_finish(o, l, out_dtype):
+    # Fully-masked rows (possible only for degenerate inputs) get 0, not
+    # NaN.
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(out_dtype)
+
+
 def _ring_attention_local(
     q: jax.Array,
     k: jax.Array,
@@ -91,27 +121,78 @@ def _ring_attention_local(
             k_pos = chunk * tk + jnp.arange(tk)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)  # rescale of prior accumulation
-        p_ij = jnp.exp(s - m_new[..., None])
-        l_new = l * alpha + jnp.sum(p_ij, axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p_ij, v_c.astype(jnp.float32)
-        )
+        o, m, l = _online_update(o, m, l, s, v_c)
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)
-        return (o_new, m_new, l_new, k_c, v_c), None
+        return (o, m, l, k_c, v_c), None
 
-    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
-    m0 = jnp.full((b, h, tq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    o0, m0, l0 = _accum_init(b, h, tq, d)
     (o, _, l, _, _), _ = lax.scan(
         hop, (o0, m0, l0, k, v), jnp.arange(p)
     )
-    # Fully-masked rows (possible only for degenerate inputs) get 0, not
-    # NaN.
-    out = o / jnp.maximum(l[..., None], 1e-30)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    return _accum_finish(o, l, q.dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    kv_chunk: int = 1024,
+) -> jax.Array:
+    """Single-device exact attention in KV chunks (flash-style online
+    softmax): peak score memory is [b, h, tq, kv_chunk], never [T, T].
+    The local compute of the Ulysses body, and usable standalone for long
+    sequences on one device."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    chunk = min(kv_chunk, tk)
+    nch = -(-tk // chunk)
+    pad = nch * chunk - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(tq)
+
+    def step(carry, i):
+        o, m, l = carry
+        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+        # Static guard: the mask depends on the traced chunk index, so
+        # XLA cannot fold it away — skip building it entirely in the
+        # common unpadded non-causal case.
+        if pad or causal:
+            k_pos = i * chunk + jnp.arange(chunk)
+            valid = (k_pos < tk)[None, :]
+            if causal:
+                valid = valid & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(valid[None, None], s, NEG_INF)
+        o, m, l = _online_update(o, m, l, s, v_c)
+        return (o, m, l), None
+
+    (o, _, l), _ = lax.scan(step, _accum_init(b, h, tq, d), jnp.arange(nch))
+    return _accum_finish(o, l, q.dtype)
+
+
+def _seq_parallel_jit(mesh: Mesh, axis_name: str, body):
+    """Shared scaffolding for both schedules: shard q/k/v along the
+    sequence dimension, run the per-device ``body`` under ``shard_map``,
+    jit with matching in/out shardings."""
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    sharding = NamedSharding(mesh, spec)
+    return jax.jit(fn, in_shardings=(sharding,) * 3, out_shardings=sharding)
 
 
 @functools.lru_cache(maxsize=None)
@@ -130,21 +211,13 @@ def make_ring_attention(
     the one-shot :func:`ring_attention` wrapper in a step loop) reuse one
     traced/compiled function instead of re-compiling per call.
     """
-    from jax import shard_map
-
-    spec = P(None, axis_name, None, None)
-    body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal
+    return _seq_parallel_jit(
+        mesh,
+        axis_name,
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal
+        ),
     )
-    fn = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    sharding = NamedSharding(mesh, spec)
-    return jax.jit(fn, in_shardings=(sharding,) * 3, out_shardings=sharding)
 
 
 def ring_attention(
@@ -160,3 +233,60 @@ def ring_attention(
     if mesh is None:
         return attention_reference(q, k, v, causal=causal)
     return make_ring_attention(mesh, axis_name, causal)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+    kv_chunk: int,
+):
+    """Per-device body: one ``all_to_all`` each way redistributes
+    sequence↔heads, so this device attends over the FULL sequence for
+    its H/p head subset — in KV chunks (:func:`blockwise_attention`), so
+    no [T, T] block materializes. Activations still hold [T, H/p, D]
+    per device (see the module docstring for the regime split vs ring).
+    """
+    # [B, Tl, H, D] -> [B, T, H/p, D]: split heads, gather sequence.
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = blockwise_attention(qh, kh, vh, causal=causal, kv_chunk=kv_chunk)
+    # [B, T, H/p, D] -> [B, Tl, H, D]: back to sequence shards.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+@functools.lru_cache(maxsize=None)
+def make_ulysses_attention(
+    mesh: Mesh,
+    axis_name: str = "data",
+    causal: bool = False,
+    kv_chunk: int = 1024,
+):
+    """All-to-all (Ulysses-style) sequence-parallel attention over
+    ``mesh``'s ``axis_name`` — the second canonical long-context
+    strategy next to :func:`make_ring_attention`, preferable when
+    ``heads`` is a multiple of the axis size and per-chunk compute is
+    too small to hide ``p`` ring hops (each device must fit the full
+    sequence for its head group, though — the ring has no such bound).
+    Same contract: ``fn(q, k, v) -> out`` on ``[batch, seq, heads,
+    head_dim]`` arrays sharded along ``seq``; both ``seq`` and ``heads``
+    must be divisible BY the axis size. Memoized like
+    :func:`make_ring_attention`."""
+    return _seq_parallel_jit(
+        mesh,
+        axis_name,
+        functools.partial(
+            _ulysses_local,
+            axis_name=axis_name,
+            causal=causal,
+            kv_chunk=kv_chunk,
+        ),
+    )

@@ -1,0 +1,89 @@
+"""Real-TPU validation of the resident loader (gated: RSDL_TPU_TESTS=1).
+
+``tests/conftest.py`` pins the test process to CPU, so the check runs in a
+fresh subprocess allowed to bring up the accelerator. Proves on hardware
+what the CPU-mesh tests prove functionally: exactly-once delivery from an
+HBM-resident buffer, stream equality of the materialized-epoch and
+per-batch-gather schedules, and (printed, not asserted) their relative
+epoch timings — the numbers that decide the default schedule on TPU.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RSDL_TPU_TESTS") != "1",
+    reason="set RSDL_TPU_TESTS=1 on a TPU host to run real-chip tests",
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TPU_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["RSDL_TEST_REPO"])
+import numpy as np
+import jax
+
+assert jax.default_backend() != "cpu", jax.default_backend()
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import (
+    LABEL_COLUMN, EMBEDDING_COLUMNS, generate_data,
+)
+from ray_shuffling_data_loader_tpu.resident import (
+    DeviceResidentShufflingDataset,
+)
+
+runtime.init(num_workers=2)
+data_dir = os.environ["RSDL_TEST_TMP"]
+filenames, _ = generate_data(200_000, 4, 1, 0.0, data_dir)
+features = EMBEDDING_COLUMNS[:6] + ["key"]
+
+streams = {}
+for mat in (True, False):
+    ds = DeviceResidentShufflingDataset(
+        filenames,
+        num_epochs=2,
+        batch_size=25_000,
+        feature_columns=features,
+        label_column=LABEL_COLUMN,
+        seed=5,
+        materialize_epoch=mat,
+    )
+    epochs = []
+    for epoch in range(2):
+        t0 = time.perf_counter()
+        ds.set_epoch(epoch)
+        keys = np.concatenate(
+            [np.asarray(f["key"]) for f, _ in ds]
+        )
+        jax.effects_barrier()
+        dt = time.perf_counter() - t0
+        assert np.array_equal(np.sort(keys), np.arange(200_000)), (
+            mat, epoch,
+        )
+        epochs.append(keys)
+        print(f"RESIDENT_TPU mat={mat} epoch={epoch} {dt:.3f}s", flush=True)
+    streams[mat] = epochs
+for epoch in range(2):
+    assert np.array_equal(streams[True][epoch], streams[False][epoch])
+runtime.shutdown()
+print("RESIDENT_TPU_OK", flush=True)
+"""
+
+
+def test_resident_loader_on_tpu(tmp_path):
+    env = dict(os.environ, RSDL_TEST_REPO=_REPO, RSDL_TEST_TMP=str(tmp_path))
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-u", "-c", _TPU_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "RESIDENT_TPU_OK" in proc.stdout, proc.stdout[-2000:]
