@@ -5,6 +5,9 @@ from ray_shuffling_data_loader_tpu.ops.interaction import (  # noqa: F401
     dot_interaction_reference,
     num_pairs,
 )
+from ray_shuffling_data_loader_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+)
 from ray_shuffling_data_loader_tpu.ops.ring_attention import (  # noqa: F401
     attention_reference,
     blockwise_attention,
@@ -19,6 +22,7 @@ __all__ = [
     "num_pairs",
     "attention_reference",
     "blockwise_attention",
+    "flash_attention",
     "make_ring_attention",
     "make_ulysses_attention",
     "ring_attention",

@@ -1,0 +1,243 @@
+"""Pallas TPU flash attention: fused blockwise softmax-attention kernel.
+
+The XLA lowerings in :mod:`.ring_attention` keep exactness and memory
+bounds but leave fusion to the compiler; this kernel hand-fuses one
+(q-block × kv-block) tile pipeline in VMEM — scores, online softmax, and
+the value matmul never round-trip to HBM, with K/V streamed block by
+block across the innermost grid dimension into a revisited accumulator
+(the flash-attention construction, written Pallas-idiomatically: MXU
+matmuls via ``lax.dot_general``, ``@pl.when`` for first/last-block
+prologue/epilogue, lane-padded VMEM scratch for the running max and
+normalizer).
+
+Scope: single-device attention over ``[batch, seq, heads, head_dim]``.
+It composes with the sequence-parallel schedules (the Ulysses local body
+and each ring hop are exactly this computation) but is wired as the
+standalone ``flash_attention`` op with an XLA fallback — same
+auto-policy shape as the DLRM interaction kernel (``ops/interaction.py``):
+Pallas on single-device TPU, XLA reference elsewhere, interpret mode for
+CPU tests.
+
+Differentiability: forward-only kernel with an exact XLA VJP (the dense
+reference's gradient). A fused flash backward (recompute from saved
+``(out, l, m)``) is future work; until then training long sequences
+should use the XLA paths, whose VJPs XLA fuses adequately.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_shuffling_data_loader_tpu.ops.ring_attention import (
+    NEG_INF,
+    attention_reference,
+)
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+):
+    """One (batch·head, q-block, kv-block) grid cell.
+
+    The kv dimension is the innermost grid axis; the output block is
+    revisited across it, carrying (running max, normalizer, accumulator)
+    in VMEM scratch.
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    def _update():
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [bq, bk]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        needs_mask = causal or seq_len % block_k != 0
+        if needs_mask:
+            valid = k_pos < seq_len  # pad keys past the real sequence
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[:, :1]  # [bq, 1] (lanes replicated)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
+            p.astype(jnp.float32),
+            v.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Skip fully-masked (strictly upper-right) blocks: the first
+        # valid kv block for q-block qi always exists at ki == 0, so the
+        # ki == 0 initialization above is never the skipped cell.
+        pl.when((qi + 1) * block_q > ki * block_k)(_update)
+    else:
+        _update()
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        o_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    tq_pad = -(-t // bq) * bq
+    tk_pad = -(-t // bk) * bk
+
+    def to_bh(x, t_pad):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
+
+    qb = to_bh(q, tq_pad)
+    kb = to_bh(k, tk_pad)
+    vb = to_bh(v, tk_pad)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=bq,
+        block_k=bk,
+        seq_len=t,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, tq_pad // bq, tk_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max
+            pltpu.VMEM((bq, 128), jnp.float32),  # normalizer
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :t].reshape(b, h, t, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (
+        q,
+        k,
+        v,
+    )
+
+
+def _bwd(causal, block_q, block_k, interpret, res, ct):
+    # Exact XLA gradient of the same math (dense reference VJP); a fused
+    # flash backward is future work (module docstring).
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_reference(q, k, v, causal=causal), q, k, v
+    )
+    return vjp(ct)
+
+
+_flash_vjp.defvjp(_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    use_pallas: Optional[bool] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention over ``[batch, seq, heads, head_dim]``.
+
+    ``use_pallas=None`` auto-selects the kernel on a single-device TPU
+    backend and the XLA dense reference elsewhere (same policy as
+    :func:`~.interaction.dot_interaction`); ``interpret=True`` runs the
+    kernel in interpreter mode (CPU tests).
+    """
+    if use_pallas is None:
+        from ray_shuffling_data_loader_tpu.ops.interaction import (
+            _auto_pallas,
+        )
+
+        use_pallas = _auto_pallas()
+    if not use_pallas:
+        return attention_reference(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_vjp(q, k, v, causal, block_q, block_k, interpret)

@@ -1,0 +1,92 @@
+"""Pallas flash-attention kernel vs the dense reference, interpreter mode
+(the compiled-on-TPU check lives in ``tests/test_ops_tpu.py``'s pattern;
+CI has no TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu.ops import attention_reference
+from ray_shuffling_data_loader_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(shape, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32), dtype)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "shape,blocks",
+    [
+        ((2, 64, 2, 8), (16, 16)),  # multiple kv blocks per q block
+        ((1, 56, 2, 8), (16, 24)),  # ragged: seq divides neither block
+        ((2, 8, 1, 4), (128, 128)),  # seq smaller than the block
+    ],
+)
+def test_matches_dense_reference(causal, shape, blocks):
+    q, k, v = _qkv(shape, seed=1)
+    got = flash_attention(
+        q,
+        k,
+        v,
+        causal=causal,
+        use_pallas=True,
+        block_q=blocks[0],
+        block_k=blocks[1],
+        interpret=True,
+    )
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_bfloat16(seed=3):
+    q, k, v = _qkv((2, 32, 2, 8), seed=seed, dtype=jnp.bfloat16)
+    got = flash_attention(
+        q, k, v, use_pallas=True, block_q=16, block_k=16, interpret=True
+    )
+    assert got.dtype == jnp.bfloat16
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32),
+        np.asarray(want, dtype=np.float32),
+        rtol=5e-2,
+        atol=5e-2,
+    )
+
+
+def test_gradients_exact():
+    """The custom VJP is the dense reference's gradient — exact."""
+    q, k, v = _qkv((1, 32, 2, 8), seed=4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, use_pallas=True,
+                block_q=16, block_k=16, interpret=True,
+            )
+            ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_f = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_f, g_d):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_xla_fallback_path():
+    q, k, v = _qkv((1, 16, 2, 4), seed=5)
+    got = flash_attention(q, k, v, use_pallas=False)
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))

@@ -83,3 +83,51 @@ def test_pallas_compiled_on_tpu():
     assert proc.returncode == 0 and "TPU_OPS_OK" in proc.stdout, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
     )
+
+
+_FLASH_SCRIPT = r"""
+import os
+import sys
+sys.path.insert(0, os.environ["RSDL_TEST_REPO"])
+import numpy as np
+import jax
+import jax.numpy as jnp
+from ray_shuffling_data_loader_tpu.ops import attention_reference
+from ray_shuffling_data_loader_tpu.ops.flash_attention import flash_attention
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+
+rng = np.random.default_rng(1)
+for causal in (False, True):
+    # Ragged T exercises the padded tail blocks compiled.
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 1000, 4, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    got = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, use_pallas=True, interpret=False
+        )
+    )(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-3, (causal, err)
+    print(f"FLASH_TPU causal={causal} max_err={err:.2e}", flush=True)
+print("FLASH_TPU_OK", flush=True)
+"""
+
+
+def test_flash_attention_compiled_on_tpu():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["RSDL_TEST_REPO"] = _REPO
+    proc = subprocess.run(
+        [sys.executable, "-c", _FLASH_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    assert proc.returncode == 0 and "FLASH_TPU_OK" in proc.stdout, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    )

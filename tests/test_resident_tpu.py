@@ -27,7 +27,7 @@ sys.path.insert(0, os.environ["RSDL_TEST_REPO"])
 import numpy as np
 import jax
 
-assert jax.default_backend() != "cpu", jax.default_backend()
+assert jax.default_backend() == "tpu", jax.default_backend()
 
 from ray_shuffling_data_loader_tpu import runtime
 from ray_shuffling_data_loader_tpu.data_generation import (
