@@ -7,6 +7,11 @@ from ray_shuffling_data_loader_tpu.models.dlrm import (  # noqa: F401
     dlrm_for_data_spec,
     example_features,
 )
+from ray_shuffling_data_loader_tpu.models.lm import (  # noqa: F401
+    CausalLM,
+    next_token_loss,
+    synthetic_tokens,
+)
 from ray_shuffling_data_loader_tpu.models.transformer import (  # noqa: F401
     TabTransformer,
     transformer_for_data_spec,

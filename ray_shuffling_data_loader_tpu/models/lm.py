@@ -1,0 +1,113 @@
+"""Causal transformer LM: the long-context model family.
+
+Completes the model zoo's coverage of the framework's parallelism
+surface: the DLRM exercises dp×tp (vocab-sharded tables), the
+TabTransformer exercises attention over column tokens, and this family
+exercises **sequence parallelism** — a causal LM whose attention runs
+ring- or Ulysses-scheduled over a mesh axis, so the sequence dimension
+scales past one chip's memory (the task's long-context requirement; the
+reference repo has no model compute at all).
+
+Blocks are shared with the TabTransformer (:class:`~.transformer
+.EncoderBlock` with a causal ``attention_fn``); bfloat16 compute /
+float32 params as everywhere in the zoo.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+
+from ray_shuffling_data_loader_tpu.models.transformer import EncoderBlock
+from ray_shuffling_data_loader_tpu.ops.ring_attention import (
+    attention_reference,
+)
+
+
+class CausalLM(nn.Module):
+    """Next-token transformer over int32 token ids.
+
+    ``__call__(tokens [batch, seq]) -> logits [batch, seq, vocab]``.
+
+    ``attention_fn`` must apply a CAUSAL mask (default: the dense
+    reference with ``causal=True``; pass
+    ``make_ring_attention(mesh, axis, causal=True)`` or the Ulysses
+    equivalent to shard the sequence axis).
+    """
+
+    vocab_size: int
+    max_seq_len: int
+    embed_dim: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        b, t = tokens.shape
+        embed = self.param(
+            "token_embed",
+            nn.initializers.normal(stddev=0.02),
+            (self.vocab_size, self.embed_dim),
+            jnp.float32,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_seq_len, self.embed_dim),
+            jnp.float32,
+        )
+        x = jnp.take(embed, tokens % self.vocab_size, axis=0)
+        x = (x + pos[None, :t]).astype(self.compute_dtype)
+        attention = self.attention_fn or functools.partial(
+            attention_reference, causal=True
+        )
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                compute_dtype=self.compute_dtype,
+                attention_fn=attention,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_out")(x)
+        # Weight-tied readout: logits against the embedding table (keeps
+        # the params lean and the matmul on the MXU).
+        logits = jnp.einsum(
+            "btd,vd->btv", x.astype(jnp.float32), embed
+        )
+        return logits
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean cross-entropy of predicting token ``t+1`` from position ``t``.
+
+    Targets fold into the vocab exactly like the model's input hashing
+    (``tokens % vocab`` in ``__call__``) — without it, an out-of-range id
+    would be silently CLAMPED by ``take_along_axis`` under jit and train
+    toward the wrong class."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    targets = tokens[:, 1:] % logits.shape[-1]
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def synthetic_tokens(
+    batch: int, seq_len: int, vocab: int, seed: int = 0
+) -> np.ndarray:
+    """Learnable synthetic stream: a periodic pattern with per-sample
+    phase plus light noise — next-token loss genuinely falls."""
+    rng = np.random.default_rng(seed)
+    period = min(vocab, 17)
+    phase = rng.integers(0, period, (batch, 1))
+    base = (np.arange(seq_len)[None, :] + phase) % period
+    noise = rng.integers(0, vocab, (batch, seq_len))
+    use_noise = rng.random((batch, seq_len)) < 0.05
+    return np.where(use_noise, noise, base).astype(np.int32)

@@ -177,13 +177,16 @@ def blockwise_attention(
     return _accum_finish(o, l, q.dtype)
 
 
-def _seq_parallel_jit(mesh: Mesh, axis_name: str, body):
+def _seq_parallel_jit(
+    mesh: Mesh, axis_name: str, body, batch_axis: Optional[str] = None
+):
     """Shared scaffolding for both schedules: shard q/k/v along the
-    sequence dimension, run the per-device ``body`` under ``shard_map``,
-    jit with matching in/out shardings."""
+    sequence dimension (and optionally the batch dimension along
+    ``batch_axis`` — composes with data parallelism), run the per-device
+    ``body`` under ``shard_map``, jit with matching in/out shardings."""
     from jax import shard_map
 
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
     fn = shard_map(
         body,
         mesh=mesh,
@@ -200,15 +203,18 @@ def make_ring_attention(
     mesh: Mesh,
     axis_name: str = "data",
     causal: bool = False,
+    batch_axis: Optional[str] = None,
 ):
     """Build a jitted ring-attention over ``mesh``'s ``axis_name``.
 
     Returns ``fn(q, k, v) -> out`` operating on global arrays of shape
     ``[batch, seq, heads, head_dim]`` sharded (or shardable) along the
     sequence dimension; ``seq`` must divide evenly by the axis size.
+    ``batch_axis`` additionally shards the batch dimension (dp × sp
+    meshes — batch must then divide that axis size).
 
-    Memoized on ``(mesh, axis_name, causal)`` so repeated calls (incl.
-    the one-shot :func:`ring_attention` wrapper in a step loop) reuse one
+    Memoized on the argument tuple so repeated calls (incl. the one-shot
+    :func:`ring_attention` wrapper in a step loop) reuse one
     traced/compiled function instead of re-compiling per call.
     """
     return _seq_parallel_jit(
@@ -217,6 +223,7 @@ def make_ring_attention(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal
         ),
+        batch_axis=batch_axis,
     )
 
 
@@ -269,6 +276,7 @@ def make_ulysses_attention(
     axis_name: str = "data",
     causal: bool = False,
     kv_chunk: int = 1024,
+    batch_axis: Optional[str] = None,
 ):
     """All-to-all (Ulysses-style) sequence-parallel attention over
     ``mesh``'s ``axis_name`` — the second canonical long-context
@@ -289,4 +297,5 @@ def make_ulysses_attention(
             causal=causal,
             kv_chunk=kv_chunk,
         ),
+        batch_axis=batch_axis,
     )

@@ -7,6 +7,9 @@ cd "$(dirname "$0")"
 export JAX_PLATFORMS=cpu
 python examples/train_dlrm.py --smoke
 python examples/train_dlrm.py --smoke --loader resident --model transformer
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python examples/train_long_context.py --dp 2 --sp 4 --steps 8 \
+    --seq-len 256
 python examples/train_dlrm_multirank.py --num-trainers 2 \
     --num-rows 50000 --num-files 4 --batch-size 5000 --epochs 2
 python -m ray_shuffling_data_loader_tpu.dataset
