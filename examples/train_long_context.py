@@ -44,7 +44,10 @@ def parse_args(argv=None):
         "--attention", choices=("ring", "ulysses", "dense"), default="ring"
     )
     p.add_argument("--seed", type=int, default=0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.steps < 2:
+        p.error("--steps must be >= 2 (the run asserts the loss falls)")
+    return args
 
 
 def main(argv=None) -> int:

@@ -397,6 +397,34 @@ class DeviceResidentShufflingDataset:
                 f"dataset has {n} rows but num_rows says {num_rows}"
             )
         self.num_rows = n
+
+        # Every process maps row offsets from ITS filename order; a
+        # divergent order (e.g. numeric vs lexicographic listing) would
+        # silently assemble a corrupt global buffer. Compare a digest of
+        # the stream identity against process 0's before staging.
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        ident = "\x00".join(
+            [*map(os.path.basename, filenames), *map(str, file_rows)]
+        )
+        digest = int.from_bytes(
+            hashlib.blake2s(ident.encode()).digest()[:4], "big"
+        )
+        # allgather (not broadcast-and-compare-locally): EVERY process
+        # must raise on divergence, or the agreeing ones proceed into
+        # the staging collective and hang waiting for the one that bailed.
+        digests = np.asarray(
+            multihost_utils.process_allgather(
+                jnp.asarray([digest], jnp.uint32)
+            )
+        ).reshape(-1)
+        if len(set(digests.tolist())) != 1:
+            raise ValueError(
+                "file list (order/rows) differs across processes; all "
+                "processes must pass the identical sequence of files"
+            )
         padded = math.ceil(n / data_shards) * data_shards
         self._padded_rows = padded
 
