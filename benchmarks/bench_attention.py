@@ -92,7 +92,15 @@ def main() -> None:
                     interpret=False,
                 )
             )
+        # Pre-shard inputs for the sequence-parallel schedules: without
+        # this, every timed rep would include a full scatter of q/k/v
+        # from device 0, which the single-device schedules never pay.
+        sharded_inputs = None
         if seq % n_dev == 0 and n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh, P(None, "sp", None, None))
+            sharded_inputs = tuple(jax.device_put(x, sh) for x in (q, k, v))
             schedules["ring"] = make_ring_attention(
                 mesh, "sp", causal=args.causal
             )
@@ -101,8 +109,13 @@ def main() -> None:
                     mesh, "sp", causal=args.causal
                 )
         for name, fn in schedules.items():
+            inputs = (
+                sharded_inputs
+                if name in ("ring", "ulysses")
+                else (q, k, v)
+            )
             try:
-                dt = _time(fn, (q, k, v), args.reps)
+                dt = _time(fn, inputs, args.reps)
             except Exception as exc:  # e.g. OOM at long T for dense
                 print(
                     json.dumps(

@@ -18,10 +18,11 @@ auto-policy shape as the DLRM interaction kernel (``ops/interaction.py``):
 Pallas on single-device TPU, XLA reference elsewhere, interpret mode for
 CPU tests.
 
-Differentiability: forward-only kernel with an exact XLA VJP (the dense
-reference's gradient). A fused flash backward (recompute from saved
-``(out, l, m)``) is future work; until then training long sequences
-should use the XLA paths, whose VJPs XLA fuses adequately.
+Differentiability: the kernel carries an exact, memory-safe custom VJP —
+the standard flash backward in chunked XLA (recompute softmax statistics
+with one blockwise pass, then accumulate ``dq`` and per-chunk
+``dk``/``dv``), so no ``[T, T]`` block materializes in the gradient
+either; a hand-fused Pallas backward kernel remains future work.
 """
 
 from __future__ import annotations
@@ -32,9 +33,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ray_shuffling_data_loader_tpu.ops.ring_attention import (
     NEG_INF,
+    _blockwise_fwd,
     attention_reference,
 )
 
@@ -193,21 +196,74 @@ def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (
-        q,
-        k,
-        v,
-    )
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    # ``out`` joins the residuals: the backward needs D = rowsum(ct*out)
+    # and would otherwise re-accumulate the whole output.
+    return out, (q, k, v, out)
+
+
+def _flash_backward(q, k, v, out, ct, causal, kv_chunk):
+    """Memory-safe exact backward: recompute the softmax STATISTICS with
+    one chunked stats pass (the primal ``out`` rides the residuals), then
+    accumulate dq and emit per-chunk dk/dv in a second chunked pass —
+    peak extra memory is ``[b, h, tq, kv_chunk]``, never ``[T, T]``.
+
+    Standard flash-attention gradient algebra: with ``p`` the softmax
+    probabilities, ``dp = ct @ vᵀ``, ``D = rowsum(ct ⊙ out)``, then
+    ``ds = p ⊙ (dp - D)``; ``dq = ds @ k``, ``dk = dsᵀ @ q`` (both times
+    ``scale``), ``dv = pᵀ @ ct``.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    chunk = min(kv_chunk, tk)
+    nch = -(-tk // chunk)
+    pad = nch * chunk - tk
+
+    _, m, l = _blockwise_fwd(q, k, v, causal, kv_chunk, with_output=False)
+    l = jnp.maximum(l, 1e-30)
+
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    ctf = ct.astype(jnp.float32)
+    # D[b, h, tq] = rowsum(ct * out)
+    big_d = jnp.einsum("bqhd,bqhd->bhq", ctf, out.astype(jnp.float32))
+    q_pos = jnp.arange(tq)
+
+    def step(dq, i):
+        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+            * scale
+        )
+        if pad or causal:
+            k_pos = i * chunk + jnp.arange(chunk)
+            valid = (k_pos < tk)[None, :]
+            if causal:
+                valid = valid & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(valid[None, None], s, NEG_INF)
+        p = jnp.exp(s - m[..., None]) / l[..., None]  # [b,h,tq,ck]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", ctf, v_c.astype(jnp.float32))
+        ds = p * (dp - big_d[..., None])
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k_c.astype(jnp.float32)) * scale
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, ctf)
+        return dq, (dk_c, dv_c)
+
+    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    dq, (dk_chunks, dv_chunks) = lax.scan(step, dq0, jnp.arange(nch))
+    # [nch, b, ck, h, d] -> [b, nch*ck, h, d] -> unpad
+    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
+    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, ct):
-    # Exact XLA gradient of the same math (dense reference VJP); a fused
-    # flash backward is future work (module docstring).
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_reference(q, k, v, causal=causal), q, k, v
-    )
-    return vjp(ct)
+    q, k, v, out = res
+    return _flash_backward(q, k, v, out, ct, causal, max(block_k, 128))
 
 
 _flash_vjp.defvjp(_fwd, _bwd)

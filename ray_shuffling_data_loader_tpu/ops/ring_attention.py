@@ -64,14 +64,20 @@ def attention_reference(
     return out.astype(q.dtype)
 
 
-def _online_update(o, m, l, s, v_c):
-    """One flash-style accumulation step: fold score block ``s``
-    ([b, h, tq, ck]) and its values ``v_c`` ([b, ck, h, d]) into the
-    running (un-normalized output, row max, normalizer)."""
+def _stats_update(m, l, s):
+    """Fold score block ``s`` ([b, h, tq, ck]) into the running softmax
+    statistics; returns the rescale factor and probabilities too."""
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     alpha = jnp.exp(m - m_new)  # rescale of prior accumulation
     p_ij = jnp.exp(s - m_new[..., None])
     l_new = l * alpha + jnp.sum(p_ij, axis=-1)
+    return m_new, l_new, alpha, p_ij
+
+
+def _online_update(o, m, l, s, v_c):
+    """One flash-style accumulation step: statistics plus the
+    un-normalized output against values ``v_c`` ([b, ck, h, d])."""
+    m_new, l_new, alpha, p_ij = _stats_update(m, l, s)
     o_new = o * alpha[..., None] + jnp.einsum(
         "bhqk,bkhd->bhqd", p_ij, v_c.astype(jnp.float32)
     )
@@ -133,17 +139,20 @@ def _ring_attention_local(
     return _accum_finish(o, l, q.dtype)
 
 
-def blockwise_attention(
+def _blockwise_fwd(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    causal: bool = False,
-    kv_chunk: int = 1024,
-) -> jax.Array:
-    """Single-device exact attention in KV chunks (flash-style online
-    softmax): peak score memory is [b, h, tq, kv_chunk], never [T, T].
-    The local compute of the Ulysses body, and usable standalone for long
-    sequences on one device."""
+    causal: bool,
+    kv_chunk: int,
+    with_output: bool = True,
+):
+    """Chunked forward returning ``(out, m, l)`` — the softmax statistics
+    the flash backward recomputes probabilities from. ``out`` is in the
+    inputs' dtype; ``m``/``l`` are float32 ``[b, h, tq]``.
+    ``with_output=False`` skips the value accumulation (returns ``out``
+    None) — the backward already holds the primal output and only needs
+    the statistics."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     chunk = min(kv_chunk, tk)
@@ -156,10 +165,7 @@ def blockwise_attention(
     qf = q.astype(jnp.float32) * scale
     q_pos = jnp.arange(tq)
 
-    def step(carry, i):
-        o, m, l = carry
-        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
-        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+    def masked_scores(i, k_c):
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
         # Static guard: the mask depends on the traced chunk index, so
         # XLA cannot fold it away — skip building it entirely in the
@@ -170,11 +176,46 @@ def blockwise_attention(
             if causal:
                 valid = valid & (q_pos[:, None] >= k_pos[None, :])
             s = jnp.where(valid[None, None], s, NEG_INF)
-        o, m, l = _online_update(o, m, l, s, v_c)
-        return (o, m, l), None
+        return s
 
-    (o, _, l), _ = lax.scan(step, _accum_init(b, h, tq, d), jnp.arange(nch))
-    return _accum_finish(o, l, q.dtype)
+    if with_output:
+
+        def step(carry, i):
+            o, m, l = carry
+            k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+            v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+            o, m, l = _online_update(o, m, l, masked_scores(i, k_c), v_c)
+            return (o, m, l), None
+
+        (o, m, l), _ = lax.scan(
+            step, _accum_init(b, h, tq, d), jnp.arange(nch)
+        )
+        return _accum_finish(o, l, q.dtype), m, l
+
+    def stats_step(carry, i):
+        m, l = carry
+        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        m, l, _, _ = _stats_update(m, l, masked_scores(i, k_c))
+        return (m, l), None
+
+    _, m0, l0 = _accum_init(b, h, tq, d)
+    (m, l), _ = lax.scan(stats_step, (m0, l0), jnp.arange(nch))
+    return None, m, l
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    kv_chunk: int = 1024,
+) -> jax.Array:
+    """Single-device exact attention in KV chunks (flash-style online
+    softmax): peak score memory is [b, h, tq, kv_chunk], never [T, T].
+    The local compute of the Ulysses body, and usable standalone for long
+    sequences on one device."""
+    out, _, _ = _blockwise_fwd(q, k, v, causal, kv_chunk)
+    return out
 
 
 def _seq_parallel_jit(

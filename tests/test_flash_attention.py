@@ -85,6 +85,32 @@ def test_gradients_exact():
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_multi_chunk_ragged(causal):
+    """Backward with several KV chunks and a ragged tail (T=300 over
+    128-wide chunks) — the chunked-VJP path the single-chunk test
+    misses."""
+    q, k, v = _qkv((1, 300, 2, 8), seed=6)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=causal, use_pallas=True, interpret=True
+            )
+            ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    g_f = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_f, g_d):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=1e-3, atol=1e-4
+        )
+
+
 def test_xla_fallback_path():
     q, k, v = _qkv((1, 16, 2, 4), seed=5)
     got = flash_attention(q, k, v, use_pallas=False)
