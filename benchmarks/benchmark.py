@@ -14,6 +14,11 @@ session's shared-memory store instead of the raylet gRPC probe.
 Run:
     python benchmarks/benchmark.py --num-rows 1000000 --num-files 10 \
         --num-trainers 4 --num-reducers 8 --num-epochs 5 --num-trials 2
+
+Scope: this harness measures the HOST shuffle engine (map/reduce +
+actor consumers). The device-resident loader bypasses that engine
+entirely; its end-to-end measurement lives in the repo-root ``bench.py``
+(which auto-selects between the loaders) and ``BENCHLOG.md``.
 """
 
 from __future__ import annotations
