@@ -75,6 +75,46 @@ def _decode_narrow_to_store(filename: str, columns: Sequence[str]):
     return ctx.store.put_columns(cols)
 
 
+def _decode_narrow_range_to_store(
+    filename: str, columns: Sequence[str], row_lo: int, row_hi: int
+):
+    """Pool task: decode only the row range ``[row_lo, row_hi)`` of one
+    Parquet file — at row-group granularity, so a pod process staging a
+    slice of a boundary-straddling file never decompresses the rest of
+    it. Returns the ref (exactly ``row_hi - row_lo`` rows)."""
+    import pyarrow.parquet as pq
+
+    from ray_shuffling_data_loader_tpu.shuffle import _narrow_column
+    from ray_shuffling_data_loader_tpu.utils import is_remote_path
+
+    pf = pq.ParquetFile(
+        filename, memory_map=not is_remote_path(filename)
+    )
+    md = pf.metadata
+    sel = []
+    first_row = None
+    g_start = 0
+    for gi in range(md.num_row_groups):
+        g_end = g_start + md.row_group(gi).num_rows
+        if g_end > row_lo and g_start < row_hi:
+            if first_row is None:
+                first_row = g_start
+            sel.append(gi)
+        g_start = g_end
+    if first_row is None:
+        raise ValueError(
+            f"row range [{row_lo}, {row_hi}) outside file {filename!r}"
+        )
+    table = pf.read_row_groups(sel, columns=list(columns), use_threads=False)
+    a, b = row_lo - first_row, row_hi - first_row
+    cols = {}
+    for name in columns:
+        arr = table.column(name).to_numpy(zero_copy_only=False)
+        cols[name] = _narrow_column(name, np.ascontiguousarray(arr[a:b]))
+    ctx = runtime.ensure_initialized()
+    return ctx.store.put_columns(cols)
+
+
 def dataset_num_rows(filenames: Sequence[str]) -> int:
     """Total rows across Parquet files from metadata only (no decode)."""
     import pyarrow.parquet as pq
@@ -471,25 +511,30 @@ class DeviceResidentShufflingDataset:
 
         local = np.zeros((ncols, hi - lo), np.int32)
         offsets = np.concatenate([[0], np.cumsum(file_rows)])
-        want = [
-            i
-            for i in range(len(filenames))
-            if offsets[i + 1] > lo and offsets[i] < min(hi, n)
-        ]
-        # Local pool on purpose: cluster-wide scatter would publish
-        # segments on other hosts and pull them straight back over DCN.
+        # Per-file overlap with this process's range, decoded at
+        # row-group granularity (a boundary-straddling file costs only
+        # its overlapping groups, not a full decompress). Local pool on
+        # purpose: cluster-wide scatter would publish segments on other
+        # hosts and pull them straight back over DCN.
+        spans_by_file = []
+        for i in range(len(filenames)):
+            file_lo = max(lo, int(offsets[i]))
+            file_hi = min(hi, min(int(offsets[i + 1]), n))
+            if file_lo < file_hi:
+                spans_by_file.append((i, file_lo, file_hi))
         futs = {
             i: ctx.pool.submit(
-                _decode_narrow_to_store, filenames[i], self._columns
+                _decode_narrow_range_to_store,
+                filenames[i],
+                self._columns,
+                file_lo - int(offsets[i]),
+                file_hi - int(offsets[i]),
             )
-            for i in want
+            for i, file_lo, file_hi in spans_by_file
         }
-        for i in want:
+        for i, file_lo, file_hi in spans_by_file:
             ref = futs[i].result()
             cb = ctx.store.get_columns(ref)
-            file_lo = max(lo, int(offsets[i]))
-            file_hi = min(hi, int(offsets[i + 1]))
-            src = slice(file_lo - int(offsets[i]), file_hi - int(offsets[i]))
             dst = slice(file_lo - lo, file_hi - lo)
             for ci, name in enumerate(self._columns):
                 arr = np.asarray(cb[name])
@@ -499,7 +544,7 @@ class DeviceResidentShufflingDataset:
                         f"to {arr.dtype}, schema says "
                         f"{self._col_dtypes[name]}"
                     )
-                local[ci, dst] = arr[src].view(np.int32)
+                local[ci, dst] = arr.view(np.int32)
             self.stats.bytes_staged += ncols * (file_hi - file_lo) * 4
             del cb
             ctx.store.free([ref])
@@ -674,12 +719,29 @@ class DeviceResidentShufflingDataset:
         return full + (1 if rem and not self.drop_last else 0)
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
+        self._check_open()
         if not 0 <= epoch < self.num_epochs:
             raise ValueError(
                 f"epoch {epoch} outside num_epochs {self.num_epochs}"
             )
         self._epoch = epoch
         self._skip = int(skip_batches)
+
+    def close(self) -> None:
+        """Release the resident buffers (HBM) deterministically instead
+        of waiting for GC — after this the dataset cannot iterate."""
+        self._closed = True
+        self._buf = None
+        self._epoch_buf_cache.clear()
+        self._perm_cache.clear()
+        self._gather_cache.clear()
+        self._epoch = None
+
+    def _check_open(self) -> None:
+        if getattr(self, "_closed", False):
+            raise RuntimeError(
+                "dataset is closed (close() released its device buffers)"
+            )
 
     def _perm(self, epoch: int) -> jax.Array:
         perm = self._perm_cache.get(epoch)
@@ -691,6 +753,7 @@ class DeviceResidentShufflingDataset:
         return perm
 
     def __iter__(self):
+        self._check_open()
         if self._epoch is None:
             raise RuntimeError("set_epoch must be called before iterating")
         epoch, skip = self._epoch, self._skip

@@ -187,6 +187,18 @@ def test_epoch_bounds_and_bad_rank(local_runtime, resident_files):
         _make(resident_files, num_trainers=2, rank=2)
 
 
+def test_close_releases_and_blocks_iteration(local_runtime, resident_files):
+    ds = _make(resident_files)
+    ds.set_epoch(0)
+    next(iter(ds))
+    ds.close()
+    assert ds._buf is None
+    with pytest.raises(RuntimeError, match="closed"):
+        next(iter(ds))
+    with pytest.raises(RuntimeError, match="closed"):
+        ds.set_epoch(0)
+
+
 def test_stats_accounting(local_runtime, resident_files):
     ds = _make(resident_files)
     # Features + label, 4 bytes per value, every real row staged once.
@@ -194,6 +206,27 @@ def test_stats_accounting(local_runtime, resident_files):
     ds.set_epoch(0)
     n = sum(1 for _ in ds)
     assert ds.stats.batches_staged == n
+
+
+def test_range_decode(local_runtime, resident_files):
+    """Row-group-granular range decode (pod staging's per-file slice):
+    exact rows, within one group and across the group boundary."""
+    from ray_shuffling_data_loader_tpu import runtime as rt
+    from ray_shuffling_data_loader_tpu.resident import (
+        _decode_narrow_range_to_store,
+    )
+
+    store = rt.get_context().store
+    # resident_files[0] holds keys [0, ~2731) in 2 row groups.
+    for lo, hi in ((100, 900), (1000, 2400)):
+        ref = _decode_narrow_range_to_store(
+            resident_files[0], ["key"], lo, hi
+        )
+        keys = np.asarray(store.get_columns(ref)["key"])
+        assert np.array_equal(keys, np.arange(lo, hi))
+        store.free([ref])
+    with pytest.raises(ValueError, match="outside"):
+        _decode_narrow_range_to_store(resident_files[0], ["key"], 10**9, 10**9 + 1)
 
 
 def test_num_rows_hint(local_runtime, resident_files):

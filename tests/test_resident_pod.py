@@ -47,7 +47,11 @@ NUM_ROWS, BATCH = 8000, 1000
 # design (each host decodes the files overlapping its row range).
 runtime.init(num_workers=2)
 if rank == 0:
-    generate_data(NUM_ROWS, 4, 1, 0.0, rdv + "/data_tmp")
+    # num_files=3 floors to 2666 rows/file and actually writes FOUR
+    # files (2666 x 3 + a 2-row tail); what matters here: the process
+    # boundary (row 4000) straddles file 1, so the row-group-granular
+    # range decode path is genuinely exercised.
+    generate_data(NUM_ROWS, 3, 2, 0.0, rdv + "/data_tmp")
     os.rename(rdv + "/data_tmp", rdv + "/data")
 else:
     deadline = time.time() + 120
