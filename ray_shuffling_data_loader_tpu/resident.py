@@ -101,9 +101,13 @@ def _decode_narrow_range_to_store(
                 first_row = g_start
             sel.append(gi)
         g_start = g_end
-    if first_row is None:
+    # g_start is now the file's total row count; reject ANY range not
+    # fully inside it (a numpy slice would silently clamp a too-large
+    # row_hi to fewer rows than the contract promises).
+    if first_row is None or not 0 <= row_lo < row_hi <= g_start:
         raise ValueError(
-            f"row range [{row_lo}, {row_hi}) outside file {filename!r}"
+            f"row range [{row_lo}, {row_hi}) outside file {filename!r} "
+            f"({g_start} rows)"
         )
     table = pf.read_row_groups(sel, columns=list(columns), use_threads=False)
     a, b = row_lo - first_row, row_hi - first_row
@@ -518,8 +522,10 @@ class DeviceResidentShufflingDataset:
         # hosts and pull them straight back over DCN.
         spans_by_file = []
         for i in range(len(filenames)):
+            # offsets[-1] == n is validated above, so file spans never
+            # exceed n on their own; only the process bound hi clips.
             file_lo = max(lo, int(offsets[i]))
-            file_hi = min(hi, min(int(offsets[i + 1]), n))
+            file_hi = min(hi, int(offsets[i + 1]))
             if file_lo < file_hi:
                 spans_by_file.append((i, file_lo, file_hi))
         futs = {
@@ -778,6 +784,11 @@ class DeviceResidentShufflingDataset:
         pending = deque()
         start = self._rank_start + skip * b
         for width in widths[skip:]:
+            # Re-checked per batch: a close() between yields must fail
+            # fast here, not crash inside jit on a None buffer (and, on
+            # the materialized path, not keep serving from the local
+            # ebuf reference after the docstring promised release).
+            self._check_open()
             if self._materialize:
                 item = self._slice_fn(width)(ebuf, np.int32(start))
             else:

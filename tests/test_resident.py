@@ -199,6 +199,19 @@ def test_close_releases_and_blocks_iteration(local_runtime, resident_files):
         ds.set_epoch(0)
 
 
+def test_close_invalidates_live_iterator(local_runtime, resident_files):
+    ds = _make(resident_files, lookahead=1)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)
+    ds.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        # Drain: the lookahead may hold a couple of pre-dispatched
+        # batches, but the next dispatch must fail fast.
+        for _ in range(5):
+            next(it)
+
+
 def test_stats_accounting(local_runtime, resident_files):
     ds = _make(resident_files)
     # Features + label, 4 bytes per value, every real row staged once.
@@ -227,6 +240,9 @@ def test_range_decode(local_runtime, resident_files):
         store.free([ref])
     with pytest.raises(ValueError, match="outside"):
         _decode_narrow_range_to_store(resident_files[0], ["key"], 10**9, 10**9 + 1)
+    # Partially-overlapping ranges must raise too, never silently truncate.
+    with pytest.raises(ValueError, match="outside"):
+        _decode_narrow_range_to_store(resident_files[0], ["key"], 2000, 10**9)
 
 
 def test_num_rows_hint(local_runtime, resident_files):
