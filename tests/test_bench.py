@@ -17,7 +17,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline", "backend"}
 
 
-def test_bench_emits_contract_json(tmp_path):
+def _run_bench(tmp_path, extra_env):
     env = dict(
         os.environ,
         # Force the failover path DETERMINISTICALLY, independent of this
@@ -34,6 +34,7 @@ def test_bench_emits_contract_json(tmp_path):
         # Mock mode bypasses model build/compile/warm-up entirely; the
         # contract under test is the JSON line, not the train step.
         RSDL_BENCH_MOCK_STEP_S="0.01",
+        **extra_env,
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -56,3 +57,19 @@ def test_bench_emits_contract_json(tmp_path):
     # Failover must be recorded when the accelerator never came up.
     if result["backend"] == "cpu":
         assert "tpu_error" in result, result
+    return result
+
+
+def test_bench_emits_contract_json(tmp_path):
+    result = _run_bench(tmp_path, {})
+    # Auto never picks resident on the CPU failover backend.
+    assert result["loader"] == "mapreduce", result
+
+
+def test_bench_resident_loader_contract(tmp_path):
+    """The loader the real-TPU round-end bench takes (auto-resident on
+    an accelerator) must satisfy the same JSON contract — forced here
+    since CI has no accelerator."""
+    result = _run_bench(tmp_path, {"RSDL_BENCH_RESIDENT": "on"})
+    assert result["loader"] == "resident", result
+    assert result["staged_gb"] > 0, result
