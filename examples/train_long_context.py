@@ -69,7 +69,10 @@ def main(argv=None) -> int:
         next_token_loss,
         synthetic_tokens,
     )
+    import functools
+
     from ray_shuffling_data_loader_tpu.ops import (
+        attention_reference,
         make_ring_attention,
         make_ulysses_attention,
     )
@@ -96,7 +99,10 @@ def main(argv=None) -> int:
             mesh, "sp", causal=True, batch_axis="data"
         )
     else:
-        attention_fn = None  # dense reference (replicated sequence math)
+        # Explicitly the XLA dense reference — the numerics baseline for
+        # the two sequence schedules. (attention_fn=None would mean the
+        # model's default, i.e. the flash auto-policy, not dense.)
+        attention_fn = functools.partial(attention_reference, causal=True)
 
     model = CausalLM(
         vocab_size=args.vocab,

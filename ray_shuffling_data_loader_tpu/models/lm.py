@@ -24,8 +24,8 @@ import flax.linen as nn
 import numpy as np
 
 from ray_shuffling_data_loader_tpu.models.transformer import EncoderBlock
-from ray_shuffling_data_loader_tpu.ops.ring_attention import (
-    attention_reference,
+from ray_shuffling_data_loader_tpu.ops.flash_attention import (
+    flash_attention,
 )
 
 
@@ -34,10 +34,10 @@ class CausalLM(nn.Module):
 
     ``__call__(tokens [batch, seq]) -> logits [batch, seq, vocab]``.
 
-    ``attention_fn`` must apply a CAUSAL mask (default: the dense
-    reference with ``causal=True``; pass
-    ``make_ring_attention(mesh, axis, causal=True)`` or the Ulysses
-    equivalent to shard the sequence axis).
+    ``attention_fn`` must apply a CAUSAL mask (default: causal
+    ``flash_attention`` — fused Pallas on a single-device TPU, dense XLA
+    elsewhere; pass ``make_ring_attention(mesh, axis, causal=True)`` or
+    the Ulysses equivalent to shard the sequence axis).
     """
 
     vocab_size: int
@@ -66,8 +66,10 @@ class CausalLM(nn.Module):
         )
         x = jnp.take(embed, tokens % self.vocab_size, axis=0)
         x = (x + pos[None, :t]).astype(self.compute_dtype)
+        # Default: the flash lowering with causal masking (Pallas on a
+        # single-device TPU, dense XLA elsewhere — see flash_attention).
         attention = self.attention_fn or functools.partial(
-            attention_reference, causal=True
+            flash_attention, causal=True
         )
         for i in range(self.num_layers):
             x = EncoderBlock(

@@ -11,11 +11,11 @@ exists so the framework exercises an attention-bearing model end to end
 TPU-first choices mirror the flagship DLRM (``models/dlrm.py``):
 float32 params with bfloat16 compute (MXU-rate matmuls), embedding
 lookups as gathers, and no data-dependent control flow. Attention is
-pluggable: the default is the dense reference
-(:func:`~.ops.ring_attention.attention_reference`); pass
-``attention_fn=make_ring_attention(mesh, axis)`` to run the encoder with
-sequence-parallel ring attention when the token sequence is sharded
-across the mesh (long-context configurations — see
+pluggable: the default is :func:`~.ops.flash_attention.flash_attention`
+(auto: fused Pallas kernel on a single-device TPU, dense XLA reference
+elsewhere); pass ``attention_fn=make_ring_attention(mesh, axis)`` to run
+the encoder with sequence-parallel ring attention when the token
+sequence is sharded across the mesh (long-context configurations — see
 ``tests/test_transformer.py`` for the wiring).
 """
 
@@ -28,8 +28,8 @@ import jax.numpy as jnp
 import flax.linen as nn
 import numpy as np
 
-from ray_shuffling_data_loader_tpu.ops.ring_attention import (
-    attention_reference,
+from ray_shuffling_data_loader_tpu.ops.flash_attention import (
+    flash_attention,
 )
 
 
@@ -59,7 +59,11 @@ class EncoderBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
         qkv = dense(3 * d, "qkv")(h).reshape(b, t, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = (self.attention_fn or attention_reference)(q, k, v)
+        # Default lowering mirrors the DLRM interaction auto-policy: the
+        # fused Pallas flash kernel on a single-device TPU backend, the
+        # dense XLA reference everywhere else (flash_attention resolves
+        # this internally).
+        attn = (self.attention_fn or flash_attention)(q, k, v)
         x = x + dense(d, "proj")(attn.reshape(b, t, d))
 
         h = nn.LayerNorm(dtype=self.compute_dtype, name="ln_mlp")(x)

@@ -98,21 +98,25 @@ from ray_shuffling_data_loader_tpu.ops.flash_attention import flash_attention
 assert jax.default_backend() == "tpu", jax.default_backend()
 
 rng = np.random.default_rng(1)
-for causal in (False, True):
-    # Ragged T exercises the padded tail blocks compiled.
-    q, k, v = (
-        jnp.asarray(rng.standard_normal((2, 1000, 4, 64)), jnp.float32)
-        for _ in range(3)
-    )
-    got = jax.jit(
-        lambda q, k, v: flash_attention(
-            q, k, v, causal=causal, use_pallas=True, interpret=False
+# (2, 1000, 4, 64): ragged T exercises the padded tail blocks. The small
+# shapes are the model zoo's ACTUAL defaults, which route through this
+# kernel by default on a single-device TPU: TabTransformer column tokens
+# (~20 tokens, head_dim 8) and CausalLM (head_dim 16).
+for shape in ((2, 1000, 4, 64), (32, 20, 4, 8), (8, 64, 4, 16)):
+    for causal in (False, True):
+        q, k, v = (
+            jnp.asarray(rng.standard_normal(shape), jnp.float32)
+            for _ in range(3)
         )
-    )(q, k, v)
-    want = attention_reference(q, k, v, causal=causal)
-    err = float(jnp.max(jnp.abs(got - want)))
-    assert err < 1e-3, (causal, err)
-    print(f"FLASH_TPU causal={causal} max_err={err:.2e}", flush=True)
+        got = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, use_pallas=True, interpret=False
+            )
+        )(q, k, v)
+        want = attention_reference(q, k, v, causal=causal)
+        err = float(jnp.max(jnp.abs(got - want)))
+        assert err < 1e-3, (shape, causal, err)
+        print(f"FLASH_TPU {shape} causal={causal} max_err={err:.2e}", flush=True)
 print("FLASH_TPU_OK", flush=True)
 """
 
