@@ -215,6 +215,31 @@ def take(
     return out
 
 
+def _take_multi_sparse(
+    parts: Sequence[np.ndarray],
+    idx: np.ndarray,
+    out: Optional[np.ndarray],
+) -> np.ndarray:
+    """Numpy sparse multi-part gather: partition ``idx`` by source part
+    (one searchsorted over the part offsets) and scatter each part's rows
+    into place — never materializes the concatenated source. Used when the
+    fused C++ kernel is unavailable yet the gather is sparse enough that a
+    full concat would dominate the cost."""
+    offsets = np.zeros(len(parts) + 1, dtype=np.int64)
+    np.cumsum([len(p) for p in parts], out=offsets[1:])
+    idx = idx.astype(np.int64, copy=False)
+    shape = (len(idx), *parts[0].shape[1:])
+    if not _out_ok(out, shape, parts[0].dtype):
+        out = np.empty(shape, dtype=parts[0].dtype)
+    part_id = np.searchsorted(offsets, idx, side="right") - 1
+    local = idx - offsets[part_id]
+    for p in range(len(parts)):
+        sel = np.nonzero(part_id == p)[0]
+        if len(sel):
+            out[sel] = parts[p][local[sel]]
+    return out
+
+
 def take_multi(
     parts: Sequence[np.ndarray],
     idx: np.ndarray,
@@ -241,17 +266,34 @@ def take_multi(
         for p in parts
     )
     total = sum(len(p) for p in parts)
+    idx_arr = np.asarray(idx)
+    in_bounds = _check_bounds(idx_arr, total)
     # Strategy: the fused kernel skips materializing the concat but pays a
-    # per-row part lookup; it only wins when threads amortize that. On few
+    # per-row part lookup; a DENSE gather (idx covers ~all rows, the
+    # reduce path) only wins fused when threads amortize that — on few
     # cores a sequential concat (pure memcpy) + one gather is fastest.
+    # A SPARSE gather (idx << total rows, the steady-state index-schedule
+    # path) must never materialize the concat: the copy would dwarf the
+    # gather itself. Sparse paths assume parts[0]'s dtype/shape for every
+    # part, so mixed-dtype parts must keep going through the concat
+    # (numpy promotes there; the sparse scatter would silently truncate).
+    compat = all(
+        p.dtype == parts[0].dtype and p.shape[1:] == parts[0].shape[1:]
+        for p in parts
+    )
+    sparse = (
+        compat and len(parts) > 1 and in_bounds and 2 * len(idx_arr) < total
+    )
     if (
         lib is None
         or row_bytes is None
         or not same
         or len(parts) == 1
-        or _NUM_THREADS < 4
-        or not _check_bounds(np.asarray(idx), total)
+        or (_NUM_THREADS < 4 and not sparse)
+        or not in_bounds
     ):
+        if sparse:
+            return _take_multi_sparse(parts, idx_arr, out)
         base = parts[0] if len(parts) == 1 else np.concatenate(parts)
         return take(base, idx, out=out)
     idx = np.ascontiguousarray(idx, dtype=np.int64)

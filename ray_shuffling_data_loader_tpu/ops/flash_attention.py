@@ -37,7 +37,7 @@ from jax import lax
 
 from ray_shuffling_data_loader_tpu.ops.ring_attention import (
     NEG_INF,
-    _blockwise_fwd,
+    _chunked_attention_bwd,
     attention_reference,
 )
 
@@ -190,80 +190,58 @@ def _flash_forward(
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
+@functools.lru_cache(maxsize=None)
+def _partitioned_flash(
+    causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """The flash kernel wrapped in ``custom_partitioning``: batch and
+    heads partition (the grid is over ``b·h``), sequence and head_dim
+    must be replicated (each tile reads full K/V rows) — so a dp×tp pod
+    mesh splits the ``pallas_call`` per device and the fused kernel fires
+    on pods, no model-layer ``shard_map`` plumbing. Sequence sharding is
+    the ring/Ulysses schedules' job, not this op's."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _lower(q, k, v):
+        return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+    fn = custom_partitioning(_lower)
+
+    def partition(mesh, arg_infos, result_infos):
+        sh = arg_infos[0].sharding
+        spec = sh.spec if sh is not None else P()
+        b_ax = spec[0] if len(spec) > 0 else None
+        h_ax = spec[2] if len(spec) > 2 else None
+        io = NamedSharding(mesh, P(b_ax, None, h_ax, None))
+        return mesh, _lower, io, (io, io, io)
+
+    fn.def_partition(
+        partition=partition,
+        sharding_rule="b t h d, b s h d, b s h d -> b t h d",
+        need_replication_factors=("t", "s", "d"),
+    )
+    return fn
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _partitioned_flash(causal, block_q, block_k, interpret)(q, k, v)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out = _partitioned_flash(causal, block_q, block_k, interpret)(q, k, v)
     # ``out`` joins the residuals: the backward needs D = rowsum(ct*out)
     # and would otherwise re-accumulate the whole output.
     return out, (q, k, v, out)
 
 
-def _flash_backward(q, k, v, out, ct, causal, kv_chunk):
-    """Memory-safe exact backward: recompute the softmax STATISTICS with
-    one chunked stats pass (the primal ``out`` rides the residuals), then
-    accumulate dq and emit per-chunk dk/dv in a second chunked pass —
-    peak extra memory is ``[b, h, tq, kv_chunk]``, never ``[T, T]``.
-
-    Standard flash-attention gradient algebra: with ``p`` the softmax
-    probabilities, ``dp = ct @ vᵀ``, ``D = rowsum(ct ⊙ out)``, then
-    ``ds = p ⊙ (dp - D)``; ``dq = ds @ k``, ``dk = dsᵀ @ q`` (both times
-    ``scale``), ``dv = pᵀ @ ct``.
-    """
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    chunk = min(kv_chunk, tk)
-    nch = -(-tk // chunk)
-    pad = nch * chunk - tk
-
-    _, m, l = _blockwise_fwd(q, k, v, causal, kv_chunk, with_output=False)
-    l = jnp.maximum(l, 1e-30)
-
-    if pad:
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    scale = 1.0 / math.sqrt(d)
-    qf = q.astype(jnp.float32)
-    ctf = ct.astype(jnp.float32)
-    # D[b, h, tq] = rowsum(ct * out)
-    big_d = jnp.einsum("bqhd,bqhd->bhq", ctf, out.astype(jnp.float32))
-    q_pos = jnp.arange(tq)
-
-    def step(dq, i):
-        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
-        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
-        s = (
-            jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
-            * scale
-        )
-        if pad or causal:
-            k_pos = i * chunk + jnp.arange(chunk)
-            valid = (k_pos < tk)[None, :]
-            if causal:
-                valid = valid & (q_pos[:, None] >= k_pos[None, :])
-            s = jnp.where(valid[None, None], s, NEG_INF)
-        p = jnp.exp(s - m[..., None]) / l[..., None]  # [b,h,tq,ck]
-        dp = jnp.einsum("bqhd,bkhd->bhqk", ctf, v_c.astype(jnp.float32))
-        ds = p * (dp - big_d[..., None])
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k_c.astype(jnp.float32)) * scale
-        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, ctf)
-        return dq, (dk_c, dv_c)
-
-    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
-    dq, (dk_chunks, dv_chunks) = lax.scan(step, dq0, jnp.arange(nch))
-    # [nch, b, ck, h, d] -> [b, nch*ck, h, d] -> unpad
-    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
-    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
 def _bwd(causal, block_q, block_k, interpret, res, ct):
     q, k, v, out = res
-    return _flash_backward(q, k, v, out, ct, causal, max(block_k, 128))
+    # Chunked-XLA exact backward, shared with blockwise_attention
+    # (ring_attention._chunked_attention_bwd); a hand-fused Pallas
+    # backward kernel remains future work.
+    return _chunked_attention_bwd(q, k, v, out, ct, causal, max(block_k, 128))
 
 
 _flash_vjp.defvjp(_fwd, _bwd)
@@ -281,9 +259,10 @@ def flash_attention(
 ) -> jax.Array:
     """Fused attention over ``[batch, seq, heads, head_dim]``.
 
-    ``use_pallas=None`` auto-selects the kernel on a single-device TPU
-    backend and the XLA dense reference elsewhere (same policy as
-    :func:`~.interaction.dot_interaction`); ``interpret=True`` runs the
+    ``use_pallas=None`` auto-selects the kernel on any TPU backend (the
+    ``custom_partitioning`` wrapper splits it batch/head-wise on pod
+    meshes — same policy as :func:`~.interaction.dot_interaction`) and
+    the XLA dense reference elsewhere; ``interpret=True`` runs the
     kernel in interpreter mode (CPU tests).
     """
     if use_pallas is None:

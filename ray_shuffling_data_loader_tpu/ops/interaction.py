@@ -102,12 +102,20 @@ def _interaction_kernel(x_ref, s_ref, out_ref):
 
 
 def _interaction_pallas(
-    stacked: jax.Array, block_batch: int, interpret: bool
+    stacked: jax.Array,
+    block_batch: int,
+    interpret: bool,
+    selectors: Optional[jax.Array] = None,
 ) -> jax.Array:
+    """``selectors`` is an explicit operand (not a closed-over constant)
+    so the partitioned wrapper's jaxpr stays const-free —
+    ``custom_partitioning`` rejects captured consts."""
     from jax.experimental import pallas as pl
 
     b, n, d = stacked.shape
     p = num_pairs(n)
+    if selectors is None:
+        selectors = jnp.asarray(_row_selectors(n))
     # VMEM sizing: per tile ~ bt*(n*d + n*n + p)*4 bytes plus the constant
     # selector (n*n*p*4); cap the tile so the whole working set stays well
     # under the 16 MB scoped limit, and keep tiles sublane-aligned
@@ -133,8 +141,45 @@ def _interaction_pallas(
         out_specs=pl.BlockSpec((bt, p), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded, p), stacked.dtype),
         interpret=interpret,
-    )(stacked, jnp.asarray(_row_selectors(n)))
+    )(stacked, selectors)
     return out[:b]
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_interaction(block_batch: int, interpret: bool):
+    """The kernel wrapped in ``custom_partitioning``: under a multi-device
+    ``jit`` the SPMD partitioner splits the ``pallas_call`` per device
+    along the batch dimension (the op is batch-elementwise), so the fused
+    kernel fires on pod meshes instead of silently falling back — no
+    ``shard_map`` plumbing needed at the model layer. The Shardy rule
+    marks every non-batch factor replicated; the selector operand is
+    grid-invariant and replicated."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _lower(stacked, selectors):
+        return _interaction_pallas(
+            stacked, block_batch, interpret, selectors=selectors
+        )
+
+    fn = custom_partitioning(_lower)
+
+    def partition(mesh, arg_infos, result_infos):
+        sh = arg_infos[0].sharding
+        batch = sh.spec[0] if sh is not None and len(sh.spec) else None
+        in_sh = (
+            NamedSharding(mesh, P(batch, None, None)),
+            NamedSharding(mesh, P(None, None, None)),
+        )
+        out_sh = NamedSharding(mesh, P(batch, None))
+        return mesh, _lower, out_sh, in_sh
+
+    fn.def_partition(
+        partition=partition,
+        sharding_rule="b n d, m o p -> b q",
+        need_replication_factors=("n", "d", "m", "o", "p", "q"),
+    )
+    return fn
 
 
 # ---------------------------------------------------------------------------
@@ -142,15 +187,26 @@ def _interaction_pallas(
 # ---------------------------------------------------------------------------
 
 
+def _interaction_forward(stacked, block_batch, interpret):
+    """Forward lowering shared by primal and VJP-fwd: the partitioned
+    kernel wrapper (pod-capable under pjit; also valid inside
+    ``shard_map`` bodies and on a single device, where the partitioner
+    has nothing to split)."""
+    n = stacked.shape[1]
+    return _partitioned_interaction(block_batch, interpret)(
+        stacked, jnp.asarray(_row_selectors(n))
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _dot_interaction_pallas_vjp(
     stacked: jax.Array, block_batch: int, interpret: bool
 ):
-    return _interaction_pallas(stacked, block_batch, interpret)
+    return _interaction_forward(stacked, block_batch, interpret)
 
 
 def _fwd(stacked, block_batch, interpret):
-    return _interaction_pallas(stacked, block_batch, interpret), stacked
+    return _interaction_forward(stacked, block_batch, interpret), stacked
 
 
 def _bwd(block_batch, interpret, stacked, ct):
@@ -171,12 +227,13 @@ _dot_interaction_pallas_vjp.defvjp(_fwd, _bwd)
 
 
 def _auto_pallas() -> bool:
-    """Auto policy: single-device TPU only. Under a multi-chip pjit the SPMD
-    partitioner's handling of ``pallas_call`` depends on the enclosing
-    sharding; callers doing explicit ``shard_map`` per-device code can force
-    ``use_pallas=True`` safely."""
+    """Auto policy: any TPU backend, single chip or pod. The kernels are
+    wrapped in ``custom_partitioning`` (batch-elementwise rule), so a
+    multi-chip pjit splits the ``pallas_call`` per device instead of the
+    old single-device bail; ``shard_map`` bodies compose with the wrapper
+    too (verified under the 8-virtual-device mesh tests)."""
     try:
-        return jax.default_backend() == "tpu" and jax.device_count() == 1
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
 
@@ -186,21 +243,24 @@ def dot_interaction(
     *,
     use_pallas: Optional[bool] = None,
     block_batch: int = 256,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Pairwise dot-interaction ``[B, N, D] -> [B, N(N-1)/2]``.
 
     Args:
         stacked: per-sample stacked feature vectors.
-        use_pallas: force the kernel on/off; default auto (single-device
-            TPU — the kernel targets Mosaic; elsewhere the XLA reference
-            runs).
+        use_pallas: force the kernel on/off; default auto (any TPU
+            backend — the kernel partitions batch-wise on pod meshes via
+            ``custom_partitioning``; elsewhere the XLA reference runs).
         block_batch: batch tile per kernel invocation (VMEM budget:
             ``bt·n·d + bt·n² + bt·p`` elements).
-        interpret: run the kernel in the Pallas interpreter (CPU tests).
+        interpret: run the kernel in the Pallas interpreter; default auto
+            (interpreter off-TPU — CPU tests forcing ``use_pallas``).
     """
     if use_pallas is None:
         use_pallas = _auto_pallas()
     if not use_pallas:
         return dot_interaction_reference(stacked)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     return _dot_interaction_pallas_vjp(stacked, block_batch, interpret)

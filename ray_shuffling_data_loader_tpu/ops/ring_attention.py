@@ -25,9 +25,13 @@ hide latency. Requires ``heads % p == 0``.
 
 Shared properties: causal masking is exact across chunk boundaries using
 global positions; per-hop/per-chunk compute is mask-independent (no
-data-dependent control flow — XLA-friendly); both differentiate cleanly
-(``scan`` + collectives transpose), so they drop into a train step
-unchanged.
+data-dependent control flow — XLA-friendly); both differentiate exactly,
+and the memory bound holds on the BACKWARD pass too: the ring carries a
+custom VJP whose backward runs its own ring (re-rotating K/V and
+recomputing score blocks — plain scan autodiff would save O(T) rotated
+chunks plus O(T²/p) probability blocks per device), and the local bodies
+(blockwise / flash kernel) recompute their chunks via
+:func:`_chunked_attention_bwd`. Both drop into a train step unchanged.
 """
 
 from __future__ import annotations
@@ -66,10 +70,16 @@ def attention_reference(
 
 def _stats_update(m, l, s):
     """Fold score block ``s`` ([b, h, tq, ck]) into the running softmax
-    statistics; returns the rescale factor and probabilities too."""
+    statistics; returns the rescale factor and probabilities too.
+
+    Rows with no valid key yet (``m`` still at the finite NEG_INF) would
+    see ``exp(s - m) = exp(0) = 1`` for their masked entries — the guard
+    zeroes them so fully-masked rows accumulate nothing and finish as 0.
+    """
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     alpha = jnp.exp(m - m_new)  # rescale of prior accumulation
     p_ij = jnp.exp(s - m_new[..., None])
+    p_ij = jnp.where(m_new[..., None] > NEG_INF / 2, p_ij, 0.0)
     l_new = l * alpha + jnp.sum(p_ij, axis=-1)
     return m_new, l_new, alpha, p_ij
 
@@ -94,20 +104,24 @@ def _accum_init(b, h, tq, d):
 
 def _accum_finish(o, l, out_dtype):
     # Fully-masked rows (possible only for degenerate inputs) get 0, not
-    # NaN.
+    # NaN: ``_stats_update`` zeroes their probabilities, so o == l == 0
+    # and the clamped divide yields exactly 0.
     out = o / jnp.maximum(l[..., None], 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(out_dtype)
 
 
-def _ring_attention_local(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis_name: str,
-    causal: bool,
-):
-    """Per-device body (runs inside ``shard_map``); q/k/v are the local
-    sequence chunks ``[batch, chunk, heads, head_dim]``."""
+def _ring_mask(s, i, me, p, tq, tk):
+    """Apply the global-position causal mask for hop ``i``."""
+    chunk = (me - i) % p
+    q_pos = me * tq + jnp.arange(tq)
+    k_pos = chunk * tk + jnp.arange(tk)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    return jnp.where(mask[None, None], s, NEG_INF)
+
+
+def _ring_fwd_local(q, k, v, axis_name, causal):
+    """Forward ring pass; returns ``(out, m, l)`` — the softmax statistics
+    ride out as residuals for the backward ring."""
     p = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
@@ -119,24 +133,92 @@ def _ring_attention_local(
 
     def hop(carry, i):
         o, m, l, k_c, v_c = carry
-        # After i rotations this device holds the chunk owned by me - i.
-        chunk = (me - i) % p
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
         if causal:
-            q_pos = me * tq + jnp.arange(tq)
-            k_pos = chunk * tk + jnp.arange(tk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, NEG_INF)
+            s = _ring_mask(s, i, me, p, tq, tk)
         o, m, l = _online_update(o, m, l, s, v_c)
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)
         return (o, m, l, k_c, v_c), None
 
     o0, m0, l0 = _accum_init(b, h, tq, d)
-    (o, _, l, _, _), _ = lax.scan(
-        hop, (o0, m0, l0, k, v), jnp.arange(p)
+    (o, m, l, _, _), _ = lax.scan(hop, (o0, m0, l0, k, v), jnp.arange(p))
+    return _accum_finish(o, l, q.dtype), m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_attention_local(q, k, v, axis_name, causal):
+    """Per-device ring attention (runs inside ``shard_map``); q/k/v are
+    the local sequence chunks ``[batch, chunk, heads, head_dim]``.
+
+    Carries a custom VJP: the backward runs its OWN ring pass —
+    recomputing each hop's score block from the saved softmax statistics
+    and rotating ``(k, v, dk, dv)`` together — so gradient memory scales
+    with the shard like the forward (plain scan autodiff would save every
+    hop's rotated K/V chunks and probability blocks: O(T) + O(T²/p) per
+    device; the advisor flagged exactly this)."""
+    out, _, _ = _ring_fwd_local(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal):
+    out, m, l = _ring_fwd_local(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, m, l)
+
+
+def _ring_vjp_bwd(axis_name, causal, res, ct):
+    q, k, v, out, m, l = res
+    p = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    ctf = ct.astype(jnp.float32)
+    l_safe = jnp.maximum(l, 1e-30)
+    # Degenerate fully-masked rows kept their m at NEG_INF and produced 0
+    # output; their probabilities must stay 0 in the recompute too.
+    live = (m > NEG_INF / 2)[..., None]
+    # D[b, h, tq] = rowsum(ct ⊙ out) — the softmax-jacobian diagonal term.
+    big_d = jnp.einsum("bqhd,bqhd->bhq", ctf, out.astype(jnp.float32))
+
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def hop(carry, i):
+        dq, k_c, v_c, dk_c, dv_c = carry
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+            * scale
+        )
+        if causal:
+            s = _ring_mask(s, i, me, p, tq, tk)
+        prob = jnp.where(
+            live, jnp.exp(s - m[..., None]) / l_safe[..., None], 0.0
+        )
+        dp = jnp.einsum("bqhd,bkhd->bhqk", ctf, v_c.astype(jnp.float32))
+        ds = prob * (dp - big_d[..., None])
+        dq = dq + jnp.einsum(
+            "bhqk,bkhd->bqhd", ds, k_c.astype(jnp.float32)
+        ) * scale
+        dk_c = dk_c + jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dv_c = dv_c + jnp.einsum("bhqk,bqhd->bkhd", prob, ctf)
+        # dk/dv rotate WITH their chunks: after p hops every chunk is back
+        # home carrying contributions from all devices.
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        dk_c = lax.ppermute(dk_c, axis_name, perm)
+        dv_c = lax.ppermute(dv_c, axis_name, perm)
+        return (dq, k_c, v_c, dk_c, dv_c), None
+
+    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    zeros_kv = jnp.zeros((b, tk, h, d), jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        hop, (dq0, k, v, zeros_kv, zeros_kv), jnp.arange(p)
     )
-    return _accum_finish(o, l, q.dtype)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def _blockwise_fwd(
@@ -203,6 +285,91 @@ def _blockwise_fwd(
     return None, m, l
 
 
+def _chunked_attention_bwd(q, k, v, out, ct, causal, kv_chunk):
+    """Memory-safe exact attention backward in KV chunks: recompute the
+    softmax STATISTICS with one chunked stats pass (the primal ``out``
+    rides the residuals), then accumulate dq and emit per-chunk dk/dv in
+    a second chunked pass — peak extra memory is ``[b, h, tq, kv_chunk]``,
+    never ``[T, T]``.
+
+    Standard flash-attention gradient algebra: with ``p`` the softmax
+    probabilities, ``dp = ct @ vᵀ``, ``D = rowsum(ct ⊙ out)``, then
+    ``ds = p ⊙ (dp - D)``; ``dq = ds @ k``, ``dk = dsᵀ @ q`` (both times
+    ``scale``), ``dv = pᵀ @ ct``. Shared by the Pallas flash kernel's VJP
+    and :func:`blockwise_attention`'s.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    chunk = min(kv_chunk, tk)
+    nch = -(-tk // chunk)
+    pad = nch * chunk - tk
+
+    _, m, l = _blockwise_fwd(q, k, v, causal, kv_chunk, with_output=False)
+    l = jnp.maximum(l, 1e-30)
+    live = (m > NEG_INF / 2)[..., None]  # fully-masked rows stay 0
+
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    ctf = ct.astype(jnp.float32)
+    # D[b, h, tq] = rowsum(ct * out)
+    big_d = jnp.einsum("bqhd,bqhd->bhq", ctf, out.astype(jnp.float32))
+    q_pos = jnp.arange(tq)
+
+    def step(dq, i):
+        k_c = lax.dynamic_slice_in_dim(k, i * chunk, chunk, axis=1)
+        v_c = lax.dynamic_slice_in_dim(v, i * chunk, chunk, axis=1)
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+            * scale
+        )
+        if pad or causal:
+            k_pos = i * chunk + jnp.arange(chunk)
+            valid = (k_pos < tk)[None, :]
+            if causal:
+                valid = valid & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(valid[None, None], s, NEG_INF)
+        p = jnp.where(
+            live, jnp.exp(s - m[..., None]) / l[..., None], 0.0
+        )  # [b,h,tq,ck]
+        dp = jnp.einsum("bqhd,bkhd->bhqk", ctf, v_c.astype(jnp.float32))
+        ds = p * (dp - big_d[..., None])
+        dq = dq + jnp.einsum(
+            "bhqk,bkhd->bqhd", ds, k_c.astype(jnp.float32)
+        ) * scale
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, ctf)
+        return dq, (dk_c, dv_c)
+
+    dq0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    dq, (dk_chunks, dv_chunks) = lax.scan(step, dq0, jnp.arange(nch))
+    # [nch, b, ck, h, d] -> [b, nch*ck, h, d] -> unpad
+    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
+    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(b, nch * chunk, h, d)[:, :tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _blockwise_core(q, k, v, causal, kv_chunk):
+    out, _, _ = _blockwise_fwd(q, k, v, causal, kv_chunk)
+    return out
+
+
+def _blockwise_core_fwd(q, k, v, causal, kv_chunk):
+    out, _, _ = _blockwise_fwd(q, k, v, causal, kv_chunk)
+    return out, (q, k, v, out)
+
+
+def _blockwise_core_bwd(causal, kv_chunk, res, ct):
+    q, k, v, out = res
+    return _chunked_attention_bwd(q, k, v, out, ct, causal, kv_chunk)
+
+
+_blockwise_core.defvjp(_blockwise_core_fwd, _blockwise_core_bwd)
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -213,9 +380,10 @@ def blockwise_attention(
     """Single-device exact attention in KV chunks (flash-style online
     softmax): peak score memory is [b, h, tq, kv_chunk], never [T, T].
     The local compute of the Ulysses body, and usable standalone for long
-    sequences on one device."""
-    out, _, _ = _blockwise_fwd(q, k, v, causal, kv_chunk)
-    return out
+    sequences on one device. The memory bound holds for the BACKWARD too:
+    a custom VJP recomputes score chunks (:func:`_chunked_attention_bwd`)
+    instead of letting scan autodiff save every chunk's probabilities."""
+    return _blockwise_core(q, k, v, causal, kv_chunk)
 
 
 def _seq_parallel_jit(
@@ -261,9 +429,8 @@ def make_ring_attention(
     return _seq_parallel_jit(
         mesh,
         axis_name,
-        functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal
-        ),
+        # Positional call: custom_vjp nondiff args resolve by position.
+        lambda q, k, v: _ring_attention_local(q, k, v, axis_name, causal),
         batch_axis=batch_axis,
     )
 
@@ -288,6 +455,15 @@ def ring_attention(
 # ---------------------------------------------------------------------------
 
 
+def _use_flash_auto() -> bool:
+    """Local-attention lowering policy for the sequence-parallel bodies:
+    the fused Pallas flash kernel on a TPU backend (safe inside
+    ``shard_map`` — the kernel is per-device, the collectives stay XLA's),
+    the XLA blockwise path elsewhere (CPU tests run it compiled rather
+    than paying kernel-interpret overhead)."""
+    return jax.default_backend() == "tpu"
+
+
 def _ulysses_local(
     q: jax.Array,
     k: jax.Array,
@@ -295,18 +471,30 @@ def _ulysses_local(
     axis_name: str,
     causal: bool,
     kv_chunk: int,
+    use_flash: Optional[bool] = None,
 ):
     """Per-device body: one ``all_to_all`` each way redistributes
     sequence↔heads, so this device attends over the FULL sequence for
-    its H/p head subset — in KV chunks (:func:`blockwise_attention`), so
-    no [T, T] block materializes. Activations still hold [T, H/p, D]
-    per device (see the module docstring for the regime split vs ring).
+    its H/p head subset — fused flash kernel on TPU, KV chunks
+    (:func:`blockwise_attention`) elsewhere; either way no [T, T] block
+    materializes, forward or backward. Activations still hold
+    [T, H/p, D] per device (see the module docstring for the regime
+    split vs ring).
     """
     # [B, Tl, H, D] -> [B, T, H/p, D]: split heads, gather sequence.
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = blockwise_attention(qh, kh, vh, causal=causal, kv_chunk=kv_chunk)
+    if use_flash is None:
+        use_flash = _use_flash_auto()
+    if use_flash:
+        from ray_shuffling_data_loader_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        out = flash_attention(qh, kh, vh, causal=causal, use_pallas=True)
+    else:
+        out = blockwise_attention(qh, kh, vh, causal=causal, kv_chunk=kv_chunk)
     # [B, T, H/p, D] -> [B, Tl, H, D]: back to sequence shards.
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -318,6 +506,7 @@ def make_ulysses_attention(
     causal: bool = False,
     kv_chunk: int = 1024,
     batch_axis: Optional[str] = None,
+    use_flash: Optional[bool] = None,
 ):
     """All-to-all (Ulysses-style) sequence-parallel attention over
     ``mesh``'s ``axis_name`` — the second canonical long-context
@@ -337,6 +526,7 @@ def make_ulysses_attention(
             axis_name=axis_name,
             causal=causal,
             kv_chunk=kv_chunk,
+            use_flash=use_flash,
         ),
         batch_axis=batch_axis,
     )

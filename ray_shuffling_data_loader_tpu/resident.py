@@ -328,10 +328,27 @@ class DeviceResidentShufflingDataset:
             return
         t0 = time.perf_counter()
         ctx = runtime.ensure_initialized()
-        futs = [
-            ctx.scheduler.submit(_decode_narrow_to_store, f, self._columns)
-            for f in filenames
-        ]
+        # Decode submission runs a SLIDING WINDOW ahead of the consume
+        # cursor (pool width + slack), not all files up-front: when the
+        # pool decodes faster than the driver packs and stages, completed
+        # columnar objects would otherwise pile up un-consumed in /dev/shm
+        # (spill keeps that correct but doubles the I/O) — the same
+        # backpressure the map/reduce path gets from its epoch window.
+        # Scheduler width = cluster-wide worker count when joined to a
+        # cluster, else the local pool size.
+        window = max(2, getattr(ctx.scheduler, "width", 1) + 2)
+        pending = list(filenames)
+        futs: List = []
+
+        def topup():
+            while pending and len(futs) < window:
+                futs.append(
+                    ctx.scheduler.submit(
+                        _decode_narrow_to_store, pending.pop(0), self._columns
+                    )
+                )
+
+        topup()
         ncols = len(self._columns)
         data_shards = self.mesh.shape.get(self.batch_axis, 1)
 
@@ -373,8 +390,10 @@ class DeviceResidentShufflingDataset:
             if self._progress_cb is not None:
                 self._progress_cb()
 
-        for fut in futs:
+        while futs:
+            fut = futs.pop(0)
             ref = fut.result()
+            topup()  # keep the decode window full while this ref packs
             cb = ctx.store.get_columns(ref)
             cols = []
             for name in self._columns:
@@ -434,7 +453,8 @@ class DeviceResidentShufflingDataset:
         data_shards = self.mesh.shape.get(self.batch_axis, 1)
         self._col_dtypes = {}
 
-        file_rows = [pq.ParquetFile(f).metadata.num_rows for f in filenames]
+        file_metas = [pq.ParquetFile(f).metadata for f in filenames]
+        file_rows = [m.num_rows for m in file_metas]
         n = sum(file_rows)
         if num_rows is not None and num_rows != n:
             raise ValueError(
@@ -450,21 +470,40 @@ class DeviceResidentShufflingDataset:
 
         from jax.experimental import multihost_utils
 
-        ident = "\x00".join(
-            [*map(os.path.basename, filenames), *map(str, file_rows)]
-        )
-        digest = int.from_bytes(
-            hashlib.blake2s(ident.encode()).digest()[:4], "big"
-        )
+        # Identity = basename + full Parquet footer fingerprint (schema,
+        # created_by, serialized footer size, per-row-group row counts) —
+        # same-named same-length files with different CONTENT diverge on
+        # the footer, so they no longer assemble a silently corrupt
+        # buffer. Deliberately NOT the full path: pods legitimately mount
+        # one dataset at different paths per host.
+        ident_parts = []
+        for f, meta in zip(filenames, file_metas):
+            ident_parts.extend(
+                (
+                    os.path.basename(f),
+                    str(meta.num_rows),
+                    str(meta.created_by),
+                    str(meta.serialized_size),
+                    # NOT str(meta.schema): ParquetSchema's repr leads
+                    # with the object's memory address.
+                    str(meta.schema.to_arrow_schema()),
+                    *(
+                        str(meta.row_group(i).num_rows)
+                        for i in range(meta.num_row_groups)
+                    ),
+                )
+            )
+        digest16 = hashlib.blake2s(
+            "\x00".join(ident_parts).encode()
+        ).digest()[:16]
+        digest_words = np.frombuffer(digest16, dtype=np.uint32)
         # allgather (not broadcast-and-compare-locally): EVERY process
         # must raise on divergence, or the agreeing ones proceed into
         # the staging collective and hang waiting for the one that bailed.
         digests = np.asarray(
-            multihost_utils.process_allgather(
-                jnp.asarray([digest], jnp.uint32)
-            )
-        ).reshape(-1)
-        if len(set(digests.tolist())) != 1:
+            multihost_utils.process_allgather(jnp.asarray(digest_words))
+        ).reshape(-1, 4)
+        if len({tuple(row) for row in digests.tolist()}) != 1:
             raise ValueError(
                 "file list (order/rows) differs across processes; all "
                 "processes must pass the identical sequence of files"

@@ -337,10 +337,14 @@ class ClusterScheduler:
         agents: List[ActorHandle],
         store_to_agent: Optional[Dict[Tuple, ActorHandle]] = None,
         max_inflight: int = 64,
+        width: Optional[int] = None,
     ):
         if not agents:
             raise ValueError("no host agents registered")
         self._agents = list(agents)
+        # Cluster-wide worker count (sum of every host's pool), for
+        # callers sizing submission windows to actual decode capacity.
+        self.width = int(width) if width else len(agents)
         # store-server address -> that host's agent; lets locality hints
         # (ObjectRef.owner carries the store address) pick the host that
         # already holds a task's inputs.
@@ -551,6 +555,7 @@ class ClusterClient:
         hosts = self.registry.call("hosts")
         agents: List[ActorHandle] = []
         store_to_agent: Dict[Tuple, ActorHandle] = {}
+        total_workers = 0
         for info in hosts.values():
             agent = (
                 self.agent
@@ -559,6 +564,8 @@ class ClusterClient:
             )
             agents.append(agent)
             store_to_agent[tuple(info["store"])] = agent
+            total_workers += int(info.get("num_workers", 1))
+        self._total_workers = max(1, total_workers)
         return agents, store_to_agent
 
     def _evict_host(self, agent: ActorHandle) -> None:
@@ -598,7 +605,11 @@ class ClusterClient:
             else:
                 agents, store_to_agent = self._read_agents()
                 self._scheduler_read_ts = now
-            self._scheduler = ClusterScheduler(agents, store_to_agent)
+            self._scheduler = ClusterScheduler(
+                agents,
+                store_to_agent,
+                width=getattr(self, "_total_workers", len(agents)),
+            )
             self._scheduler.on_agent_dead = self._evict_host
             return self._scheduler
 

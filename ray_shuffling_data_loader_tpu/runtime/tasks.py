@@ -159,6 +159,7 @@ class WorkerPool:
 
     def __init__(self, num_workers: int, env: Optional[Dict[str, str]] = None):
         self.num_workers = num_workers
+        self.width = num_workers  # scheduler-duck-typed capacity surface
         ctx = mp.get_context("spawn")
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()

@@ -48,6 +48,41 @@ def test_take_multi_fused_concat_gather():
     np.testing.assert_array_equal(native.take_multi(parts, idx), cat[idx])
 
 
+def test_take_multi_sparse_gather():
+    """Sparse multi-part gathers (idx << total rows — the index-schedule
+    reduce) must match the dense semantics on every code path, including
+    the no-concat numpy fallback."""
+    parts = [
+        rng.integers(0, 1 << 20, size=n) for n in (4000, 0, 9000, 17, 2500)
+    ]
+    cat = np.concatenate(parts)
+    idx = rng.choice(len(cat), size=len(cat) // 8, replace=False)
+    np.testing.assert_array_equal(native.take_multi(parts, idx), cat[idx])
+    # out= destination, 2-D rows, and the pure-numpy sparse path.
+    parts2d = [rng.random((n, 3)) for n in (700, 1200, 5)]
+    cat2d = np.concatenate(parts2d)
+    idx2 = rng.choice(len(cat2d), size=64, replace=False)
+    out = np.empty((64, 3))
+    got = native.take_multi(parts2d, idx2, out=out)
+    np.testing.assert_array_equal(got, cat2d[idx2])
+    from ray_shuffling_data_loader_tpu.native import _take_multi_sparse
+
+    np.testing.assert_array_equal(
+        _take_multi_sparse(parts2d, idx2.astype(np.int64), None), cat2d[idx2]
+    )
+    # Mixed-dtype parts must keep numpy's concat promotion semantics (the
+    # sparse scatter assumes parts[0]'s dtype and would silently truncate).
+    mixed = [
+        np.arange(100, dtype=np.int32),
+        np.arange(100, dtype=np.int64) + (1 << 40),
+    ]
+    mcat = np.concatenate(mixed)
+    midx = np.array([5, 150, 199])
+    got = native.take_multi(mixed, midx)
+    np.testing.assert_array_equal(got, mcat[midx])
+    assert got.dtype == mcat.dtype
+
+
 def test_narrow_casts():
     a = rng.integers(0, 2**31 - 1, size=9999)
     np.testing.assert_array_equal(

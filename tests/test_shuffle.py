@@ -246,6 +246,89 @@ def test_dataset_with_decode_cache_exactly_once(local_runtime, small_dataset):
             assert keys != first_epoch_order
 
 
+def test_index_schedule_stream_identical(local_runtime, small_dataset):
+    """Steady-state index schedule (plan + sparse gather from the decode
+    cache) must deliver a bit-identical stream to the materialized
+    map/reduce path — same rows, same order, per (epoch, rank)."""
+
+    def run(cache_decoded, log):
+        consumer = CollectingConsumer()
+        shuffle(
+            small_dataset,
+            consumer,
+            num_epochs=3,
+            num_reducers=5,
+            num_trainers=2,
+            seed=23,
+            cache_decoded=cache_decoded,
+            schedule_log=log,
+        )
+        return consumer
+
+    log_fast, log_slow = [], []
+    fast = run(True, log_fast)
+    slow = run(False, log_slow)
+    # Epoch 0 materializes (cache cold); later epochs take the fast path.
+    assert dict(log_fast)[0] == "mapreduce"
+    assert dict(log_fast)[1] == "index"
+    assert dict(log_fast)[2] == "index"
+    assert all(s == "mapreduce" for _, s in log_slow)
+    assert dict(fast.keys) == dict(slow.keys)
+    assert dict(fast.done) == dict(slow.done)
+
+
+def test_index_schedule_resume_matches(local_runtime, small_dataset):
+    """Checkpoint resume determinism across schedules: an epoch that ran
+    via the index schedule originally must reproduce the exact stream when
+    re-run cold (materialized) after a resume."""
+    consumer = CollectingConsumer()
+    log = []
+    shuffle(
+        small_dataset,
+        consumer,
+        num_epochs=3,
+        num_reducers=4,
+        num_trainers=1,
+        seed=5,
+        cache_decoded=True,
+        schedule_log=log,
+    )
+    assert dict(log)[2] == "index"
+    consumer2 = CollectingConsumer()
+    log2 = []
+    shuffle(
+        small_dataset,
+        consumer2,
+        num_epochs=3,
+        num_reducers=4,
+        num_trainers=1,
+        seed=5,
+        start_epoch=2,
+        cache_decoded=True,
+        schedule_log=log2,
+    )
+    assert dict(log2)[2] == "mapreduce"  # cache cold on the resumed run
+    assert consumer2.keys[(2, 0)] == consumer.keys[(2, 0)]
+
+
+def test_index_schedule_env_off(local_runtime, small_dataset, monkeypatch):
+    monkeypatch.setenv("RSDL_INDEX_SHUFFLE", "off")
+    log = []
+    consumer = CollectingConsumer()
+    shuffle(
+        small_dataset,
+        consumer,
+        num_epochs=2,
+        num_reducers=3,
+        num_trainers=1,
+        seed=9,
+        cache_decoded=True,
+        schedule_log=log,
+    )
+    assert all(s == "mapreduce" for _, s in log)
+    assert sorted(consumer.keys[(1, 0)]) == list(range(2000))
+
+
 def test_narrow_to_32_rejects_out_of_range(local_runtime, tmp_path):
     """narrow_to_32 must raise (not silently wrap) on ids outside int32
     range — wraparound would corrupt training data undetectably."""

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Watch for the axon TPU tunnel to come up; the moment it does, capture the
+# round's TPU proof artifacts automatically:
+#   1. python bench.py                       -> tools/tpu_bench.out (JSON line at tail)
+#   2. RSDL_TPU_TESTS=1 pytest TPU-gated     -> tools/tpu_tests.out
+# Probe runs jax.devices() in a subprocess with a hard timeout because a down
+# tunnel HANGS rather than erroring (see BENCHLOG.md).
+set -u
+cd /root/repo
+OUT=tools
+mkdir -p "$OUT"
+LOG="$OUT/tpu_watch.log"
+echo "[watch] started $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if python - <<'EOF' 2>>"$LOG"
+import subprocess, sys
+code = "import jax; ds=jax.devices(); print('PLATFORM='+ds[0].platform)"
+try:
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+except subprocess.TimeoutExpired:
+    sys.exit(1)
+ok = p.returncode == 0 and "PLATFORM=tpu" in p.stdout
+sys.exit(0 if ok else 1)
+EOF
+  then
+    echo "[watch] TUNNEL UP $(date -u +%FT%TZ) — capturing" >> "$LOG"
+    # Bench first (the scarce artifact), then the gated tests.
+    timeout 3600 python bench.py > "$OUT/tpu_bench.out" 2>&1
+    echo "[watch] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    RSDL_TPU_TESTS=1 timeout 2400 python -m pytest -q \
+      tests/test_ops_tpu.py tests/test_resident_tpu.py \
+      > "$OUT/tpu_tests.out" 2>&1
+    echo "[watch] tests rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    touch "$OUT/TPU_CAPTURED"
+    echo "[watch] capture complete — exiting" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
+  sleep 180
+done
