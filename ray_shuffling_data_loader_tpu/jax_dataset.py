@@ -247,10 +247,6 @@ class JaxShufflingDataset:
             and label.dtype.itemsize == 4
             and len({a.shape[0] for a in host.values()} | {label.shape[0]})
             == 1
-            # The on-device unpack is a jitted (SPMD-collective under
-            # multi-controller) computation; ranks stage at independent
-            # rates, so the packed path is single-process only.
-            and jax.process_count() == 1
         )
 
         t0 = time.perf_counter()
@@ -262,7 +258,12 @@ class JaxShufflingDataset:
                 # Unvalidated backend corner (e.g. a plugin that rejects
                 # the jitted unpack): the packed path is an optimization,
                 # so degrade PERMANENTLY to per-column staging rather
-                # than sinking the run — and only warn once.
+                # than sinking the run — and only warn once. On a
+                # multi-controller pod a unilateral fallback would diverge
+                # the ranks' global programs (the others keep unpacking),
+                # so there the failure must surface instead.
+                if jax.process_count() > 1:
+                    raise
                 self._packed_ok = False
                 import logging
 
@@ -289,7 +290,13 @@ class JaxShufflingDataset:
     def _stage_packed(self, host: Dict[str, np.ndarray], label: np.ndarray):
         """One transfer for the whole batch: bit-pack all 4-byte columns
         as int32 rows of a ``[n_cols+1, batch]`` buffer (float rows are
-        bitcast back on device)."""
+        bitcast back on device).
+
+        Multi-controller pods pack their LOCAL shard and assemble the
+        global buffer with one ``make_array_from_process_local_data``
+        call per batch per process — the same single-transfer economics
+        as the single-chip path (a pod previously paid ``n_cols+1``
+        per-column assemblies per batch per host)."""
         names = tuple(host)
         batch = label.shape[0]
         packed = np.empty((len(names) + 1, batch), np.int32)
@@ -297,7 +304,12 @@ class JaxShufflingDataset:
             packed[i] = host[name].view(np.int32)
         packed[-1] = label.view(np.int32)
         sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
-        packed_dev = jax.device_put(packed, sharding)
+        if jax.process_count() > 1:
+            packed_dev = jax.make_array_from_process_local_data(
+                sharding, packed
+            )
+        else:
+            packed_dev = jax.device_put(packed, sharding)
         unpack = self._get_unpack(
             names,
             tuple(str(host[n].dtype) for n in names),
@@ -309,7 +321,14 @@ class JaxShufflingDataset:
     def _get_unpack(self, names, dtypes, label_dtype):
         """Jitted on-device unpack for the packed layout: row slices +
         bitcasts, executed as ONE device computation (a single dispatch
-        round-trip, vs one per column)."""
+        round-trip, vs one per column).
+
+        The computation is device-local by construction — each device
+        already holds its batch shard of every packed row, so unpacking
+        never moves data between shards. On multi-controller pods it is
+        expressed through ``shard_map`` with pinned specs, which
+        GUARANTEES no collective can be inserted: ranks may dispatch it
+        at independent staging rates without cross-host rendezvous."""
         key = (names, dtypes, label_dtype)
         fn = self._unpack_cache.get(key)
         if fn is None:
@@ -331,13 +350,30 @@ class JaxShufflingDataset:
                     )
                 return feats, lab
 
-            fn = jax.jit(
-                unpack,
-                out_shardings=(
-                    {name: row_sharding for name in names},
-                    row_sharding,
-                ),
-            )
+            if jax.process_count() > 1:
+                from jax import shard_map
+
+                row_spec = P(self.batch_axis)
+                fn = jax.jit(
+                    shard_map(
+                        unpack,
+                        mesh=self.mesh,
+                        in_specs=(P(None, self.batch_axis),),
+                        out_specs=(
+                            {name: row_spec for name in names},
+                            row_spec,
+                        ),
+                        check_vma=False,
+                    )
+                )
+            else:
+                fn = jax.jit(
+                    unpack,
+                    out_shardings=(
+                        {name: row_sharding for name in names},
+                        row_sharding,
+                    ),
+                )
             self._unpack_cache[key] = fn
         return fn
 
