@@ -407,20 +407,22 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         )
     _log(f"loader: {'device-resident' if use_resident else 'map/reduce'}")
 
-    collector = None
-    if not use_resident:
-        from ray_shuffling_data_loader_tpu.stats import TrialStatsCollector
+    from ray_shuffling_data_loader_tpu.stats import TrialStatsCollector
 
-        collector = runtime.spawn_actor(
-            TrialStatsCollector,
-            NUM_EPOCHS,
-            len(filenames),
-            NUM_REDUCERS,
-            num_rows,
-            BATCH_SIZE,
-            1,
-            name="bench-stats",
-        )
+    # Both loaders report through the same collector vocabulary; the
+    # resident loader maps its stages onto it (map = epoch permutation,
+    # reduce = epoch materialization/gather, consume = batch delivery),
+    # with one map and one reduce per epoch.
+    collector = runtime.spawn_actor(
+        TrialStatsCollector,
+        NUM_EPOCHS,
+        len(filenames) if not use_resident else 1,
+        NUM_REDUCERS if not use_resident else 1,
+        num_rows,
+        BATCH_SIZE,
+        1,
+        name="bench-stats",
+    )
 
     def make_dataset():
         if use_resident:
@@ -439,6 +441,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 progress_cb=lambda: last_progress.__setitem__(
                     0, time.monotonic()
                 ),
+                stats_collector=collector,
             )
         return JaxShufflingDataset(
             filenames,
@@ -544,7 +547,8 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # wall-clock stage windows and mean task durations per epoch.
     phase = {}
     try:
-        # The resident loader has no map/reduce stages (collector None).
+        # Resident runs report permutation/materialization through the
+        # same map/reduce event names, so this covers both loaders.
         epochs = (
             collector.call("snapshot").epochs if collector is not None else []
         )

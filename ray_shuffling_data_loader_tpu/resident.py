@@ -268,6 +268,7 @@ class DeviceResidentShufflingDataset:
         num_rows: Optional[int] = None,
         progress_cb: Optional[Callable[[], None]] = None,
         materialize_epoch: Optional[bool] = None,
+        stats_collector=None,
     ):
         if jax.process_count() > 1 and num_trainers != 1:
             # Multi-controller SPMD: every process executes the SAME
@@ -306,6 +307,13 @@ class DeviceResidentShufflingDataset:
         # Called after every staged piece: lets a long staging pass feed
         # an external liveness watchdog (the bench arms one).
         self._progress_cb = progress_cb
+        # Optional TrialStatsCollector handle: the resident loader reports
+        # through the SAME event vocabulary as the map/reduce engine
+        # (map = epoch permutation draw, reduce = epoch
+        # materialization/gather stream, consume = per-batch delivery),
+        # so process_stats CSVs cover the flagship path too.
+        self._stats_collector = stats_collector
+        self._trial_t0 = time.perf_counter()
         self.stats = HostToDeviceStats()
         self._load(filenames, num_rows)
 
@@ -775,6 +783,17 @@ class DeviceResidentShufflingDataset:
     def close(self) -> None:
         """Release the resident buffers (HBM) deterministically instead
         of waiting for GC — after this the dataset cannot iterate."""
+        sc = self._stats_collector
+        if sc is not None and not getattr(self, "_closed", False):
+            try:
+                sc.call_oneway(
+                    "report_staging", self.rank, self.stats.as_dict()
+                )
+                sc.call_oneway(
+                    "trial_done", time.perf_counter() - self._trial_t0
+                )
+            except Exception:
+                pass
         self._closed = True
         self._buf = None
         self._epoch_buf_cache.clear()
@@ -802,10 +821,28 @@ class DeviceResidentShufflingDataset:
         if self._epoch is None:
             raise RuntimeError("set_epoch must be called before iterating")
         epoch, skip = self._epoch, self._skip
+        sc = self._stats_collector
+        if sc is not None:
+            sc.call_oneway("epoch_start", epoch)
+            sc.call_oneway("map_start", epoch)
+        t_perm = time.perf_counter()
+        perm = self._perm(epoch)
+        if sc is not None:
+            # Block for an honest stage timing only when a collector is
+            # attached (measured runs); unmeasured runs stay fully async.
+            jax.block_until_ready(perm)
+            sc.call_oneway(
+                "map_done", epoch, time.perf_counter() - t_perm, 0.0
+            )
+            sc.call_oneway("reduce_start", epoch)
+        t_shuffle = time.perf_counter()
         if self._materialize:
             ebuf = self._epoch_buf(epoch)
-        else:
-            perm = self._perm(epoch)
+            if sc is not None:
+                jax.block_until_ready(ebuf)
+                sc.call_oneway(
+                    "reduce_done", epoch, time.perf_counter() - t_shuffle
+                )
         b = self.batch_size
         full, rem = divmod(self._rank_rows, b)
         widths = [b] * full
@@ -835,9 +872,22 @@ class DeviceResidentShufflingDataset:
             pending.append(item)
             start += width
             self.stats.batches_staged += 1
+            if sc is not None:
+                sc.call_oneway(
+                    "consume",
+                    self.rank,
+                    epoch,
+                    len(self._columns) * width * 4,
+                )
             if self.stats.batches_staged % 32 == 0:
                 self.stats.sample_device_memory()
             while len(pending) > self._lookahead:
                 yield pending.popleft()
+        if sc is not None and not self._materialize:
+            # Per-batch gather mode: the "reduce" is the epoch's gather
+            # dispatch stream, complete once every batch is in flight.
+            sc.call_oneway(
+                "reduce_done", epoch, time.perf_counter() - t_shuffle
+            )
         while pending:
             yield pending.popleft()
