@@ -18,22 +18,26 @@ auto-policy shape as the DLRM interaction kernel (``ops/interaction.py``):
 Pallas on single-device TPU, XLA reference elsewhere, interpret mode for
 CPU tests.
 
-Differentiability: the kernel carries an exact, memory-safe custom VJP —
-the standard flash backward in chunked XLA (recompute softmax statistics
-with one blockwise pass, then accumulate ``dq`` and per-chunk
-``dk``/``dv``), so no ``[T, T]`` block materializes in the gradient
-either; a hand-fused Pallas backward kernel remains future work.
+Differentiability: the kernel carries an exact, memory-safe custom VJP.
+The forward emits its softmax row statistics (m, l) as outputs; the
+backward is two fused Pallas kernels — dK/dV (q innermost, VMEM
+accumulators) and dQ (kv innermost) — that recompute probability blocks
+from those statistics, so no ``[T, T]`` block materializes in the
+gradient and no stats-recompute pass is paid. ``RSDL_FLASH_BWD=xla``
+falls back to the chunked-XLA exact backward (shared with
+``blockwise_attention``).
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
 
 from ray_shuffling_data_loader_tpu.ops.ring_attention import (
     NEG_INF,
@@ -47,6 +51,8 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
+    m_ref,
+    l_ref,
     m_scr,
     l_scr,
     acc_scr,
@@ -61,7 +67,9 @@ def _flash_kernel(
 
     The kv dimension is the innermost grid axis; the output block is
     revisited across it, carrying (running max, normalizer, accumulator)
-    in VMEM scratch.
+    in VMEM scratch. The softmax statistics (row max ``m`` and
+    normalizer ``l``) are emitted as outputs: the backward kernels and
+    the ring schedule's stats merge consume them.
     """
     from jax.experimental import pallas as pl
 
@@ -105,6 +113,9 @@ def _flash_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # Rows with no valid key yet (m still NEG_INF) would see
+        # exp(0) = 1; zero them so fully-masked rows finish as 0.
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
             p.astype(jnp.float32),
@@ -127,6 +138,8 @@ def _flash_kernel(
         o_ref[0] = (
             acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+        m_ref[0] = m_scr[:, 0]
+        l_ref[0] = l_scr[:, 0]
 
 
 def _flash_forward(
@@ -137,7 +150,11 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+    return_stats: bool = False,
+):
+    """Fused forward. With ``return_stats`` also returns the softmax row
+    statistics ``(m, l)`` as float32 ``[b, h, t]`` — residuals for the
+    fused backward and merge inputs for the ring schedule."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -166,7 +183,7 @@ def _flash_forward(
         block_k=bk,
         seq_len=t,
     )
-    out = pl.pallas_call(
+    out, m, l = pl.pallas_call(
         kernel,
         grid=(b * h, tq_pad // bq, tk_pad // bk),
         in_specs=[
@@ -174,8 +191,16 @@ def _flash_forward(
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_pad), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max
             pltpu.VMEM((bq, 128), jnp.float32),  # normalizer
@@ -187,12 +212,297 @@ def _flash_forward(
         interpret=interpret,
     )(qb, kb, vb)
     out = out[:, :t].reshape(b, h, t, d)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    if not return_stats:
+        return out
+    return out, m[:, :t].reshape(b, h, t), l[:, :t].reshape(b, h, t)
+
+
+def _bwd_probs(q, k, m, l, ki, scale, causal, block_q, block_k, seq_len, qi):
+    """Shared backward-kernel algebra: recompute the normalized
+    probability block from the saved statistics."""
+    s = (
+        jax.lax.dot_general(
+            q,
+            k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [bq, bk]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (q.shape[0], k.shape[0]), 1
+    )
+    if causal or seq_len % block_k != 0:
+        valid = k_pos < seq_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], k.shape[0]), 0
+            )
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+    mcol = m[:, None]
+    lcol = jnp.maximum(l[:, None], 1e-30)
+    p = jnp.exp(s - mcol) / lcol
+    # Fully-masked rows kept m at NEG_INF and must contribute nothing.
+    return jnp.where(mcol > NEG_INF / 2, p, 0.0)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    m_ref,
+    l_ref,
+    d_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+):
+    """dK/dV: grid (batch·head, kv-block, q-block) with q innermost; the
+    dk/dv accumulators live in VMEM and are revisited across q blocks.
+
+        p  = softmax block recomputed from (m, l)
+        dv += pᵀ @ dO
+        dp = dO @ vᵀ ; ds = p ⊙ (dp - D)
+        dk += dsᵀ @ q · scale
+    """
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_probs(
+            q, k, m_ref[0], l_ref[0], ki, scale, causal, block_q,
+            block_k, seq_len, qi,
+        )
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p,
+            do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do,
+            v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_ref[0][:, None])
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds,
+            q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        # q blocks strictly above the diagonal see only masked scores.
+        pl.when((qi + 1) * block_q > ki * block_k)(_update)
+    else:
+        _update()
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    m_ref,
+    l_ref,
+    d_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+):
+    """dQ: grid (batch·head, q-block, kv-block) with kv innermost;
+    ``dq += ds @ k · scale`` accumulates in VMEM across kv blocks."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        p = _bwd_probs(
+            q, k, m_ref[0], l_ref[0], ki, scale, causal, block_q,
+            block_k, seq_len, qi,
+        )
+        dp = jax.lax.dot_general(
+            do,
+            v,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_ref[0][:, None])
+        dq_scr[...] = dq_scr[...] + jax.lax.dot(
+            ds,
+            k.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        pl.when((qi + 1) * block_q > ki * block_k)(_update)
+    else:
+        _update()
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_backward_pallas(
+    q, k, v, out, m, l, ct, causal, block_q, block_k, interpret
+):
+    """Fused flash backward: two Pallas kernels (dK/dV with q innermost,
+    dQ with kv innermost) consuming the forward's saved statistics — no
+    stats-recompute pass and no ``[T, T]`` block in HBM. ``D`` (the
+    softmax-jacobian diagonal term rowsum(ct ⊙ out)) is a cheap XLA
+    elementwise-reduce."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    tq_pad = -(-t // bq) * bq
+    tk_pad = -(-t // bk) * bk
+
+    def to_bh(x, t_pad):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
+
+    def rows_bh(x, t_pad):  # [b, h, t] -> [bh, t_pad]
+        x = x.reshape(b * h, t)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t)))
+        return x
+
+    qb = to_bh(q, tq_pad)
+    kb = to_bh(k, tk_pad)
+    vb = to_bh(v, tk_pad)
+    dob = to_bh(ct.astype(jnp.float32), tq_pad)
+    mb = rows_bh(m, tq_pad)
+    lb = rows_bh(l, tq_pad)
+    big_d = jnp.einsum(
+        "bqhd,bqhd->bhq",
+        ct.astype(jnp.float32),
+        out.astype(jnp.float32),
+    )
+    db = rows_bh(big_d, tq_pad)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda bh, j, i: (bh, i))
+    dkv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_k=bk,
+            seq_len=t,
+        ),
+        grid=(b * h, tk_pad // bk, tq_pad // bq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+                  row_spec],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, dob, mb, lb, db)
+    dkb, dvb = dkv
+
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i))
+    dqb = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_k=bk,
+            seq_len=t,
+        ),
+        grid=(b * h, tq_pad // bq, tk_pad // bk),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2, row_spec2],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qb, kb, vb, dob, mb, lb, db)
+
+    def from_bh(x, t_real):
+        x = x[:, :t_real].reshape(b, h, t_real, d)
+        return jnp.transpose(x, (0, 2, 1, 3))
+
+    return from_bh(dqb, t), from_bh(dkb, t), from_bh(dvb, t)
 
 
 @functools.lru_cache(maxsize=None)
 def _partitioned_flash(
-    causal: bool, block_q: int, block_k: int, interpret: bool
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    return_stats: bool = False,
 ):
     """The flash kernel wrapped in ``custom_partitioning``: batch and
     heads partition (the grid is over ``b·h``), sequence and head_dim
@@ -204,7 +514,10 @@ def _partitioned_flash(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def _lower(q, k, v):
-        return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+        return _flash_forward(
+            q, k, v, causal, block_q, block_k, interpret,
+            return_stats=return_stats,
+        )
 
     fn = custom_partitioning(_lower)
 
@@ -214,12 +527,57 @@ def _partitioned_flash(
         b_ax = spec[0] if len(spec) > 0 else None
         h_ax = spec[2] if len(spec) > 2 else None
         io = NamedSharding(mesh, P(b_ax, None, h_ax, None))
-        return mesh, _lower, io, (io, io, io)
+        stat = NamedSharding(mesh, P(b_ax, h_ax, None))
+        out_sh = (io, stat, stat) if return_stats else io
+        return mesh, _lower, out_sh, (io, io, io)
+
+    rule_out = (
+        "b t h d, b h t, b h t" if return_stats else "b t h d"
+    )
+    fn.def_partition(
+        partition=partition,
+        sharding_rule=f"b t h d, b s h d, b s h d -> {rule_out}",
+        need_replication_factors=("t", "d", "s"),
+    )
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_flash_bwd(
+    causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """The fused backward under the same batch/head partitioning rule."""
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def _lower(q, k, v, out, m, l, ct):
+        return _flash_backward_pallas(
+            q, k, v, out, m, l, ct, causal, block_q, block_k, interpret
+        )
+
+    fn = custom_partitioning(_lower)
+
+    def partition(mesh, arg_infos, result_infos):
+        sh = arg_infos[0].sharding
+        spec = sh.spec if sh is not None else P()
+        b_ax = spec[0] if len(spec) > 0 else None
+        h_ax = spec[2] if len(spec) > 2 else None
+        io = NamedSharding(mesh, P(b_ax, None, h_ax, None))
+        stat = NamedSharding(mesh, P(b_ax, h_ax, None))
+        return (
+            mesh,
+            _lower,
+            (io, io, io),
+            (io, io, io, io, stat, stat, io),
+        )
 
     fn.def_partition(
         partition=partition,
-        sharding_rule="b t h d, b s h d, b s h d -> b t h d",
-        need_replication_factors=("t", "s", "d"),
+        sharding_rule=(
+            "b t h d, b s h d, b s h d, b t h d, b h t, b h t, b t h d "
+            "-> b t h d, b s h d, b s h d"
+        ),
+        need_replication_factors=("t", "d", "s"),
     )
     return fn
 
@@ -230,18 +588,27 @@ def _flash_vjp(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _partitioned_flash(causal, block_q, block_k, interpret)(q, k, v)
-    # ``out`` joins the residuals: the backward needs D = rowsum(ct*out)
-    # and would otherwise re-accumulate the whole output.
-    return out, (q, k, v, out)
+    out, m, l = _partitioned_flash(
+        causal, block_q, block_k, interpret, True
+    )(q, k, v)
+    # ``out`` joins the residuals (the backward needs D = rowsum(ct*out))
+    # along with the softmax statistics the fused backward consumes.
+    return out, (q, k, v, out, m, l)
 
 
 def _bwd(causal, block_q, block_k, interpret, res, ct):
-    q, k, v, out = res
-    # Chunked-XLA exact backward, shared with blockwise_attention
-    # (ring_attention._chunked_attention_bwd); a hand-fused Pallas
-    # backward kernel remains future work.
-    return _chunked_attention_bwd(q, k, v, out, ct, causal, max(block_k, 128))
+    q, k, v, out, m, l = res
+    # Fused Pallas backward by default (consumes the forward's saved
+    # statistics — no stats-recompute pass); RSDL_FLASH_BWD=xla selects
+    # the chunked-XLA exact backward (shared with blockwise_attention)
+    # as an escape hatch.
+    if os.environ.get("RSDL_FLASH_BWD", "pallas").lower() == "xla":
+        return _chunked_attention_bwd(
+            q, k, v, out, ct, causal, max(block_k, 128)
+        )
+    return _partitioned_flash_bwd(causal, block_q, block_k, interpret)(
+        q, k, v, out, m, l, ct
+    )
 
 
 _flash_vjp.defvjp(_fwd, _bwd)

@@ -117,6 +117,33 @@ for shape in ((2, 1000, 4, 64), (32, 20, 4, 8), (8, 64, 4, 16)):
         err = float(jnp.max(jnp.abs(got - want)))
         assert err < 1e-3, (shape, causal, err)
         print(f"FLASH_TPU {shape} causal={causal} max_err={err:.2e}", flush=True)
+
+# Fused Pallas backward (dK/dV + dQ kernels) compiled on the real chip.
+q, k, v = (
+    jnp.asarray(rng.standard_normal((2, 256, 4, 32)), jnp.float32)
+    for _ in range(3)
+)
+g_f = jax.jit(
+    jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, use_pallas=True, interpret=False
+            )
+            ** 2
+        ),
+        (0, 1, 2),
+    )
+)(q, k, v)
+g_d = jax.grad(
+    lambda q, k, v: jnp.sum(
+        attention_reference(q, k, v, causal=True) ** 2
+    ),
+    (0, 1, 2),
+)(q, k, v)
+for gf, gd in zip(g_f, g_d):
+    err = float(jnp.max(jnp.abs(gf - gd)))
+    assert err < 1e-2, err
+print("FLASH_TPU_BWD_OK", flush=True)
 print("FLASH_TPU_OK", flush=True)
 """
 
