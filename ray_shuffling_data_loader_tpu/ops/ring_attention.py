@@ -119,35 +119,100 @@ def _ring_mask(s, i, me, p, tq, tk):
     return jnp.where(mask[None, None], s, NEG_INF)
 
 
-def _ring_fwd_local(q, k, v, axis_name, causal):
+def _ring_fwd_local(q, k, v, axis_name, causal, use_flash=None):
     """Forward ring pass; returns ``(out, m, l)`` — the softmax statistics
-    ride out as residuals for the backward ring."""
+    ride out as residuals for the backward ring.
+
+    ``use_flash`` routes each hop's local block compute through the fused
+    Pallas flash kernel (``None`` = auto: on for TPU backends). The hop
+    is exactly the kernel's computation; its emitted (m, l) statistics
+    merge into the ring accumulator in float32. Causal hops classify by
+    the chunk's position: below the diagonal = plain kernel, on the
+    diagonal = causal kernel (local positions coincide), above = fully
+    masked, skipped outright — so no traced positions ever enter the
+    kernel."""
     p = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32) * scale
+    if use_flash is None:
+        use_flash = _use_flash_auto()
 
     perm = [(j, (j + 1) % p) for j in range(p)]
 
-    def hop(carry, i):
-        o, m, l, k_c, v_c = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
-        if causal:
-            s = _ring_mask(s, i, me, p, tq, tk)
-        o, m, l = _online_update(o, m, l, s, v_c)
-        k_c = lax.ppermute(k_c, axis_name, perm)
-        v_c = lax.ppermute(v_c, axis_name, perm)
-        return (o, m, l, k_c, v_c), None
+    if use_flash:
+        from ray_shuffling_data_loader_tpu.ops.flash_attention import (
+            _flash_forward,
+        )
+
+        interpret = jax.default_backend() != "tpu"
+
+        def _partial(causal_block):
+            def run(q_, k_, v_):
+                o_i, m_i, l_i = _flash_forward(
+                    q_, k_, v_, causal_block, 128, 128, interpret,
+                    return_stats=True,
+                )
+                return o_i.astype(jnp.float32), m_i, l_i
+
+            return run
+
+        def _masked(q_, k_, v_):
+            return (
+                jnp.zeros((b, tq, h, d), jnp.float32),
+                jnp.full((b, h, tq), NEG_INF, jnp.float32),
+                jnp.zeros((b, h, tq), jnp.float32),
+            )
+
+        def hop(carry, i):
+            o, m, l, k_c, v_c = carry
+            if causal:
+                chunk = (me - i) % p
+                idx = jnp.where(chunk == me, 0, jnp.where(chunk < me, 1, 2))
+                o_i, m_i, l_i = lax.switch(
+                    idx,
+                    [_partial(True), _partial(False), _masked],
+                    q,
+                    k_c,
+                    v_c,
+                )
+            else:
+                o_i, m_i, l_i = _partial(False)(q, k_c, v_c)
+            # Merge the hop's normalized block result into the running
+            # accumulator: un-normalize with l_i, rescale both sides to
+            # the joint max. Fully-masked rows have l == 0 on their side,
+            # so their (possibly exp(0)=1) weights multiply zeros.
+            o_i = jnp.transpose(o_i, (0, 2, 1, 3)) * l_i[..., None]
+            m_new = jnp.maximum(m, m_i)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_i - m_new)
+            o = o * alpha[..., None] + o_i * beta[..., None]
+            l = l * alpha + l_i * beta
+            k_c = lax.ppermute(k_c, axis_name, perm)
+            v_c = lax.ppermute(v_c, axis_name, perm)
+            return (o, m_new, l, k_c, v_c), None
+
+    else:
+
+        def hop(carry, i):
+            o, m, l, k_c, v_c = carry
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32))
+            if causal:
+                s = _ring_mask(s, i, me, p, tq, tk)
+            o, m, l = _online_update(o, m, l, s, v_c)
+            k_c = lax.ppermute(k_c, axis_name, perm)
+            v_c = lax.ppermute(v_c, axis_name, perm)
+            return (o, m, l, k_c, v_c), None
 
     o0, m0, l0 = _accum_init(b, h, tq, d)
     (o, m, l, _, _), _ = lax.scan(hop, (o0, m0, l0, k, v), jnp.arange(p))
     return _accum_finish(o, l, q.dtype), m, l
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_attention_local(q, k, v, axis_name, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_local(q, k, v, axis_name, causal, use_flash=None):
     """Per-device ring attention (runs inside ``shard_map``); q/k/v are
     the local sequence chunks ``[batch, chunk, heads, head_dim]``.
 
@@ -157,16 +222,16 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     with the shard like the forward (plain scan autodiff would save every
     hop's rotated K/V chunks and probability blocks: O(T) + O(T²/p) per
     device; the advisor flagged exactly this)."""
-    out, _, _ = _ring_fwd_local(q, k, v, axis_name, causal)
+    out, _, _ = _ring_fwd_local(q, k, v, axis_name, causal, use_flash)
     return out
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal):
-    out, m, l = _ring_fwd_local(q, k, v, axis_name, causal)
+def _ring_vjp_fwd(q, k, v, axis_name, causal, use_flash=None):
+    out, m, l = _ring_fwd_local(q, k, v, axis_name, causal, use_flash)
     return out, (q, k, v, out, m, l)
 
 
-def _ring_vjp_bwd(axis_name, causal, res, ct):
+def _ring_vjp_bwd(axis_name, causal, use_flash, res, ct):
     q, k, v, out, m, l = res
     p = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
@@ -413,6 +478,7 @@ def make_ring_attention(
     axis_name: str = "data",
     causal: bool = False,
     batch_axis: Optional[str] = None,
+    use_flash: Optional[bool] = None,
 ):
     """Build a jitted ring-attention over ``mesh``'s ``axis_name``.
 
@@ -430,7 +496,9 @@ def make_ring_attention(
         mesh,
         axis_name,
         # Positional call: custom_vjp nondiff args resolve by position.
-        lambda q, k, v: _ring_attention_local(q, k, v, axis_name, causal),
+        lambda q, k, v: _ring_attention_local(
+            q, k, v, axis_name, causal, use_flash
+        ),
         batch_axis=batch_axis,
     )
 
