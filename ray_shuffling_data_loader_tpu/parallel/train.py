@@ -137,6 +137,7 @@ def make_psum_train_step(
     model,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    grad_dtype: Optional[Any] = None,
 ) -> Callable:
     """Explicit-DP train step: per-device compute under ``shard_map`` with a
     hand-written ``lax.psum`` gradient exchange over ICI — the literal
@@ -144,6 +145,15 @@ def make_psum_train_step(
 
     Requires replicated params (pure DP; use :func:`make_train_step` when
     sharding the model axis).
+
+    ``grad_dtype``: optional reduced precision (e.g. ``jnp.bfloat16``)
+    for the gradient all-reduce — halves the bytes on the wire, the
+    analog of the reference's fp16 gradient compression
+    (``ray_torch_shuffle.py:183-193``). Gradients are cast down before
+    the collective and restored to the parameter dtype after; off by
+    default (exact f32 reduction). Worth it when the reduce crosses DCN
+    (multi-slice) — on single-slice ICI the collective is rarely the
+    bottleneck.
     """
     from jax import shard_map
 
@@ -154,7 +164,17 @@ def make_psum_train_step(
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         # The gradient plane: mean-reduce across the data axis on ICI.
-        grads = jax.lax.pmean(grads, DATA_AXIS)
+        if grad_dtype is not None:
+            orig_dtypes = jax.tree.map(lambda g: g.dtype, grads)
+            grads = jax.tree.map(
+                lambda g: g.astype(grad_dtype), grads
+            )
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            grads = jax.tree.map(
+                lambda g, dt: g.astype(dt), grads, orig_dtypes
+            )
+        else:
+            grads = jax.lax.pmean(grads, DATA_AXIS)
         loss = jax.lax.pmean(loss, DATA_AXIS)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
