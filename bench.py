@@ -402,8 +402,15 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     elif resident_env == "off":
         use_resident = False
     else:
+        # The bench is SPMD on pods (every process runs this same line),
+        # so pod-consistent auto-selection is safe: resident engages on
+        # the target topology when every host's budget agrees.
         use_resident = resident_mod.fits_device(
-            filenames, len(feature_columns), mesh=mesh, num_rows=num_rows
+            filenames,
+            len(feature_columns),
+            mesh=mesh,
+            num_rows=num_rows,
+            pod_consistent=True,
         )
     _log(f"loader: {'device-resident' if use_resident else 'map/reduce'}")
 

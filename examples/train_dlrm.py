@@ -181,8 +181,15 @@ def main(argv=None) -> int:
     if args.loader == "mapreduce":
         use_resident = False
     else:
+        # SPMD on pods: every process evaluates this same call, so the
+        # pod-consistent vote is safe (resident engages only when every
+        # host's budget agrees).
         fits = resident_mod.fits_device(
-            filenames, len(feature_columns), mesh=mesh, num_rows=args.num_rows
+            filenames,
+            len(feature_columns),
+            mesh=mesh,
+            num_rows=args.num_rows,
+            pod_consistent=True,
         )
         use_resident = args.loader == "resident" or fits
         if use_resident and not fits:
@@ -191,8 +198,8 @@ def main(argv=None) -> int:
             # isn't drowned by deliberate CPU/pod opt-ins.
             if jax.process_count() > 1:
                 print(
-                    "note: pod resident mode is explicit-construction "
-                    "only; every process must run with --loader resident"
+                    "note: pod auto-select declined (some host's budget "
+                    "vote was no); every process is forcing resident"
                 )
             elif jax.local_devices()[0].platform == "cpu":
                 print(

@@ -175,19 +175,59 @@ def fits_device(
     batch_axis: str = "data",
     budget_frac: float = 0.35,
     num_rows: Optional[int] = None,
+    pod_consistent: bool = False,
 ) -> bool:
     """Policy gate: can the packed dataset live resident in device memory?
 
     The buffer shards over the mesh's batch axis, so the budget applies
-    to the per-device slice. Multi-controller pods never auto-select
-    (pod resident mode is explicit-construction only). ``num_rows``
-    skips the Parquet-footer sweep when the caller already knows the
-    count (remote URIs pay a round-trip per file otherwise).
+    to the per-device slice. ``num_rows`` skips the Parquet-footer sweep
+    when the caller already knows the count (remote URIs pay a
+    round-trip per file otherwise).
+
+    Multi-controller pods: auto-select only when the caller declares the
+    call SPMD (``pod_consistent=True`` — every process calls this at the
+    same point, e.g. the bench and the pod examples); the per-process
+    decisions are then allgathered and resident engages only if EVERY
+    host agrees, so the pod can never split across delivery paths.
+    Library callers probing from a single process keep the safe False.
     """
     if jax.process_count() > 1:
-        # Pod resident mode exists (``_load_multiprocess``) but stays
-        # opt-in: auto never silently swaps a pod's delivery path.
-        return False
+        if not pod_consistent:
+            # Pod resident mode stays opt-in for non-SPMD callers: auto
+            # must never silently swap one process's delivery path.
+            return False
+        local = bool(
+            _fits_device_local(
+                filenames,
+                num_feature_columns,
+                mesh,
+                batch_axis,
+                budget_frac,
+                num_rows,
+            )
+        )
+        from jax.experimental import multihost_utils
+
+        votes = np.asarray(
+            multihost_utils.process_allgather(
+                jnp.asarray([int(local)], jnp.int32)
+            )
+        ).reshape(-1)
+        return bool(votes.min())
+    return _fits_device_local(
+        filenames, num_feature_columns, mesh, batch_axis, budget_frac,
+        num_rows,
+    )
+
+
+def _fits_device_local(
+    filenames: Sequence[str],
+    num_feature_columns: int,
+    mesh: Optional[Mesh] = None,
+    batch_axis: str = "data",
+    budget_frac: float = 0.35,
+    num_rows: Optional[int] = None,
+) -> bool:
     # The mode's entire win is device memory being faster than host
     # memory. On the CPU backend the "device" IS host RAM (and XLA-CPU
     # gathers are slow), so auto mode measured ~3x SLOWER than the host

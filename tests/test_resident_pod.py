@@ -78,6 +78,18 @@ def shard_keys(arr):
             seen.add(idx)
             keys.extend(np.asarray(shard.data).reshape(-1).tolist())
     return keys
+from ray_shuffling_data_loader_tpu.resident import fits_device
+
+# Pod auto-select: single-process callers keep the safe False; the SPMD
+# pod-consistent vote reaches consensus (True here: CPU backend with
+# RSDL_RESIDENT_BUDGET_GB opt-in set below).
+assert fits_device(filenames, 2, mesh=mesh) is False
+os.environ["RSDL_RESIDENT_BUDGET_GB"] = "4"
+assert (
+    fits_device(filenames, 2, mesh=mesh, pod_consistent=True) is True
+)
+del os.environ["RSDL_RESIDENT_BUDGET_GB"]
+
 ds = DeviceResidentShufflingDataset(
     filenames,
     num_epochs=2,
