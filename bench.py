@@ -556,9 +556,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     try:
         # Resident runs report permutation/materialization through the
         # same map/reduce event names, so this covers both loaders.
-        epochs = (
-            collector.call("snapshot").epochs if collector is not None else []
-        )
+        epochs = collector.call("snapshot").epochs
         if epochs:
             phase = {
                 "map_stage_s": round(

@@ -421,7 +421,9 @@ def _flash_backward_pallas(
     qb = to_bh(q, tq_pad)
     kb = to_bh(k, tk_pad)
     vb = to_bh(v, tk_pad)
-    dob = to_bh(ct.astype(jnp.float32), tq_pad)
+    # Native dtype: the kernels cast each dO block to f32 on load, so a
+    # host-side f32 copy would only double dO's HBM traffic.
+    dob = to_bh(ct, tq_pad)
     mb = rows_bh(m, tq_pad)
     lb = rows_bh(l, tq_pad)
     big_d = jnp.einsum(
