@@ -519,20 +519,28 @@ class _DecodeCache:
                 pass
 
 
-def _decode_cache_auto(filenames: List[str], num_epochs: int) -> bool:
+def _decode_cache_auto(
+    filenames: List[str], num_epochs: int, narrow_to_32: bool = False
+) -> bool:
     """Auto policy: cache when more than one epoch will read the files AND
-    the (roughly estimated) decoded size fits comfortably inside the
-    store's capacity budget alongside ~2 epochs of in-flight shuffle
-    state. Snappy DATA_SPEC expands ~3.6x on decode; 6x is the
-    conservative planning factor, and a wrong guess only shifts segments
-    into the spill tier rather than breaking anything. When the budget is
-    unknowable (``capacity_bytes`` None — budgeting disabled, statvfs
-    failure, or spill dir on the same tmpfs), there IS no spill tier to
-    absorb a wrong guess, so auto stays off."""
+    the (estimated) decoded size fits comfortably inside the store's
+    capacity budget alongside ~2 epochs of in-flight shuffle state.
+
+    Expansion factor: snappy DATA_SPEC decodes to ~0.95x its on-disk
+    bytes (measured at 25 GB: 23.7 GB decoded, 11.9 GB after 32-bit
+    narrowing — BENCHLOG 2026-07-30; the compressed int64 columns are
+    nearly incompressible, so decode does not blow them up). 1.3x
+    un-narrowed / 0.7x narrowed keeps planning headroom, and a wrong
+    guess only shifts segments into the spill tier rather than breaking
+    anything. When the budget is unknowable (``capacity_bytes`` None —
+    budgeting disabled, statvfs failure, or spill dir on the same
+    tmpfs), there IS no spill tier to absorb a wrong guess, so auto
+    stays off."""
     if num_epochs < 2:
         return False
+    factor = 0.7 if narrow_to_32 else 1.3
     try:
-        est = sum(os.path.getsize(f) for f in filenames) * 6
+        est = sum(os.path.getsize(f) for f in filenames) * factor
     except OSError:
         return False
     cap = runtime.get_context().store.capacity_bytes
@@ -783,7 +791,9 @@ def shuffle(
         raise ValueError("no input files to shuffle")
     runtime.ensure_initialized()
     if cache_decoded is None:
-        cache_decoded = _decode_cache_auto(filenames, num_epochs - start_epoch)
+        cache_decoded = _decode_cache_auto(
+            filenames, num_epochs - start_epoch, narrow_to_32
+        )
     decode_cache = _DecodeCache(enabled=cache_decoded)
     start = timeit.default_timer()
     threads = []
