@@ -35,7 +35,8 @@ class CausalLM(nn.Module):
     ``__call__(tokens [batch, seq]) -> logits [batch, seq, vocab]``.
 
     ``attention_fn`` must apply a CAUSAL mask (default: causal
-    ``flash_attention`` — fused Pallas on a single-device TPU, dense XLA
+    ``flash_attention`` — fused Pallas on TPU backends (batch/head-
+partitioned on pod meshes), dense XLA
     elsewhere; pass ``make_ring_attention(mesh, axis, causal=True)`` or
     the Ulysses equivalent to shard the sequence axis).
     """
@@ -67,7 +68,8 @@ class CausalLM(nn.Module):
         x = jnp.take(embed, tokens % self.vocab_size, axis=0)
         x = (x + pos[None, :t]).astype(self.compute_dtype)
         # Default: the flash lowering with causal masking (Pallas on a
-        # single-device TPU, dense XLA elsewhere — see flash_attention).
+        # TPU backends incl. pod meshes, dense XLA elsewhere — see
+        # flash_attention).
         attention = self.attention_fn or functools.partial(
             flash_attention, causal=True
         )

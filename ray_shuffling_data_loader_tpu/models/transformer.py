@@ -12,7 +12,7 @@ TPU-first choices mirror the flagship DLRM (``models/dlrm.py``):
 float32 params with bfloat16 compute (MXU-rate matmuls), embedding
 lookups as gathers, and no data-dependent control flow. Attention is
 pluggable: the default is :func:`~.ops.flash_attention.flash_attention`
-(auto: fused Pallas kernel on a single-device TPU, dense XLA reference
+(auto: fused Pallas kernel on TPU backends incl. pod meshes, dense XLA reference
 elsewhere); pass ``attention_fn=make_ring_attention(mesh, axis)`` to run
 the encoder with sequence-parallel ring attention when the token
 sequence is sharded across the mesh (long-context configurations — see
@@ -60,7 +60,7 @@ class EncoderBlock(nn.Module):
         qkv = dense(3 * d, "qkv")(h).reshape(b, t, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         # Default lowering mirrors the DLRM interaction auto-policy: the
-        # fused Pallas flash kernel on a single-device TPU backend, the
+        # fused Pallas flash kernel on TPU backends (pods included), the
         # dense XLA reference everywhere else (flash_attention resolves
         # this internally).
         attn = (self.attention_fn or flash_attention)(q, k, v)

@@ -10,12 +10,13 @@ matmuls via ``lax.dot_general``, ``@pl.when`` for first/last-block
 prologue/epilogue, lane-padded VMEM scratch for the running max and
 normalizer).
 
-Scope: single-device attention over ``[batch, seq, heads, head_dim]``.
+Scope: attention over ``[batch, seq, heads, head_dim]`` (batch/head
+partitionable on pod meshes via ``custom_partitioning``).
 It composes with the sequence-parallel schedules (the Ulysses local body
 and each ring hop are exactly this computation) but is wired as the
 standalone ``flash_attention`` op with an XLA fallback — same
 auto-policy shape as the DLRM interaction kernel (``ops/interaction.py``):
-Pallas on single-device TPU, XLA reference elsewhere, interpret mode for
+Pallas on TPU backends, XLA reference elsewhere, interpret mode for
 CPU tests.
 
 Differentiability: the kernel carries an exact, memory-safe custom VJP.
