@@ -549,18 +549,34 @@ def _decode_cache_auto(
     return est < 0.35 * cap
 
 
-def _index_schedule_allowed() -> bool:
+def _index_schedule_allowed(
+    filenames: List[str], num_reducers: int, narrow_to_32: bool
+) -> bool:
     """Policy for the index-only steady-state schedule. ``auto`` (default)
-    engages it on single-host runtimes only: every gather reads from every
-    file's cached segment, so cross-host it would pull ~the whole dataset
-    to each reducer host where the materialized path moves ~1/R per
-    reducer. ``RSDL_INDEX_SHUFFLE=on|off`` overrides."""
+    weighs its read amplification: every gather reads ~the ENTIRE cached
+    dataset (a 1/R row subset still touches every cache line), so one
+    epoch's gathers read ``R x cache_bytes`` where the materialized path
+    reads ~3x cache_bytes total. Measured at 25 GB / R=8 / 1 vCPU the
+    index schedule LOSES 1.7x pipelined, while at <=5 GB isolated stages
+    it wins 1.9x (BENCHLOG 2026-07-30) — so auto engages only when the
+    per-epoch read traffic is modest relative to the host's parallelism
+    (threaded gathers amortize it on real many-core TPU hosts), and only
+    single-host (cross-host the reads would ride DCN).
+    ``RSDL_INDEX_SHUFFLE=on|off`` overrides."""
     mode = os.environ.get("RSDL_INDEX_SHUFFLE", "auto").strip().lower()
     if mode in ("on", "1", "true"):
         return True
     if mode in ("off", "0", "false"):
         return False
-    return runtime.get_context().cluster is None
+    if runtime.get_context().cluster is not None:
+        return False
+    factor = 0.7 if narrow_to_32 else 1.3
+    try:
+        est_cache = sum(os.path.getsize(f) for f in filenames) * factor
+    except OSError:
+        return False
+    budget = 16e9 * max(1, os.cpu_count() or 1)
+    return num_reducers * est_cache <= budget
 
 
 def shuffle_epoch(
@@ -601,7 +617,7 @@ def shuffle_epoch(
         decode_cache = _DecodeCache(enabled=False)
     cache_refs = (
         decode_cache.hot_refs(len(filenames))
-        if _index_schedule_allowed()
+        if _index_schedule_allowed(filenames, num_reducers, narrow_to_32)
         else None
     )
     schedule = "index" if cache_refs is not None else "mapreduce"
