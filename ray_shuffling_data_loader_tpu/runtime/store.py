@@ -391,6 +391,9 @@ class ObjectStore:
     def __init__(self, session: str, shm_dir: Optional[str] = None):
         self.session = session
         self.shm_dir = shm_dir or _default_shm_dir()
+        # RSDL_SHM_DIR may name a fresh subdirectory (e.g. per-session
+        # dirs isolating same-machine multi-host tests).
+        os.makedirs(self.shm_dir, exist_ok=True)
         # Capacity budgeting (SURVEY §7 hard-part 4): shared-memory
         # residency for this session is capped; segments beyond the budget
         # are created in (or fetched to) the disk-backed spill dir instead
@@ -635,6 +638,10 @@ class ObjectStore:
             if isinstance(r, ObjectRef)
             and self._is_foreign(r)
             and self._find_cache(r) is None
+            # Same-filesystem shortcut parity with get_columns: a
+            # "foreign" segment that is directly mappable here (sessions
+            # sharing one /dev/shm) needs no pull at all.
+            and self._find_segment(r.object_id) is None
         ]
         if not foreign:
             return []

@@ -403,20 +403,31 @@ def _run_locality_cluster(tmp_path, tag: str, extra_env: dict) -> int:
         os.environ, RSDL_ADVERTISE_HOST="127.0.0.1", JAX_PLATFORMS="cpu"
     )
     env.update(extra_env)
+    # Per-"host" shared-memory dirs: on one physical machine both
+    # sessions would otherwise share /dev/shm, and get_columns maps a
+    # peer's segment directly — zero measured cross-host bytes for BOTH
+    # schedules. Separate dirs force every cross-session read through
+    # the store servers, the way distinct hosts behave.
+    shm_head = f"/dev/shm/rsdl-test-{tag}-head"
+    shm_worker = f"/dev/shm/rsdl-test-{tag}-worker"
     head_log = tmp_path / f"head_{tag}.log"
     worker_log = tmp_path / f"worker_{tag}.log"
+    import shutil
+
     with open(head_log, "w") as hf, open(worker_log, "w") as wf:
         head = subprocess.Popen(
             [sys.executable, "-c", LOCALITY_HEAD_SCRIPT.format(
                 repo=_REPO, addr_file=addr_file, data_dir=data_dir
             )],
-            stdout=hf, stderr=subprocess.STDOUT, env=env,
+            stdout=hf, stderr=subprocess.STDOUT,
+            env=dict(env, RSDL_SHM_DIR=shm_head),
         )
         worker = subprocess.Popen(
             [sys.executable, "-c", WORKER_SCRIPT.format(
                 repo=_REPO, addr_file=addr_file
             )],
-            stdout=wf, stderr=subprocess.STDOUT, env=env,
+            stdout=wf, stderr=subprocess.STDOUT,
+            env=dict(env, RSDL_SHM_DIR=shm_worker),
         )
         try:
             head.wait(timeout=240)
@@ -428,6 +439,8 @@ def _run_locality_cluster(tmp_path, tag: str, extra_env: dict) -> int:
             worker.kill()
             head.wait()
             worker.wait()
+            for d in (shm_head, shm_worker):
+                shutil.rmtree(d, ignore_errors=True)
     out = head_log.read_text()
     assert "VERDICT: PASS" in out, (
         f"head[{tag}]:\n{out}\n--- worker:\n{worker_log.read_text()}"
