@@ -73,3 +73,16 @@ def test_bench_resident_loader_contract(tmp_path):
     result = _run_bench(tmp_path, {"RSDL_BENCH_RESIDENT": "on"})
     assert result["loader"] == "resident", result
     assert result["staged_gb"] > 0, result
+
+
+def test_bench_resident_failure_falls_back(tmp_path):
+    """An auto-selected resident loader that dies on the real backend
+    must not sink the round's number: the bench restarts the timed
+    window on the map/reduce loader and records why."""
+    result = _run_bench(
+        tmp_path,
+        {"RSDL_BENCH_RESIDENT": "on", "RSDL_BENCH_FAULT": "resident"},
+    )
+    assert result["loader"] == "mapreduce", result
+    assert "injected resident fault" in result.get("resident_error", "")
+    assert result["value"] > 0, result
