@@ -156,10 +156,20 @@ def device_memory_budget(
         platform = dev.platform
     except Exception:
         return None, False
+    if platform == "tpu":
+        # Some TPU plugins (e.g. tunneled/experimental ones) expose no
+        # memory_stats. Refusing outright would silently bench the
+        # slower loader on exactly the hardware the resident mode
+        # targets; assume the v5e-class 16 GB HBM floor instead
+        # (RSDL_TPU_HBM_GB overrides). Mis-admission is survivable: the
+        # bench restarts on map/reduce if the loader dies (bench.py
+        # failover), and real OOMs surface at staging, not mid-train.
+        hbm = float(os.environ.get("RSDL_TPU_HBM_GB", "16")) * 1e9
+        return int(budget_frac * hbm), True
     if platform != "cpu":
-        # An accelerator that won't report its memory limit gets no
-        # guess: host RAM says nothing about HBM, and an over-admitted
-        # resident buffer OOMs the device mid-staging.
+        # A non-TPU accelerator that won't report its memory limit gets
+        # no guess: host RAM says nothing about device memory, and an
+        # over-admitted resident buffer OOMs the device mid-staging.
         return None, False
     try:
         ram = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
