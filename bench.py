@@ -277,6 +277,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         batch_sharding,
         init_state,
         make_mesh,
+        make_step_body,
         make_train_step,
     )
 
@@ -316,7 +317,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         step_fn = make_train_step(model, optimizer, mesh, shardings)
         state, _ = step_fn(state, example_dev, labels0)
         jax.block_until_ready(state.params)
-        return state, step_fn
+        return state, step_fn, make_step_body(model, optimizer)
 
     # Auto: fused Pallas interaction on single-chip TPU, XLA reference
     # elsewhere. A Mosaic/libtpu compile failure must not cost the round
@@ -337,7 +338,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
 
     pallas_env = os.environ.get("RSDL_BENCH_PALLAS", "auto")
     pallas_mode = "off"
-    state = step_fn = None
+    state = step_fn = step_body = None
     if mock_step_s is not None:
         pallas_mode = "mocked-step"
     elif pallas_env != "off":
@@ -373,7 +374,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 # the flag no publish can occur.
                 abandoned.set()
         if "result" in box:
-            state, step_fn = box["result"]
+            state, step_fn, step_body = box["result"]
         elif pallas_env == "on":
             raise RuntimeError(
                 f"pallas warm-up failed with RSDL_BENCH_PALLAS=on: "
@@ -388,7 +389,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             _log(f"pallas warm-up failed ({why}); reference interaction")
             pallas_mode = "fallback-reference"
     if step_fn is None and mock_step_s is None:
-        state, step_fn = build_and_warm(False)
+        state, step_fn, step_body = build_and_warm(False)
 
     # Loader choice: the device-resident shuffle (epoch permutation +
     # gather in HBM, one staging pass total — resident.py) when the packed
@@ -534,6 +535,36 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         ds = make_dataset(resident_now)
         step_time = 0.0
         num_steps = 0
+        fused = (
+            resident_now
+            and mock_step_s is None
+            # Single-device meshes only: scanning the full DLRM step over
+            # a sharded epoch buffer is exactly what the single-chip
+            # round-end bench runs; on multi-device CPU meshes the same
+            # program's compile blows up (observed wedge at 8 virtual
+            # devices), and pods have their own delivery semantics.
+            and jax.device_count() == 1
+            and os.environ.get("RSDL_BENCH_FUSED", "on") != "off"
+        )
+        if fused:
+            # Epoch fusion: the dataset is HBM-resident, so the entire
+            # epoch (batch slice + unpack + train step) runs as ONE
+            # jitted lax.scan — one dispatch per epoch instead of one+
+            # host round-trips per batch, the delivery cost that
+            # dominates on high-latency links (resident.make_fused_epoch).
+            run_epoch = resident_mod.make_fused_epoch(
+                ds, step_body, donate_state=False
+            )
+            per_epoch = ds._rank_rows // BATCH_SIZE
+            for epoch in range(NUM_EPOCHS):
+                t0 = time.perf_counter()
+                state, losses = run_epoch(state, epoch)
+                jax.block_until_ready(losses)
+                metrics = {"loss": losses[-1]}
+                step_time += time.perf_counter() - t0
+                num_steps += per_epoch
+                last_progress[0] = time.monotonic()
+            return time.perf_counter() - t0_run, ds
         for epoch in range(NUM_EPOCHS):
             ds.set_epoch(epoch)
             for features, label in ds:

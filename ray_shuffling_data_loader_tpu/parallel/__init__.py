@@ -19,5 +19,6 @@ from ray_shuffling_data_loader_tpu.parallel.train import (  # noqa: F401
     bce_loss,
     init_state,
     make_psum_train_step,
+    make_step_body,
     make_train_step,
 )

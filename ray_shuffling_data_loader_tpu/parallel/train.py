@@ -92,19 +92,16 @@ def init_state(
     return state, shardings
 
 
-def make_train_step(
-    model,
-    optimizer: optax.GradientTransformation,
-    mesh: Mesh,
-    state_shardings,
-    donate_state: bool = True,
-) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
-    """Sharding-annotated jitted train step (idiomatic pjit path).
+def make_step_body(
+    model, optimizer: optax.GradientTransformation
+) -> Callable:
+    """The UNJITTED per-batch train step:
+    ``(state, features, labels) -> (state, {"loss"})``.
 
-    Batch arrives sharded along ``data`` (as produced by
-    ``JaxShufflingDataset``); XLA derives the gradient all-reduce.
-    """
-    batch_in = batch_sharding(mesh, 1)
+    The building block both :func:`make_train_step` (jitted with
+    shardings) and the resident loader's epoch fusion
+    (:func:`~.resident.make_fused_epoch` scans it across a whole epoch
+    in one device program) compose from."""
 
     def step_fn(state: TrainState, features, labels):
         def loss_fn(params):
@@ -120,6 +117,24 @@ def make_train_step(
             step=state.step + 1, params=params, opt_state=opt_state
         )
         return new_state, {"loss": loss}
+
+    return step_fn
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    state_shardings,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Sharding-annotated jitted train step (idiomatic pjit path).
+
+    Batch arrives sharded along ``data`` (as produced by
+    ``JaxShufflingDataset``); XLA derives the gradient all-reduce.
+    """
+    batch_in = batch_sharding(mesh, 1)
+    step_fn = make_step_body(model, optimizer)
 
     return jax.jit(
         step_fn,

@@ -941,3 +941,101 @@ class DeviceResidentShufflingDataset:
             )
         while pending:
             yield pending.popleft()
+
+
+def make_fused_epoch(
+    ds: DeviceResidentShufflingDataset,
+    step_body: Callable,
+    donate_state: bool = True,
+) -> Callable:
+    """Fuse a WHOLE training epoch into one jitted device program.
+
+    The resident design's unique capability: with the packed dataset (and
+    each epoch's permutation) living in device memory, the entire epoch —
+    per-batch slice, bitcast unpack, and the training step — compiles to a
+    single ``lax.scan``. One dispatch per epoch replaces one (or more)
+    host round-trips per batch, which on high-latency links (a tunneled
+    chip; any remote dispatch path) is the dominant delivery cost. No
+    host-side loader can do this; it is the device-resident analog of the
+    reference's tightest possible consumption loop.
+
+    ``step_body(state, features, label) -> (state, metrics)`` is the
+    UNJITTED per-batch step (e.g. the body of
+    :func:`~.parallel.train.make_train_step`); ``metrics`` must be a dict
+    containing ``"loss"``.
+
+    Returns ``run_epoch(state, epoch) -> (state, losses)`` where
+    ``losses`` is the per-batch loss array for the epoch. Only full
+    batches run fused (the resident loader defaults to ``drop_last=True``
+    already); the epoch's permutation and (on the materialized schedule)
+    the permuted copy are produced on device exactly as the per-batch
+    iterator would.
+    """
+    ds._check_open()
+    unpack = ds._unpack_rows()
+    b = ds.batch_size
+    full = ds._rank_rows // b
+    ncols = len(ds._columns)
+    start0 = ds._rank_start
+
+    def run_epoch(state, ebuf):
+        def body(state, i):
+            rows = jax.lax.dynamic_slice(
+                ebuf,
+                (jnp.int32(0), jnp.int32(start0) + i * jnp.int32(b)),
+                (ncols, b),
+            )
+            feats, label = unpack(rows)
+            state, metrics = step_body(state, feats, label)
+            return state, metrics["loss"]
+
+        return jax.lax.scan(body, state, jnp.arange(full, dtype=jnp.int32))
+
+    fused = jax.jit(run_epoch, donate_argnums=(0,) if donate_state else ())
+
+    def run(state, epoch: int):
+        ds._check_open()
+        if not 0 <= epoch < ds.num_epochs:
+            raise ValueError(f"epoch {epoch} outside {ds.num_epochs}")
+        if ds._materialize:
+            ebuf = ds._epoch_buf(epoch)
+        else:
+            # Gather schedule: materializing would blow the budget; fuse
+            # over a VIEW of the base buffer permuted per batch instead.
+            return _run_gather_fused(ds, step_body, fused, state, epoch)
+        state, losses = fused(state, ebuf)
+        ds.stats.batches_staged += int(full)
+        return state, losses
+
+    return run
+
+
+def _run_gather_fused(ds, step_body, _unused, state, epoch):
+    """Fused epoch for the per-batch-gather schedule: the scan body
+    gathers its batch rows through the epoch permutation instead of
+    slicing a materialized copy."""
+    unpack = ds._unpack_rows()
+    b = ds.batch_size
+    full = ds._rank_rows // b
+    start0 = ds._rank_start
+    fn = ds._gather_cache.get(("fused-gather", b))
+    if fn is None:
+
+        def run_epoch(state, buf, perm):
+            def body(state, i):
+                idx = jax.lax.dynamic_slice(
+                    perm, (jnp.int32(start0) + i * jnp.int32(b),), (b,)
+                )
+                feats, label = unpack(jnp.take(buf, idx, axis=1))
+                state, metrics = step_body(state, feats, label)
+                return state, metrics["loss"]
+
+            return jax.lax.scan(
+                body, state, jnp.arange(full, dtype=jnp.int32)
+            )
+
+        fn = jax.jit(run_epoch, donate_argnums=(0,))
+        ds._gather_cache[("fused-gather", b)] = fn
+    state, losses = fn(state, ds._buf, ds._perm(epoch))
+    ds.stats.batches_staged += int(full)
+    return state, losses

@@ -34,8 +34,8 @@ def _run_bench(tmp_path, extra_env):
         # Mock mode bypasses model build/compile/warm-up entirely; the
         # contract under test is the JSON line, not the train step.
         RSDL_BENCH_MOCK_STEP_S="0.01",
-        **extra_env,
     )
+    env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
         capture_output=True,
@@ -73,6 +73,30 @@ def test_bench_resident_loader_contract(tmp_path):
     result = _run_bench(tmp_path, {"RSDL_BENCH_RESIDENT": "on"})
     assert result["loader"] == "resident", result
     assert result["staged_gb"] > 0, result
+
+
+def test_bench_resident_fused_real_step_contract(tmp_path):
+    """The path the real-TPU round-end bench takes end to end: resident
+    loader + REAL train steps, which the bench fuses into one jitted
+    scan per epoch (resident.make_fused_epoch). The JSON contract and a
+    finite loss must survive it."""
+    result = _run_bench(
+        tmp_path,
+        # Empty string disables the mock step set by _run_bench's base
+        # env, so the real DLRM step (and with it epoch fusion) runs.
+        # One device, like the round-end chip: fusion gates on
+        # single-device meshes (multi-device CPU compile of the scanned
+        # step explodes).
+        {
+            "RSDL_BENCH_RESIDENT": "on",
+            "RSDL_BENCH_MOCK_STEP_S": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        },
+    )
+    assert result["loader"] == "resident", result
+    assert result["value"] > 0, result
+    assert result["loss"] is not None and result["loss"] == result["loss"]
+    assert result["steps"] >= 1, result
 
 
 def test_bench_resident_failure_falls_back(tmp_path):
