@@ -524,6 +524,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         ).start()
 
     resident_error = None
+    run_ds = {}  # the current attempt's dataset, for failover cleanup
 
     def timed_run(resident_now):
         nonlocal state, metrics, step_time, num_steps
@@ -533,6 +534,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         # metric reports (the map/reduce loader's constructor is cheap —
         # its shuffle work already overlaps the timed loop).
         ds = make_dataset(resident_now)
+        run_ds["ds"] = ds
         step_time = 0.0
         num_steps = 0
         fused = (
@@ -556,12 +558,26 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 ds, step_body, donate_state=False
             )
             per_epoch = ds._rank_rows // BATCH_SIZE
+            epoch_bytes = (len(feature_columns) + 1) * 4 * per_epoch * BATCH_SIZE
             for epoch in range(NUM_EPOCHS):
                 t0 = time.perf_counter()
+                if epoch == 0:
+                    # The first fused call compiles the whole scanned
+                    # step; grant the stall watchdog one compile's worth
+                    # of extra budget (a future "last progress" = more
+                    # headroom) without disarming wedge detection.
+                    last_progress[0] = time.monotonic() + 900
+                collector.call_oneway("epoch_start", epoch)
+                collector.call_oneway("map_start", epoch)
+                collector.call_oneway("map_done", epoch, 0.0, 0.0)
+                collector.call_oneway("reduce_start", epoch)
                 state, losses = run_epoch(state, epoch)
                 jax.block_until_ready(losses)
+                dur = time.perf_counter() - t0
+                collector.call_oneway("reduce_done", epoch, dur)
+                collector.call_oneway("consume", 0, epoch, epoch_bytes)
                 metrics = {"loss": losses[-1]}
-                step_time += time.perf_counter() - t0
+                step_time += dur
                 num_steps += per_epoch
                 last_progress[0] = time.monotonic()
             return time.perf_counter() - t0_run, ds
@@ -594,6 +610,29 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         resident_error = f"{type(exc).__name__}: {exc}"
         _log(f"resident loader failed ({resident_error}); "
              "re-running on the map/reduce loader")
+        # Release the failed attempt's staged HBM buffers before the
+        # rerun competes for device memory (the OOM-on-mis-admission
+        # case is exactly why this failover exists).
+        failed = run_ds.pop("ds", None)
+        if failed is not None:
+            try:
+                failed.close()
+            except Exception:
+                pass
+        # A fresh collector sized for the map/reduce stage counts — the
+        # resident-sized one (1 map/1 reduce per epoch) would latch the
+        # fallback's stage windows after the first task and mix in the
+        # failed attempt's partial events.
+        collector = runtime.spawn_actor(
+            TrialStatsCollector,
+            NUM_EPOCHS,
+            len(filenames),
+            NUM_REDUCERS,
+            num_rows,
+            BATCH_SIZE,
+            1,
+            name="bench-stats-fallback",
+        )
         use_resident = False
         last_progress[0] = time.monotonic()
         total_s, ds = timed_run(False)

@@ -149,13 +149,19 @@ def device_memory_budget(
         return int(float(env) * 1e9), False
     try:
         dev = jax.local_devices()[0]
+        platform = dev.platform
+    except Exception:
+        return None, False
+    try:
+        # memory_stats can RAISE (not just return empty) on experimental
+        # PJRT plugins; the platform-specific fallbacks below must still
+        # fire in that case.
         stats = dev.memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
         if limit > 0:
             return int(budget_frac * limit), True
-        platform = dev.platform
     except Exception:
-        return None, False
+        pass
     if platform == "tpu":
         # Some TPU plugins (e.g. tunneled/experimental ones) expose no
         # memory_stats. Refusing outright would silently bench the
@@ -1002,7 +1008,9 @@ def make_fused_epoch(
         else:
             # Gather schedule: materializing would blow the budget; fuse
             # over a VIEW of the base buffer permuted per batch instead.
-            return _run_gather_fused(ds, step_body, fused, state, epoch)
+            return _run_gather_fused(
+                ds, step_body, donate_state, state, epoch
+            )
         state, losses = fused(state, ebuf)
         ds.stats.batches_staged += int(full)
         return state, losses
@@ -1010,15 +1018,18 @@ def make_fused_epoch(
     return run
 
 
-def _run_gather_fused(ds, step_body, _unused, state, epoch):
+def _run_gather_fused(ds, step_body, donate_state, state, epoch):
     """Fused epoch for the per-batch-gather schedule: the scan body
     gathers its batch rows through the epoch permutation instead of
-    slicing a materialized copy."""
+    slicing a materialized copy. The jit cache keys on the step body
+    (and donation mode) too — one staged dataset can be fused with
+    different models without silently replaying the first's program."""
     unpack = ds._unpack_rows()
     b = ds.batch_size
     full = ds._rank_rows // b
     start0 = ds._rank_start
-    fn = ds._gather_cache.get(("fused-gather", b))
+    key = ("fused-gather", b, id(step_body), bool(donate_state))
+    fn = ds._gather_cache.get(key)
     if fn is None:
 
         def run_epoch(state, buf, perm):
@@ -1034,8 +1045,10 @@ def _run_gather_fused(ds, step_body, _unused, state, epoch):
                 body, state, jnp.arange(full, dtype=jnp.int32)
             )
 
-        fn = jax.jit(run_epoch, donate_argnums=(0,))
-        ds._gather_cache[("fused-gather", b)] = fn
+        fn = jax.jit(
+            run_epoch, donate_argnums=(0,) if donate_state else ()
+        )
+        ds._gather_cache[key] = fn
     state, losses = fn(state, ds._buf, ds._perm(epoch))
     ds.stats.batches_staged += int(full)
     return state, losses

@@ -519,6 +519,16 @@ class _DecodeCache:
                 pass
 
 
+def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
+    """Estimated decoded-columns footprint of the dataset. Measured at
+    25 GB: snappy DATA_SPEC decodes to ~0.95x its on-disk bytes (the
+    high-cardinality int64 columns are nearly incompressible); 1.3x
+    un-narrowed / 0.7x narrowed keeps planning headroom. Raises OSError
+    through from getsize (callers treat that as "unknown: decline")."""
+    factor = 0.7 if narrow_to_32 else 1.3
+    return sum(os.path.getsize(f) for f in filenames) * factor
+
+
 def _decode_cache_auto(
     filenames: List[str], num_epochs: int, narrow_to_32: bool = False
 ) -> bool:
@@ -526,21 +536,16 @@ def _decode_cache_auto(
     the (estimated) decoded size fits comfortably inside the store's
     capacity budget alongside ~2 epochs of in-flight shuffle state.
 
-    Expansion factor: snappy DATA_SPEC decodes to ~0.95x its on-disk
-    bytes (measured at 25 GB: 23.7 GB decoded, 11.9 GB after 32-bit
-    narrowing — BENCHLOG 2026-07-30; the compressed int64 columns are
-    nearly incompressible, so decode does not blow them up). 1.3x
-    un-narrowed / 0.7x narrowed keeps planning headroom, and a wrong
-    guess only shifts segments into the spill tier rather than breaking
-    anything. When the budget is unknowable (``capacity_bytes`` None —
+    Sizing comes from :func:`_est_decoded_bytes` (measured expansion —
+    BENCHLOG 2026-07-30); a wrong guess only shifts segments into the
+    spill tier rather than breaking anything. When the budget is unknowable (``capacity_bytes`` None —
     budgeting disabled, statvfs failure, or spill dir on the same
     tmpfs), there IS no spill tier to absorb a wrong guess, so auto
     stays off."""
     if num_epochs < 2:
         return False
-    factor = 0.7 if narrow_to_32 else 1.3
     try:
-        est = sum(os.path.getsize(f) for f in filenames) * factor
+        est = _est_decoded_bytes(filenames, narrow_to_32)
     except OSError:
         return False
     cap = runtime.get_context().store.capacity_bytes
@@ -570,9 +575,8 @@ def _index_schedule_allowed(
         return False
     if runtime.get_context().cluster is not None:
         return False
-    factor = 0.7 if narrow_to_32 else 1.3
     try:
-        est_cache = sum(os.path.getsize(f) for f in filenames) * factor
+        est_cache = _est_decoded_bytes(filenames, narrow_to_32)
     except OSError:
         return False
     budget = 16e9 * max(1, os.cpu_count() or 1)
