@@ -70,6 +70,55 @@ for mat in (True, False):
     streams[mat] = epochs
 for epoch in range(2):
     assert np.array_equal(streams[True][epoch], streams[False][epoch])
+
+# Epoch-fused training compiled on the real chip: one lax.scan per
+# epoch over the resident buffer, loss curve bit-comparable to the
+# per-batch step on the same data (the path the round-end bench takes).
+import jax.numpy as jnp
+from ray_shuffling_data_loader_tpu.resident import make_fused_epoch
+
+ds_f = DeviceResidentShufflingDataset(
+    filenames,
+    num_epochs=2,
+    batch_size=25_000,
+    feature_columns=features,
+    label_column=LABEL_COLUMN,
+    seed=5,
+)
+
+def step_body(state, feats, label):
+    def loss_fn(w):
+        pred = w * feats["key"].astype(jnp.float32) / 200_000.0
+        return jnp.mean((pred - label) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(state)
+    return state - 0.05 * g, {"loss": loss}
+
+run = make_fused_epoch(ds_f, step_body, donate_state=False)
+state_f = jnp.float32(0.5)
+t0 = time.perf_counter()
+for epoch in range(2):
+    state_f, losses = run(state_f, epoch)
+    jax.block_until_ready(losses)
+print(f"RESIDENT_TPU fused 2 epochs {time.perf_counter()-t0:.3f}s", flush=True)
+
+ds_p = DeviceResidentShufflingDataset(
+    filenames,
+    num_epochs=2,
+    batch_size=25_000,
+    feature_columns=features,
+    label_column=LABEL_COLUMN,
+    seed=5,
+)
+step = jax.jit(step_body)
+state_p = jnp.float32(0.5)
+for epoch in range(2):
+    ds_p.set_epoch(epoch)
+    for feats, label in ds_p:
+        state_p, _ = step(state_p, feats, label)
+assert abs(float(state_f) - float(state_p)) < 1e-5, (
+    float(state_f), float(state_p),
+)
+print("RESIDENT_TPU_FUSED_OK", flush=True)
 runtime.shutdown()
 print("RESIDENT_TPU_OK", flush=True)
 """
