@@ -554,33 +554,53 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             # jitted lax.scan — one dispatch per epoch instead of one+
             # host round-trips per batch, the delivery cost that
             # dominates on high-latency links (resident.make_fused_epoch).
-            run_epoch = resident_mod.make_fused_epoch(
-                ds, step_body, donate_state=False
-            )
-            per_epoch = ds._rank_rows // BATCH_SIZE
-            epoch_bytes = (len(feature_columns) + 1) * 4 * per_epoch * BATCH_SIZE
-            for epoch in range(NUM_EPOCHS):
-                t0 = time.perf_counter()
-                if epoch == 0:
-                    # The first fused call compiles the whole scanned
-                    # step; grant the stall watchdog one compile's worth
-                    # of extra budget (a future "last progress" = more
-                    # headroom) without disarming wedge detection.
-                    last_progress[0] = time.monotonic() + 900
-                collector.call_oneway("epoch_start", epoch)
-                collector.call_oneway("map_start", epoch)
-                collector.call_oneway("map_done", epoch, 0.0, 0.0)
-                collector.call_oneway("reduce_start", epoch)
-                state, losses = run_epoch(state, epoch)
-                jax.block_until_ready(losses)
-                dur = time.perf_counter() - t0
-                collector.call_oneway("reduce_done", epoch, dur)
-                collector.call_oneway("consume", 0, epoch, epoch_bytes)
-                metrics = {"loss": losses[-1]}
-                step_time += dur
-                num_steps += per_epoch
+            # The scanned module is much bigger than the per-batch step
+            # build_and_warm probed, so a compile-time rejection here is
+            # plausible on experimental toolchains — degrade to the
+            # per-batch RESIDENT loop below, not all the way to
+            # map/reduce.
+            try:
+                run_epoch = resident_mod.make_fused_epoch(
+                    ds, step_body, donate_state=False
+                )
+                per_epoch = ds._rank_rows // BATCH_SIZE
+                epoch_bytes = (
+                    (len(feature_columns) + 1) * 4 * per_epoch * BATCH_SIZE
+                )
+                for epoch in range(NUM_EPOCHS):
+                    t0 = time.perf_counter()
+                    if epoch == 0:
+                        # The first fused call compiles the whole scanned
+                        # step; grant the stall watchdog one compile's
+                        # worth of extra budget (a future "last progress"
+                        # = more headroom) without disarming wedge
+                        # detection.
+                        last_progress[0] = time.monotonic() + 900
+                    collector.call_oneway("epoch_start", epoch)
+                    collector.call_oneway("map_start", epoch)
+                    collector.call_oneway("map_done", epoch, 0.0, 0.0)
+                    collector.call_oneway("reduce_start", epoch)
+                    state, losses = run_epoch(state, epoch)
+                    jax.block_until_ready(losses)
+                    dur = time.perf_counter() - t0
+                    collector.call_oneway("reduce_done", epoch, dur)
+                    collector.call_oneway("consume", 0, epoch, epoch_bytes)
+                    metrics = {"loss": losses[-1]}
+                    step_time += dur
+                    num_steps += per_epoch
+                    last_progress[0] = time.monotonic()
+                return time.perf_counter() - t0_run, ds
+            except Exception:
+                _log(
+                    "fused epoch failed; degrading to the per-batch "
+                    "resident loop"
+                )
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                step_time = 0.0
+                num_steps = 0
                 last_progress[0] = time.monotonic()
-            return time.perf_counter() - t0_run, ds
         for epoch in range(NUM_EPOCHS):
             ds.set_epoch(epoch)
             for features, label in ds:
