@@ -413,10 +413,10 @@ def _flash_backward_pallas(
             x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
         return x
 
-    def rows_bh(x, t_pad):  # [b, h, t] -> [bh, t_pad]
+    def rows_bh(x, t_pad, fill=0.0):  # [b, h, t] -> [bh, t_pad]
         x = x.reshape(b * h, t)
         if t_pad != t:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - t)))
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t)), constant_values=fill)
         return x
 
     qb = to_bh(q, tq_pad)
@@ -425,7 +425,10 @@ def _flash_backward_pallas(
     # Native dtype: the kernels cast each dO block to f32 on load, so a
     # host-side f32 copy would only double dO's HBM traffic.
     dob = to_bh(ct, tq_pad)
-    mb = rows_bh(m, tq_pad)
+    # Padded q rows carry m = -inf so the kernels' live-row guard
+    # (m > NEG_INF/2) zeroes them directly, rather than relying on the
+    # zero-padded q/dO rows keeping exp(0)/1e-30 products finite*0.
+    mb = rows_bh(m, tq_pad, fill=NEG_INF)
     lb = rows_bh(l, tq_pad)
     big_d = jnp.einsum(
         "bqhd,bqhd->bhq",

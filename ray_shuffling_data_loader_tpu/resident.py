@@ -131,6 +131,20 @@ def packed_nbytes(num_rows: int, num_feature_columns: int) -> int:
     return (num_feature_columns + 1) * 4 * num_rows
 
 
+def _probe_device_alloc(dev, nbytes: int) -> bool:
+    """Can the device hold ``nbytes`` right now? Allocates zeros
+    ON-DEVICE (a compiled fill — no host->device transfer, so the probe
+    is cheap even over a slow tunnel) and frees them on return."""
+    try:
+        with jax.default_device(dev):
+            x = jnp.zeros((max(1, nbytes),), jnp.uint8)
+            x.block_until_ready()
+        del x
+        return True
+    except Exception:
+        return False
+
+
 def device_memory_budget(
     budget_frac: float = 0.35,
 ) -> Tuple[Optional[int], bool]:
@@ -166,12 +180,21 @@ def device_memory_budget(
         # Some TPU plugins (e.g. tunneled/experimental ones) expose no
         # memory_stats. Refusing outright would silently bench the
         # slower loader on exactly the hardware the resident mode
-        # targets; assume the v5e-class 16 GB HBM floor instead
-        # (RSDL_TPU_HBM_GB overrides). Mis-admission is survivable: the
-        # bench restarts on map/reduce if the loader dies (bench.py
-        # failover), and real OOMs surface at staging, not mid-train.
-        hbm = float(os.environ.get("RSDL_TPU_HBM_GB", "16")) * 1e9
-        return int(budget_frac * hbm), True
+        # targets; assume the v5e-class 16 GB HBM floor — then VERIFY it
+        # with a staged on-device allocation so a smaller-HBM part walks
+        # the budget down instead of OOMing mid-staging (ADVICE r3).
+        # RSDL_TPU_HBM_GB overrides and skips the probe. Mis-admission
+        # remains survivable (bench.py failover), but library callers
+        # get the probed figure.
+        env_hbm = os.environ.get("RSDL_TPU_HBM_GB")
+        if env_hbm:
+            return int(budget_frac * float(env_hbm) * 1e9), True
+        budget = int(budget_frac * 16e9)
+        for _ in range(3):
+            if _probe_device_alloc(dev, budget):
+                return budget, True
+            budget //= 2
+        return None, False
     if platform != "cpu":
         # A non-TPU accelerator that won't report its memory limit gets
         # no guess: host RAM says nothing about device memory, and an
@@ -1028,8 +1051,14 @@ def _run_gather_fused(ds, step_body, donate_state, state, epoch):
     b = ds.batch_size
     full = ds._rank_rows // b
     start0 = ds._rank_start
+    # The cache entry pins the step_body object and is verified by
+    # identity on hit: a bare id() key could silently alias a new body
+    # allocated at a recycled address after the old one was GC'd.
     key = ("fused-gather", b, id(step_body), bool(donate_state))
-    fn = ds._gather_cache.get(key)
+    hit = ds._gather_cache.get(key)
+    fn = None
+    if hit is not None and hit[0] is step_body:
+        fn = hit[1]
     if fn is None:
 
         def run_epoch(state, buf, perm):
@@ -1048,7 +1077,7 @@ def _run_gather_fused(ds, step_body, donate_state, state, epoch):
         fn = jax.jit(
             run_epoch, donate_argnums=(0,) if donate_state else ()
         )
-        ds._gather_cache[key] = fn
+        ds._gather_cache[key] = (step_body, fn)
     state, losses = fn(state, ds._buf, ds._perm(epoch))
     ds.stats.batches_staged += int(full)
     return state, losses

@@ -1,18 +1,24 @@
 #!/usr/bin/env bash
 # Watch for the axon TPU tunnel to come up; the moment it does, capture the
-# round's TPU proof artifacts automatically:
-#   1. python bench.py                       -> tools/tpu_bench.out (JSON line at tail)
-#   2. RSDL_TPU_TESTS=1 pytest TPU-gated     -> tools/tpu_tests.out
-# Probe runs jax.devices() in a subprocess with a hard timeout because a down
-# tunnel HANGS rather than erroring (see BENCHLOG.md).
+# round's TPU proof artifacts automatically — QUICK FIRST, so even a ~5-min
+# window yields an on-chip number (VERDICT r3 item 1):
+#   1. RSDL_BENCH_QUICK=1 python bench.py    -> tools/tpu_bench_quick.out
+#   2. python bench.py (full, >=10 GB)       -> tools/tpu_bench.out
+#   3. RSDL_TPU_TESTS=1 pytest TPU-gated     -> tools/tpu_tests.out
+# Each stage re-probes the tunnel before starting: if the window closed,
+# keep the artifacts already captured and go back to watching for a wider
+# one (a captured quick artifact is kept; later windows only ADD stages).
+# Probe runs jax.devices() in a subprocess with a hard timeout because a
+# down tunnel HANGS rather than erroring (see BENCHLOG.md).
 set -u
 cd /root/repo
 OUT=tools
 mkdir -p "$OUT"
 LOG="$OUT/tpu_watch.log"
 echo "[watch] started $(date -u +%FT%TZ)" >> "$LOG"
-while true; do
-  if python - <<'EOF' 2>>"$LOG"
+
+probe() {
+  python - <<'EOF' 2>>"$LOG"
 import subprocess, sys
 code = "import jax; ds=jax.devices(); print('PLATFORM='+ds[0].platform)"
 try:
@@ -23,19 +29,50 @@ except subprocess.TimeoutExpired:
 ok = p.returncode == 0 and "PLATFORM=tpu" in p.stdout
 sys.exit(0 if ok else 1)
 EOF
-  then
+}
+
+# A stage is done when its marker file exists AND records success: the
+# JSON line must say backend tpu (a CPU-failover line means the window
+# closed mid-stage) and carry no "error" key (the stall-watchdog and
+# last-resort error JSONs also say backend tpu but report value 0.0 —
+# treating those as captured would permanently skip the retry).
+bench_ok() {
+  grep -q '"backend": "tpu"' "$1" 2>/dev/null \
+    && ! grep -q '"error"' "$1" 2>/dev/null
+}
+tests_ok() {
+  grep -q 'passed' "$1" 2>/dev/null \
+    && ! grep -qE 'failed|error' "$1" 2>/dev/null
+}
+
+while true; do
+  if probe; then
     echo "[watch] TUNNEL UP $(date -u +%FT%TZ) — capturing" >> "$LOG"
-    # Bench first (the scarce artifact), then the gated tests.
-    timeout 3600 python bench.py > "$OUT/tpu_bench.out" 2>&1
-    echo "[watch] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    RSDL_TPU_TESTS=1 timeout 2400 python -m pytest -q \
-      tests/test_ops_tpu.py tests/test_resident_tpu.py \
-      > "$OUT/tpu_tests.out" 2>&1
-    echo "[watch] tests rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    touch "$OUT/TPU_CAPTURED"
-    echo "[watch] capture complete — exiting" >> "$LOG"
-    exit 0
+    if ! bench_ok "$OUT/tpu_bench_quick.out"; then
+      RSDL_BENCH_QUICK=1 RSDL_BENCH_INIT_ATTEMPTS=1 \
+        timeout 1200 python bench.py > "$OUT/tpu_bench_quick.out" 2> "$OUT/tpu_bench_quick.err"
+      echo "[watch] quick bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      bench_ok "$OUT/tpu_bench_quick.out" && touch "$OUT/TPU_CAPTURED"
+    fi
+    if probe && ! bench_ok "$OUT/tpu_bench.out"; then
+      timeout 3600 python bench.py > "$OUT/tpu_bench.out" 2> "$OUT/tpu_bench.err"
+      echo "[watch] full bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      bench_ok "$OUT/tpu_bench.out" && touch "$OUT/TPU_CAPTURED"
+    fi
+    if probe && ! tests_ok "$OUT/tpu_tests.out"; then
+      RSDL_TPU_TESTS=1 timeout 2400 python -m pytest -q \
+        tests/test_ops_tpu.py tests/test_resident_tpu.py \
+        > "$OUT/tpu_tests.out" 2>&1
+      echo "[watch] tests rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    fi
+    if bench_ok "$OUT/tpu_bench_quick.out" && bench_ok "$OUT/tpu_bench.out" \
+        && tests_ok "$OUT/tpu_tests.out"; then
+      echo "[watch] all captures complete — exiting" >> "$LOG"
+      exit 0
+    fi
+    echo "[watch] window closed with stages pending — rewatching" >> "$LOG"
+  else
+    echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   fi
-  echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   sleep 180
 done
