@@ -683,17 +683,33 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         run_ds["ds"] = ds
         step_time = 0.0
         num_steps = 0
+        # Fusion gate (r4 root-cause of the r3 "8-device compile wedge"):
+        # compile is fine multi-device (~70 s at full DLRM scale); the
+        # wedge was at RUN time — XLA-CPU executes virtual devices as
+        # threads on shared cores, and the fused program's per-step
+        # collectives starve the collective rendezvous ("Expected 8
+        # threads to join..." stalls, observed r4) while 8x-replicated
+        # big-model compute serializes onto one core. Both are virtual-
+        # mesh artifacts, so fusion engages on any REAL accelerator
+        # topology and on single-device CPU, and is declined only on
+        # multi-device CPU meshes — with the reason logged.
         fused = (
             resident_now
             and mock_step_s is None
-            # Single-device meshes only: scanning the full DLRM step over
-            # a sharded epoch buffer is exactly what the single-chip
-            # round-end bench runs; on multi-device CPU meshes the same
-            # program's compile blows up (observed wedge at 8 virtual
-            # devices), and pods have their own delivery semantics.
-            and jax.device_count() == 1
+            and (jax.device_count() == 1 or platform != "cpu")
             and os.environ.get("RSDL_BENCH_FUSED", "on") != "off"
         )
+        if (
+            resident_now
+            and mock_step_s is None
+            and not fused
+            and os.environ.get("RSDL_BENCH_FUSED", "on") != "off"
+        ):
+            _log(
+                "epoch fusion declined: multi-device CPU mesh (virtual "
+                "devices share host cores; XLA-CPU collective rendezvous "
+                "starves under load — see resident.make_fused_epoch)"
+            )
         if fused:
             # Epoch fusion: the dataset is HBM-resident, so the entire
             # epoch (batch slice + unpack + train step) runs as ONE

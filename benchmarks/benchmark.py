@@ -154,10 +154,29 @@ def run_trial(
             name=f"stats-trial-{trial}",
         )
         collector.wait_ready()
-    consumers = [
-        runtime.spawn_actor(Consumer, rank, name=f"consumer-{trial}-{rank}")
-        for rank in range(args.num_trainers)
-    ]
+    # Cluster mode: spread consumers round-robin over the hosts — the
+    # reference's SPREAD placement group for its Consumer actors
+    # (``benchmarks/benchmark.py:125-130``). Single-host (empty list)
+    # spawns locally as before; a host whose agent cannot import this
+    # module (bare `runtime.cluster join` from another cwd) degrades to
+    # a local spawn rather than sinking the trial.
+    hosts = runtime.cluster_hosts()
+
+    def _spawn_consumer(rank: int):
+        name = f"consumer-{trial}-{rank}"
+        target = hosts[rank % len(hosts)] if hosts else None
+        try:
+            return runtime.spawn_actor(
+                Consumer, rank, name=name, host_id=target
+            )
+        except Exception:
+            if target is None or target == hosts[0]:
+                raise
+            print(f"[bench] consumer {rank}: spawn on {target} failed; "
+                  "falling back to a local spawn", flush=True)
+            return runtime.spawn_actor(Consumer, rank, name=name)
+
+    consumers = [_spawn_consumer(rank) for rank in range(args.num_trainers)]
     for c in consumers:
         c.wait_ready()
     batch_consumer = ActorBatchConsumer(

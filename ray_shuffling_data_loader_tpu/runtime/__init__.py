@@ -352,11 +352,49 @@ def submit(fn: Callable, *args, **kwargs) -> TaskFuture:
     return get_context().scheduler.submit(fn, *args, **kwargs)
 
 
-def spawn_actor(cls, *args, name: Optional[str] = None, **kwargs) -> ActorHandle:
+def spawn_actor(
+    cls,
+    *args,
+    name: Optional[str] = None,
+    host_id: Optional[str] = None,
+    **kwargs,
+) -> ActorHandle:
     """Spawn an actor process; named actors are discoverable session-wide
     (and, in cluster mode, cluster-wide: the actor binds TCP and registers
-    with the head's registry)."""
+    with the head's registry).
+
+    ``host_id`` (cluster mode) is the placement hint: the actor is spawned
+    by THAT host's agent and runs there — the analog of the reference's
+    SPREAD placement groups / per-actor resource reservations
+    (``benchmarks/benchmark.py:125-130``, ``batch_queue.py:46-65``
+    ``actor_options``). Use :func:`cluster_hosts` to enumerate candidate
+    ids; the actor is reaped with that host's agent (and terminated on
+    this session's shutdown like any locally-owned actor)."""
     ctx = get_context()
+    if host_id is not None:
+        if ctx.cluster is None:
+            raise ValueError("host_id placement requires cluster mode")
+        if host_id != ctx.cluster.host_id:
+            hosts = ctx.cluster.registry.call("hosts")
+            info = hosts.get(host_id)
+            if info is None:
+                raise ValueError(
+                    f"unknown host_id {host_id!r}; "
+                    f"cluster hosts: {sorted(hosts)}"
+                )
+            agent = ActorHandle(tuple(info["agent"]))
+            address, _pid = agent.call(
+                "spawn_named_actor", cls, list(args), kwargs, name
+            )
+            # pid deliberately omitted: it belongs to the REMOTE host;
+            # terminate() must only use the TCP path, never signal a
+            # same-numbered local process.
+            handle = ActorHandle(tuple(address), pid=None, name=name)
+            ctx._owned_actors.append(handle)
+            if name is not None:
+                ctx.cluster.register_named_actor(name, handle)
+                ctx._owned_names.append(name)
+            return handle
     if ctx.cluster is not None:
         kwargs.setdefault("host", ctx.cluster.advertise_host)
     handle = _spawn_actor(
@@ -367,6 +405,18 @@ def spawn_actor(cls, *args, name: Optional[str] = None, **kwargs) -> ActorHandle
         ctx.cluster.register_named_actor(name, handle)
         ctx._owned_names.append(name)
     return handle
+
+
+def cluster_hosts() -> list:
+    """Sorted host ids currently registered in the cluster (the calling
+    host first); empty outside cluster mode. The enumeration side of
+    actor placement (``spawn_actor(host_id=...)``)."""
+    ctx = get_context()
+    if ctx.cluster is None:
+        return []
+    hosts = ctx.cluster.registry.call("hosts")
+    own = ctx.cluster.host_id
+    return sorted(hosts, key=lambda h: (h != own, h))
 
 
 def connect_actor(name: str, num_retries: int = 5) -> ActorHandle:

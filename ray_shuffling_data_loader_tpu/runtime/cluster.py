@@ -221,15 +221,22 @@ class HostAgent:
     awaits its pool future in a thread.
     """
 
-    def __init__(self, runtime_dir: str, num_workers: int):
+    def __init__(
+        self,
+        runtime_dir: str,
+        num_workers: int,
+        advertise_host: Optional[str] = None,
+    ):
         # Tasks must join THIS host's session (store segments live here).
         os.environ["RSDL_RUNTIME_DIR"] = runtime_dir
         self._runtime_dir = runtime_dir
         self._num_workers = num_workers
+        self._advertise_host = advertise_host
         self._pool = None
         self._lock = threading.Lock()
         self._submitted = 0
         self._completed = 0
+        self._spawned: List[ActorHandle] = []
 
     def _get_pool(self):
         from .tasks import WorkerPool
@@ -257,16 +264,63 @@ class HostAgent:
     def num_workers(self) -> int:
         return self._num_workers
 
+    def spawn_named_actor(self, cls, args, kwargs, name=None):
+        """Spawn an actor ON THIS HOST on behalf of a remote caller — the
+        placement primitive behind ``runtime.spawn_actor(host_id=...)``
+        (the reference expresses the same intent with SPREAD placement
+        groups + per-actor resource reservations,
+        ``benchmarks/benchmark.py:125-130``, ``batch_queue.py:46-65``).
+
+        Returns ``(address, pid)``; the caller builds its own handle and
+        registers any name with the head registry. The agent keeps the
+        handle and reaps the actor in ``teardown`` — the caller's
+        ``terminate`` only reaches the actor's TCP socket, not its pid.
+        """
+        from .actor import spawn_actor as _spawn
+
+        handle = _spawn(
+            cls,
+            *args,
+            runtime_dir=self._runtime_dir,
+            host=self._advertise_host,
+            **kwargs,
+        )
+        if name is not None:
+            handle.name = name
+        with self._lock:
+            self._spawned.append(handle)
+        return list(handle.address), handle.pid
+
     def agent_stats(self) -> Dict[str, int]:
         return {"submitted": self._submitted, "completed": self._completed}
 
     def teardown(self) -> None:
-        """Reap the worker pool before the actor process exits (called by
-        the actor host on graceful termination)."""
+        """Reap the worker pool (and any placement-spawned actors) before
+        the actor process exits (called by the actor host on graceful
+        termination)."""
         with self._lock:
             pool, self._pool = self._pool, None
+            spawned, self._spawned = self._spawned, []
+        for handle in spawned:
+            try:
+                handle.terminate(grace_period_s=2.0)
+            except Exception:
+                pass
         if pool is not None:
             pool.shutdown()
+
+
+class PlacementProbe:
+    """Diagnostic actor: reports where it actually runs. Used by the
+    placement tests (``spawn_actor(host_id=...)`` must land the actor in
+    the TARGET host's session) and handy for operators verifying a
+    cluster's spread."""
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "runtime_dir": os.environ.get("RSDL_RUNTIME_DIR"),
+            "pid": os.getpid(),
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -692,9 +746,10 @@ def start_host_services(
         HostAgent,
         runtime_dir,
         num_workers,
+        advertise_host,
         runtime_dir=runtime_dir,
         host=advertise_host,
-        daemon=False,  # the agent spawns its own worker pool
+        daemon=False,  # the agent spawns its own worker pool (and actors)
     )
     store_server = spawn_actor(
         StoreServer,

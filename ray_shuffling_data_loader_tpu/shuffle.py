@@ -631,40 +631,37 @@ def _gather_bw_for(cache_bytes: float) -> float:
     )
 
 
-def _probed_row_bytes(filename: str, narrow_to_32: bool) -> float:
-    """Decoded bytes/row of one file, measured from a <=65k-row sample
-    (first batches of the first row group — bounded decode, ~100 ms).
-    Narrowing applies :func:`narrowed_dtype` per column. Cached per
-    (file, narrowing). Raises OSError on any read/decode failure so
-    callers keep their existing "unknown: decline" contract."""
-    key = ("rowbytes", filename, narrow_to_32)
-    with _PROBE_LOCK:
-        if key in _PROBE_CACHE:
-            return _PROBE_CACHE[key]
-    try:
-        import pyarrow.parquet as pq
+def _dataset_stats_task(
+    filenames: List[str], narrow_to_32: bool
+) -> Tuple[float, int]:
+    """Runs IN A POOL WORKER: ``(decoded_bytes_per_row, total_rows)``
+    for a dataset — bytes/row from a <=65k-row decoded sample of the
+    first file (the schema is uniform across a dataset; narrowing
+    applies :func:`narrowed_dtype` per column), total rows from every
+    file's footer. Worker placement is deliberate: pyarrow opens on the
+    shuffle DRIVER thread segfaulted (pyarrow 25, observed r4 in-process
+    after unrelated earlier runs), while worker processes decode Parquet
+    all day — this rides the battle-tested path."""
+    import pyarrow.parquet as pq
 
-        pf = pq.ParquetFile(filename)
-        sample_rows = 0
-        sample_bytes = 0.0
-        for batch in pf.iter_batches(batch_size=1 << 16):
-            for col in batch.schema:
-                dt = np.dtype(col.type.to_pandas_dtype())
-                if narrow_to_32:
-                    dt = narrowed_dtype(dt)
-                sample_bytes += dt.itemsize * batch.num_rows
-            sample_rows += batch.num_rows
-            break  # one bounded sample batch is enough: fixed-width schema
-        if sample_rows == 0:
-            raise OSError(f"empty sample from {filename}")
-        per_row = sample_bytes / sample_rows
-    except OSError:
-        raise
-    except Exception as exc:  # pyarrow raises its own hierarchy
-        raise OSError(f"decode probe failed for {filename}: {exc}") from exc
-    with _PROBE_LOCK:
-        _PROBE_CACHE[key] = per_row
-    return per_row
+    pf = pq.ParquetFile(filenames[0])
+    per_row = 0.0
+    for batch in pf.iter_batches(batch_size=1 << 16):
+        if batch.num_rows == 0:
+            continue
+        for col in batch.schema:
+            dt = np.dtype(col.type.to_pandas_dtype())
+            if narrow_to_32:
+                dt = narrowed_dtype(dt)
+            per_row += float(dt.itemsize)
+        break  # one bounded sample batch: fixed-width schema
+    if per_row == 0.0:
+        raise OSError(f"empty sample from {filenames[0]}")
+    total_rows = pf.metadata.num_rows
+    total_rows += sum(
+        pq.ParquetFile(f).metadata.num_rows for f in filenames[1:]
+    )
+    return per_row, int(total_rows)
 
 
 def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
@@ -683,12 +680,9 @@ def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
         if key in _PROBE_CACHE:
             return _PROBE_CACHE[key]
     try:
-        import pyarrow.parquet as pq
-
-        per_row = _probed_row_bytes(filenames[0], narrow_to_32)
-        total_rows = sum(
-            pq.ParquetFile(f).metadata.num_rows for f in filenames
-        )
+        per_row, total_rows = runtime.get_context().scheduler.submit(
+            _dataset_stats_task, list(filenames), narrow_to_32
+        ).result()
         est = per_row * total_rows * 1.15
     except Exception:
         # Any probe/footer failure falls back to the round-3 fitted

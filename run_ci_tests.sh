@@ -1,6 +1,19 @@
 #!/usr/bin/env bash
-# CI test entry (reference run_ci_tests.sh:8-11 wraps pytest likewise).
+# CI test entry (reference run_ci_tests.sh:8-11 wraps pytest likewise),
+# two-tiered (VERDICT r3 item 7):
+#   fast tier — in-process tests, fail-fast (-x), target <8 min;
+#   slow tier — multi-process/subprocess tests (@pytest.mark.slow), run
+#   WITHOUT -x so one flaky subprocess test cannot kill the whole lane.
 # Tests force the CPU backend with 8 virtual devices via tests/conftest.py.
+# RSDL_CI_TIER=fast|slow runs a single tier (CI matrix lanes); default both.
 set -euo pipefail
 cd "$(dirname "$0")"
-python -m pytest tests/ -v --durations=10 -x
+tier="${RSDL_CI_TIER:-all}"
+rc=0
+if [ "$tier" != "slow" ]; then
+  python -m pytest tests/ -m "not slow" -v --durations=10 -x
+fi
+if [ "$tier" != "fast" ]; then
+  python -m pytest tests/ -m slow -v --durations=10 || rc=$?
+fi
+exit $rc

@@ -12,6 +12,10 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = r"""
@@ -41,7 +45,10 @@ from ray_shuffling_data_loader_tpu.resident import (
 
 rank = int(os.environ["RSDL_T_RANK"])
 rdv = os.environ["RSDL_T_RDV"]
-NUM_ROWS, BATCH = 8000, 1000
+# Overridable so tools/measure_pod_gather.py can reuse this harness at
+# measurement scale.
+NUM_ROWS = int(os.environ.get("RSDL_T_ROWS", "8000"))
+BATCH = int(os.environ.get("RSDL_T_BATCH", "1000"))
 
 # Each process runs its own runtime session: staging is process-local by
 # design (each host decodes the files overlapping its row range).
@@ -121,6 +128,7 @@ mean_fn = jax.jit(lambda label: jnp.mean(label))
 out = {"epochs": [], "gather_epochs": []}
 for epoch in range(2):
     ds.set_epoch(epoch)
+    t0 = time.perf_counter()
     local_keys = []
     for features, label in ds:
         key_arr = features["key"]
@@ -128,13 +136,22 @@ for epoch in range(2):
         m = float(mean_fn(label))  # collective across the pod
         assert np.isfinite(m)
         local_keys.extend(shard_keys(key_arr))
+    out.setdefault("mat_epoch_s", []).append(time.perf_counter() - t0)
     out["epochs"].append(local_keys)
 
 ds_gather.set_epoch(0)
+t0 = time.perf_counter()
 gather_keys = []
-for features, _ in ds_gather:
+for features, label in ds_gather:
+    jax.block_until_ready(label)
     gather_keys.extend(shard_keys(features["key"]))
+out["gather_epoch_s"] = time.perf_counter() - t0
 out["gather_epochs"].append(gather_keys)
+
+# Staging-stat sanity (VERDICT r3 item 5): the pod resident loader must
+# report its staging through the same instrumentation the bench reads.
+out["stats"] = ds.stats.as_dict()
+out["gather_stats"] = ds_gather.stats.as_dict()
 
 with open(f"{rdv}/keys_{rank}.tmp", "w") as f:
     json.dump(out, f)
@@ -165,6 +182,10 @@ def test_two_process_resident_shuffle(tmp_path):
             RSDL_T_COORD=coord,
             RSDL_T_RANK=str(rank),
             RSDL_T_RDV=str(tmp_path),
+            # Pin the workload: the worker reads these (measurement-tool
+            # knobs) from the env, and the assertions below are exact.
+            RSDL_T_ROWS="8000",
+            RSDL_T_BATCH="1000",
         )
         log = tmp_path / f"rank{rank}.log"
         logs.append(log)
@@ -212,3 +233,18 @@ def test_two_process_resident_shuffle(tmp_path):
         assert (
             results[rank]["gather_epochs"][0] == results[rank]["epochs"][0]
         )
+    # Staging-stat sanity: every process staged its addressable share
+    # (2 feature cols + label + key padding aside, > 0 bytes / batches),
+    # the one-time staging pass is timed, and the per-batch gather
+    # schedule reports its delivery through the same counters.
+    expected_batches = 2 * (8000 // 1000)  # 2 epochs x 8 full batches
+    for rank in range(2):
+        st = results[rank]["stats"]
+        assert st["bytes_staged"] > 0, st
+        assert st["batches_staged"] == expected_batches, st
+        assert st["first_batch_s"] and st["first_batch_s"] > 0, st
+        gst = results[rank]["gather_stats"]
+        assert gst["bytes_staged"] > 0, gst
+        assert gst["batches_staged"] == 8000 // 1000, gst
+        assert results[rank]["gather_epoch_s"] > 0
+        assert len(results[rank]["mat_epoch_s"]) == 2

@@ -1,0 +1,90 @@
+"""Measure the resident pod path's delivery costs (VERDICT r3 item 5):
+materialized one-gather epochs vs the per-batch-gather schedule, on the
+same 2-process / 8-virtual-device harness the pod test drives.
+
+Reuses ``tests/test_resident_pod.py``'s worker verbatim (``RSDL_T_ROWS``
+/ ``RSDL_T_BATCH`` scale it up) so the measured path is exactly the
+tested path. Prints one JSON line; append the numbers to BENCHLOG.md.
+
+Run:  python tools/measure_pod_gather.py [num_rows] [batch]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from tests.test_resident_pod import _WORKER, _free_port  # noqa: E402
+
+
+def main() -> None:
+    num_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    rdv = tempfile.mkdtemp(prefix="rsdl-podmeasure-")
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            RSDL_T_REPO=_REPO,
+            RSDL_T_COORD=coord,
+            RSDL_T_RANK=str(rank),
+            RSDL_T_RDV=rdv,
+            RSDL_T_ROWS=str(num_rows),
+            RSDL_T_BATCH=str(batch),
+        )
+        log = open(os.path.join(rdv, f"rank{rank}.log"), "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable, "-u", "-c", _WORKER],
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                ),
+                log,
+            )
+        )
+    try:
+        for proc, _ in procs:
+            proc.wait(timeout=1800)
+    finally:
+        for proc, log in procs:
+            proc.kill()
+            proc.wait()
+            log.close()
+    for rank in range(2):
+        with open(os.path.join(rdv, f"rank{rank}.log")) as f:
+            tail = f.read()
+        if f"RESPOD_RANK_DONE {rank}" not in tail:
+            print(json.dumps({"error": f"rank {rank} failed",
+                              "log_tail": tail[-2000:]}))
+            return
+    r0 = json.load(open(os.path.join(rdv, "keys_0")))
+    row_bytes = 4 * 3  # 2 feature cols + label, packed int32
+    epoch_gb = num_rows * row_bytes / 1e9
+    mat_steady = r0["mat_epoch_s"][1]
+    result = {
+        "num_rows": num_rows,
+        "batch": batch,
+        "epoch_gb": round(epoch_gb, 4),
+        "staging_s": round(r0["stats"]["first_batch_s"], 3),
+        "mat_epoch_s": [round(s, 3) for s in r0["mat_epoch_s"]],
+        "gather_epoch_s": round(r0["gather_epoch_s"], 3),
+        "gather_vs_mat_steady": round(
+            r0["gather_epoch_s"] / max(1e-9, mat_steady), 2
+        ),
+        "mat_stats": r0["stats"],
+        "gather_stats": r0["gather_stats"],
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

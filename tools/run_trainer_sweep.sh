@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Multi-trainer benchmark sweep (VERDICT r3 item 2): the reference's
+# official workload shape at {4,8,16} trainers x {2,4} reducers/trainer
+# (reference benchmarks/benchmark_batch.sh:9-24), on a >=5 GB DATA_SPEC
+# dataset. One trial x 10 epochs per config, results + CSVs under
+# tools/sweep_results/; the JSON summary line of each config is saved as
+# <tag>.json for the BENCHLOG table.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=tools/sweep_results
+mkdir -p "$OUT"
+ROWS=${RSDL_SWEEP_ROWS:-29761904}     # ~5 GB at 168 B/row
+FILES=${RSDL_SWEEP_FILES:-25}         # reference's smallest official file count
+EPOCHS=${RSDL_SWEEP_EPOCHS:-10}
+DATA_DIR=${RSDL_SWEEP_DATA:-.bench_cache/sweep5g}
+GEN_ARGS=""
+if ls "$DATA_DIR"/*.parquet.snappy >/dev/null 2>&1; then
+  GEN_ARGS="--use-old-data"
+fi
+for T in 4 8 16; do
+  for RPT in 2 4; do
+    R=$((T * RPT))
+    TAG="t${T}_r${R}"
+    if [ -s "$OUT/$TAG.json" ]; then
+      echo "[sweep] $TAG already recorded; skipping"
+      continue
+    fi
+    echo "[sweep] trainers=$T reducers=$R ($(date -u +%FT%TZ))"
+    python benchmarks/benchmark.py \
+      --num-rows "$ROWS" --num-files "$FILES" \
+      --num-row-groups-per-file 5 --batch-size 250000 \
+      --num-epochs "$EPOCHS" --num-trials 1 \
+      --num-trainers "$T" --num-reducers "$R" \
+      --max-concurrent-epochs 2 \
+      --data-dir "$DATA_DIR" $GEN_ARGS \
+      --stats-dir "$OUT/stats_$TAG" \
+      > "$OUT/$TAG.log" 2>&1 || {
+        echo "[sweep] $TAG FAILED (see $OUT/$TAG.log)"; continue; }
+    GEN_ARGS="--use-old-data"
+    grep -E '^\{' "$OUT/$TAG.log" | tail -1 > "$OUT/$TAG.json"
+    echo "[sweep] $TAG done: $(cat "$OUT/$TAG.json")"
+  done
+done
+echo "[sweep] complete"
