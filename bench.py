@@ -105,14 +105,22 @@ def _error_result(platform, msg: str) -> dict:
 
 
 def _probe_backend_once(timeout_s: float):
-    """Try ``jax.devices()`` in a THROWAWAY subprocess.
+    """Try ``jax.devices()`` PLUS a bulk-transfer round-trip in a
+    THROWAWAY subprocess.
 
     The axon plugin can hang (not fail) for minutes; probing in-process
-    would wedge the bench with no recourse. Returns
-    ``(platform, num_devices, None)`` or ``(None, 0, error_string)``.
+    would wedge the bench with no recourse. The probe moves 64 MB H2D and
+    reads a scalar back because the control plane can be live while the
+    bulk path is dead (observed 2026-07-31: ``jax.devices()`` returned in
+    3 s, then a 256 MB ``device_put`` hung forever with ~0 B/s on the
+    wire). Returns ``(platform, num_devices, None)`` or
+    ``(None, 0, error_string)``.
     """
     code = (
-        "import jax; d = jax.devices(); "
+        "import jax, numpy as np; d = jax.devices(); "
+        "a = np.ones((64, 1024, 1024), np.uint8); "
+        "x = jax.block_until_ready(jax.device_put(a)); "
+        "assert int(jax.numpy.max(x)) == 1; "
         "print('RSDL_PROBE', d[0].platform, len(d))"
     )
     try:
@@ -229,20 +237,56 @@ def _get_data(num_rows: int):
     return list(filenames), num_bytes
 
 
-def _measure_peak_h2d_gbps() -> float:
-    """Peak blocking host->HBM bandwidth via a large pinned-size device_put."""
+def _measure_peak_h2d_gbps(platform: str, budget_s: float = 300.0) -> float:
+    """Peak blocking host->HBM bandwidth via a large pinned-size device_put.
+
+    Runs on a watchdog thread: the tunnel can die BETWEEN the init_backend
+    probe and this first in-process transfer (observed 2026-07-31 — probe
+    passed at 03:48:54, this device_put then hung >15 min with zero bytes
+    on the wire). A hung transfer here would otherwise burn the entire
+    capture window before the mid-run stall watchdog is even armed, so on
+    timeout we emit the error-JSON contract and exit: the watch loop reads
+    an error JSON as "not captured" and retries on the next window.
+    """
     import jax
     import numpy as np
 
-    arr = np.ones((256, 1024, 1024), dtype=np.uint8)  # 256 MB
-    jax.block_until_ready(jax.device_put(arr))  # warm up
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(jax.device_put(arr))
-        dt = time.perf_counter() - t0
-        best = max(best, arr.nbytes / dt)
-    return best / 1e9
+    out = []
+    err = []
+
+    def _run():
+        try:
+            arr = np.ones((256, 1024, 1024), dtype=np.uint8)  # 256 MB
+            jax.block_until_ready(jax.device_put(arr))  # warm up
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready(jax.device_put(arr))
+                dt = time.perf_counter() - t0
+                best = max(best, arr.nbytes / dt)
+            out.append(best / 1e9)
+        except Exception as exc:  # noqa: BLE001 — recorded in the artifact
+            err.append(exc)
+
+    t = threading.Thread(target=_run, name="h2d-probe", daemon=True)
+    t.start()
+    t.join(budget_s)
+    if not out:
+        # Crash vs hang matters for the artifact: a raised error names the
+        # real cause; only a still-alive thread is a tunnel wedge.
+        if err:
+            msg = f"H2D probe failed: {type(err[0]).__name__}: {err[0]}"
+        elif t.is_alive():
+            msg = (
+                f"H2D probe hung >{budget_s:.0f}s after a healthy backend "
+                "probe (tunnel died between bring-up and first transfer)"
+            )
+        else:
+            msg = "H2D probe thread exited without a result"
+        result = _error_result(platform, msg)
+        print(json.dumps(result), flush=True)
+        os._exit(0)  # same contract as the stall watchdog: JSON line is the artifact
+    return out[0]
 
 
 def _kernel_microchecks(budget_s: float = 240.0) -> dict:
@@ -426,7 +470,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     num_rows, scaled_down = _sized_workload(platform)
     filenames, dataset_bytes = _get_data(num_rows)
 
-    peak_gbps = _measure_peak_h2d_gbps()
+    peak_gbps = _measure_peak_h2d_gbps(platform)
     _log(f"peak H2D: {peak_gbps:.2f} GB/s on {platform}")
 
     # Compiled-kernel proofs, cheap and early: if the tunnel dies mid-run,

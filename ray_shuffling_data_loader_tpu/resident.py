@@ -1065,7 +1065,12 @@ def make_fused_epoch(
                 )
             xs = xs_cache.get(epoch)
             if xs is None:
-                xs_cache.clear()  # one epoch tensor resident at a time
+                # One epoch-sized device copy at a time, across BOTH
+                # caches: a prior per-batch iteration leaves its permuted
+                # epoch copy in ds._epoch_buf_cache, and keeping it
+                # alongside xs would double the stated HBM footprint.
+                xs_cache.clear()
+                ds._epoch_buf_cache.clear()
                 xs = xs_fn(ds._buf, ds._perm(epoch))
                 xs_cache[epoch] = xs
             state, losses = fused(state, xs)

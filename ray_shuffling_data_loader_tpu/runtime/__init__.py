@@ -383,8 +383,12 @@ def spawn_actor(
                     f"cluster hosts: {sorted(hosts)}"
                 )
             agent = ActorHandle(tuple(info["agent"]))
-            address, _pid = agent.call(
-                "spawn_named_actor", cls, list(args), kwargs, name
+            # Timed call: the registry keeps dead hosts until eviction, so
+            # a half-dead agent must fail (letting callers' fallback pick
+            # another host) rather than wedge the trial forever.
+            address, _pid = agent.call_with_timeout(
+                "spawn_named_actor", cls, list(args), kwargs, name,
+                timeout=60.0,
             )
             # pid deliberately omitted: it belongs to the REMOTE host;
             # terminate() must only use the TCP path, never signal a

@@ -297,6 +297,42 @@ class ActorHandle:
             self._async_clients[loop] = client
         return await client.call(method, *args, **kwargs)
 
+    def call_with_timeout(self, method: str, *args, timeout: float = 30.0,
+                          **kwargs):
+        """One-shot call on a dedicated timed connection.
+
+        The per-thread connection deliberately has no socket timeout
+        (streaming gets block indefinitely by design); control-plane calls
+        that must not wedge on a half-dead host — placement, remote spawn —
+        use this instead. Raises :class:`ActorDiedError` on timeout or
+        connection failure, so callers' existing died-actor fallbacks fire.
+        """
+        try:
+            conn = transport.Connection(self.address, timeout=timeout)
+        except (ConnectionError, FileNotFoundError, OSError) as e:
+            raise ActorDiedError(
+                f"actor {self.name or self.address} unreachable: {e}"
+            ) from e
+        try:
+            conn.send((0, method, args, kwargs, False))
+            while True:
+                resp_id, status, payload = conn.recv()
+                if resp_id == 0:
+                    break
+        except (ConnectionError, OSError) as e:
+            raise ActorDiedError(
+                f"actor {self.name or self.address} did not answer "
+                f"{method} within {timeout}s: {e}"
+            ) from e
+        finally:
+            conn.close()
+        if status == "ok":
+            return payload
+        exc, tb = payload
+        if isinstance(exc, Exception):
+            raise exc
+        raise RemoteError(f"remote call {method} failed:\n{tb}")
+
     def ping(self, timeout: float = None) -> bool:
         # A dedicated short-lived connection with a socket timeout: the
         # regular per-thread connection has no timeout, and a wedged (alive

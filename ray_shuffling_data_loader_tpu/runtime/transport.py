@@ -91,9 +91,15 @@ class Connection:
         self.address = address
         if address[0] == "unix":
             self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            # Timeout must cover connect() too: a half-dead peer (host up,
+            # process wedged) hangs the connect, not just the recv.
+            if timeout is not None:
+                self.sock.settimeout(timeout)
             self.sock.connect(address[1])
         elif address[0] == "tcp":
-            self.sock = socket.create_connection((address[1], address[2]))
+            self.sock = socket.create_connection(
+                (address[1], address[2]), timeout=timeout
+            )
             try:
                 self.sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
@@ -103,7 +109,7 @@ class Connection:
                     # Don't hang forever on a server that never challenges.
                     self.sock.settimeout(30.0)
                     _answer_challenge_sync(self.sock, token)
-                    self.sock.settimeout(None)
+                    self.sock.settimeout(timeout)
             except BaseException:
                 # Auth/handshake failed: a retry loop in the actor layer
                 # must not accumulate leaked fds until EMFILE.

@@ -18,9 +18,20 @@ LOG="$OUT/tpu_watch.log"
 echo "[watch] started $(date -u +%FT%TZ)" >> "$LOG"
 
 probe() {
+  # Control-plane AND data-plane: jax.devices() can succeed over a tunnel
+  # whose bulk-transfer path is dead (observed 2026-07-31: devices() OK at
+  # 03:48, a 256 MB device_put wedged forever at 03:49 with ~0 B/s on the
+  # wire). Round-trip 64 MB — big enough to exercise the bulk path, small
+  # enough to clear the 120 s budget on any usable link.
   python - <<'EOF' 2>>"$LOG"
 import subprocess, sys
-code = "import jax; ds=jax.devices(); print('PLATFORM='+ds[0].platform)"
+code = (
+    "import jax, numpy as np; ds = jax.devices(); "
+    "a = np.ones((64, 1024, 1024), np.uint8); "
+    "d = jax.block_until_ready(jax.device_put(a)); "
+    "assert int(jax.numpy.max(d)) == 1; "
+    "print('PLATFORM='+ds[0].platform)"
+)
 try:
     p = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120)
