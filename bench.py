@@ -272,20 +272,22 @@ def _measure_peak_h2d_gbps(platform: str, budget_s: float = 300.0) -> float:
     t.start()
     t.join(budget_s)
     if not out:
-        # Crash vs hang matters for the artifact: a raised error names the
-        # real cause; only a still-alive thread is a tunnel wedge.
+        # Crash vs hang matters: a raised error propagates to main()'s
+        # last-resort handler (same error-JSON contract, normal cleanup of
+        # the already-spawned worker pool); only a still-alive thread is a
+        # tunnel wedge, where cleanup could itself hang — that branch
+        # hard-exits after printing the artifact.
         if err:
-            msg = f"H2D probe failed: {type(err[0]).__name__}: {err[0]}"
-        elif t.is_alive():
-            msg = (
-                f"H2D probe hung >{budget_s:.0f}s after a healthy backend "
-                "probe (tunnel died between bring-up and first transfer)"
-            )
-        else:
-            msg = "H2D probe thread exited without a result"
+            raise err[0]
+        msg = (
+            f"H2D probe hung >{budget_s:.0f}s after a healthy backend "
+            "probe (tunnel died between bring-up and first transfer)"
+            if t.is_alive()
+            else "H2D probe thread exited without a result"
+        )
         result = _error_result(platform, msg)
         print(json.dumps(result), flush=True)
-        os._exit(0)  # same contract as the stall watchdog: JSON line is the artifact
+        os._exit(0)  # the JSON line IS the artifact; cleanup may wedge
     return out[0]
 
 

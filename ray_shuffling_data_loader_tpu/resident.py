@@ -382,6 +382,13 @@ class DeviceResidentShufflingDataset:
         self._skip = 0
         self._perm_cache: Dict[int, jax.Array] = {}
         self._epoch_buf_cache: Dict[int, jax.Array] = {}
+        # The multi-device fused path's (batches, cols, batch) epoch
+        # tensor cache. Owned by the dataset (not the fused closure) so
+        # the one-epoch-copy-at-a-time invariant can be enforced in BOTH
+        # directions: fused clears _epoch_buf_cache, and _epoch_buf
+        # clears this (a fused run degraded to per-batch must not keep
+        # two epoch-sized HBM copies alive).
+        self._fused_xs_cache: Dict[int, jax.Array] = {}
         self._materialize = materialize_epoch
         # Called after every staged piece: lets a long staging pass feed
         # an external liveness watchdog (the bench arms one).
@@ -836,8 +843,10 @@ class DeviceResidentShufflingDataset:
     def _epoch_buf(self, epoch: int) -> jax.Array:
         ebuf = self._epoch_buf_cache.get(epoch)
         if ebuf is None:
-            # One permuted copy lives at a time.
+            # One permuted copy lives at a time — across both caches
+            # (see _fused_xs_cache).
             self._epoch_buf_cache.clear()
+            self._fused_xs_cache.clear()
             ebuf = self._permute_all(self._buf, self._perm(epoch))
             self._epoch_buf_cache[epoch] = ebuf
         return ebuf
@@ -876,6 +885,7 @@ class DeviceResidentShufflingDataset:
         self._closed = True
         self._buf = None
         self._epoch_buf_cache.clear()
+        self._fused_xs_cache.clear()
         self._perm_cache.clear()
         self._gather_cache.clear()
         self._epoch = None
@@ -1050,7 +1060,7 @@ def make_fused_epoch(
         fused = jax.jit(
             run_epoch, donate_argnums=(0,) if donate_state else ()
         )
-        xs_cache: Dict[int, jax.Array] = {}
+        xs_cache = ds._fused_xs_cache
 
         def run(state, epoch: int):
             ds._check_open()

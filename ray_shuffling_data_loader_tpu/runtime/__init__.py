@@ -383,12 +383,23 @@ def spawn_actor(
                     f"cluster hosts: {sorted(hosts)}"
                 )
             agent = ActorHandle(tuple(info["agent"]))
-            # Timed call: the registry keeps dead hosts until eviction, so
-            # a half-dead agent must fail (letting callers' fallback pick
-            # another host) rather than wedge the trial forever.
+            # The registry keeps dead hosts until eviction, so a
+            # half-dead agent must fail (letting callers' fallback pick
+            # another host) rather than wedge the trial forever. A short
+            # ping filters the common case cheaply; the spawn itself gets
+            # a generous bound so a slow-but-healthy spawn (first-touch
+            # jax init in the actor ctor) isn't false-failed — on a true
+            # mid-spawn wedge the agent may still finish the spawn later
+            # and hold the orphan until session teardown reaps it
+            # (bounded, and preferable to an unbounded client hang).
+            if not agent.ping(timeout=5.0):
+                raise ActorDiedError(
+                    f"host {host_id!r} agent unreachable (ping timeout); "
+                    "host may be dead but not yet evicted"
+                )
             address, _pid = agent.call_with_timeout(
                 "spawn_named_actor", cls, list(args), kwargs, name,
-                timeout=60.0,
+                timeout=300.0,
             )
             # pid deliberately omitted: it belongs to the REMOTE host;
             # terminate() must only use the TCP path, never signal a

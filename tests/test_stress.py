@@ -1,0 +1,237 @@
+"""Thread-interleaving stress/soak tests.
+
+The reference leans on Ray's actor serialization for concurrency safety
+and ships no stress coverage (SURVEY §5 "race detection: none"). This
+framework runs far more concurrent machinery — queue delivery threads,
+consumer acks, epoch-window joins, replacement consumers — so these soak
+tests drive the REAL components through seeded-random interleavings and
+assert the two invariants every delivery path must keep:
+
+* exactly-once: every produced item is consumed exactly once per epoch;
+* liveness: the whole dance finishes under a deadline (no deadlock
+  between the epoch-window join, producer-done events, and acks).
+
+Randomness is seeded per test case so a failing interleaving replays.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue, Empty
+
+pytestmark = pytest.mark.slow
+
+DEADLINE_S = 120.0
+
+
+def _run_threads(threads, deadline_s=DEADLINE_S):
+    for t in threads:
+        t.start()
+    end = time.monotonic() + deadline_s
+    for t in threads:
+        t.join(max(0.1, end - time.monotonic()))
+    stuck = [t.name for t in threads if t.is_alive()]
+    assert not stuck, f"threads wedged past {deadline_s}s deadline: {stuck}"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_queue_soak_multi_rank_windowed(local_runtime, seed):
+    """4 consumer threads x 6 epochs x window 2, producer jitter vs
+    consumer jitter, batched and single puts interleaved. Exercises the
+    new_epoch window join racing producer_done events and task_done acks
+    from four client threads at once."""
+    rng = random.Random(seed)
+    num_trainers, num_epochs, window = 4, 6, 2
+    items_per_rank = 12
+    q = BatchQueue(
+        num_epochs=num_epochs,
+        num_trainers=num_trainers,
+        max_concurrent_epochs=window,
+        name=f"stress-soak-{seed}",
+    )
+    q.ready()
+    errors = []
+    got = {
+        (e, r): []
+        for e in range(num_epochs)
+        for r in range(num_trainers)
+    }
+
+    def producer():
+        try:
+            for epoch in range(num_epochs):
+                q.new_epoch(epoch)  # blocks on the window
+                for rank in range(num_trainers):
+                    items = [
+                        (epoch, rank, i) for i in range(items_per_rank)
+                    ]
+                    # Mix batched and single puts so actor-side
+                    # put_nowait_batch and awaited put interleave.
+                    split = rng.randrange(items_per_rank)
+                    q.put_batch(rank, epoch, items[:split])
+                    for it in items[split:]:
+                        q.put(rank, epoch, it)
+                    if rng.random() < 0.5:
+                        time.sleep(rng.random() * 0.02)
+                    q.producer_done(rank, epoch)
+        except Exception as exc:  # noqa: BLE001 — surfaced by the test body
+            errors.append(("producer", exc))
+
+    def consumer(rank):
+        try:
+            for epoch in range(num_epochs):
+                while True:
+                    item = q.get(rank, epoch, timeout=DEADLINE_S)
+                    if item is None:
+                        q.task_done(rank, epoch)
+                        break
+                    got[(epoch, rank)].append(item)
+                    if rng.random() < 0.3:
+                        time.sleep(rng.random() * 0.01)
+                    q.task_done(rank, epoch)
+        except Exception as exc:  # noqa: BLE001
+            errors.append((f"consumer{rank}", exc))
+
+    threads = [threading.Thread(target=producer, name="producer")] + [
+        threading.Thread(target=consumer, args=(r,), name=f"consumer{r}")
+        for r in range(num_trainers)
+    ]
+    _run_threads(threads)
+    assert not errors, errors
+    q.wait_until_all_epochs_done()
+    for epoch in range(num_epochs):
+        for rank in range(num_trainers):
+            expect = [(epoch, rank, i) for i in range(items_per_rank)]
+            assert got[(epoch, rank)] == expect, (
+                f"epoch {epoch} rank {rank}: delivery not exactly-once/FIFO"
+            )
+    q.shutdown(force=True, grace_period_s=1)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_queue_consumer_dies_replacement_drains(local_runtime, seed):
+    """A consumer stops acking mid-epoch (simulated death); the epoch
+    window must block the producer's NEXT new_epoch until a replacement
+    drains and acks the dead consumer's remaining items — then the trial
+    completes. Exercises the confirm-then-recover interleaving the
+    cluster failover path depends on."""
+    rng = random.Random(seed)
+    num_epochs = 2
+    items_per_epoch = 10
+    die_after = rng.randrange(1, items_per_epoch - 1)
+    q = BatchQueue(
+        num_epochs=num_epochs,
+        num_trainers=1,
+        max_concurrent_epochs=1,
+        name=f"stress-die-{seed}",
+    )
+    q.ready()
+    errors = []
+    admitted = threading.Event()  # epoch 1 admitted by the window
+    consumed = {0: [], 1: []}
+
+    def producer():
+        try:
+            for epoch in range(num_epochs):
+                q.new_epoch(epoch)
+                if epoch == 1:
+                    admitted.set()
+                for i in range(items_per_epoch):
+                    q.put(0, epoch, (epoch, i))
+                q.producer_done(0, epoch)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(("producer", exc))
+
+    def dying_consumer():
+        try:
+            for _ in range(die_after):
+                item = q.get(0, 0, timeout=DEADLINE_S)
+                consumed[0].append(item)
+                q.task_done(0, 0)
+            # dies here: items remain unacked in (epoch 0, rank 0)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(("dying", exc))
+
+    def replacement():
+        try:
+            # Takes over epoch 0 after the original died, then runs
+            # epoch 1 normally.
+            for epoch in range(num_epochs):
+                while True:
+                    item = q.get(0, epoch, timeout=DEADLINE_S)
+                    if item is None:
+                        q.task_done(0, epoch)
+                        break
+                    consumed[epoch].append(item)
+                    q.task_done(0, epoch)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(("replacement", exc))
+
+    prod = threading.Thread(target=producer, name="producer")
+    dyer = threading.Thread(target=dying_consumer, name="dying")
+    prod.start()
+    dyer.start()
+    dyer.join(DEADLINE_S)
+    assert not dyer.is_alive()
+    # Window must hold epoch 1 closed while epoch 0 has unacked items.
+    assert not admitted.wait(timeout=0.5), (
+        "epoch window admitted epoch 1 while epoch 0 had unacked items"
+    )
+    repl = threading.Thread(target=replacement, name="replacement")
+    repl.start()
+    _run_threads_joined = [prod, repl]
+    end = time.monotonic() + DEADLINE_S
+    for t in _run_threads_joined:
+        t.join(max(0.1, end - time.monotonic()))
+    assert not any(t.is_alive() for t in _run_threads_joined)
+    assert not errors, errors
+    assert admitted.is_set()
+    for epoch in range(num_epochs):
+        assert sorted(consumed[epoch]) == [
+            (epoch, i) for i in range(items_per_epoch)
+        ], f"epoch {epoch} not exactly-once after consumer replacement"
+    q.shutdown(force=True, grace_period_s=1)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_shuffle_delivery_soak_jittery_consumer(local_runtime, seed, tmp_path):
+    """End-to-end soak: the real shuffle engine feeding a ShufflingDataset
+    consumer whose iteration jitters (random sleeps), across 6 epochs with
+    a 2-epoch window at tiny scale. Exercises the delivery/free-input
+    threads against the window repeatedly; asserts exactly-once keys per
+    epoch."""
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    rng = random.Random(seed)
+    num_rows = 2000
+    filenames, _ = generate_data(
+        num_rows, 4, 1, 0.0, str(tmp_path / "soak-data")
+    )
+    ds = ShufflingDataset(
+        filenames,
+        num_epochs=6,
+        num_trainers=1,
+        batch_size=300,
+        rank=0,
+        num_reducers=3,
+        max_concurrent_epochs=2,
+        queue_name=f"stress-shuffle-{seed}",
+        seed=seed,
+    )
+    for epoch in range(6):
+        ds.set_epoch(epoch)
+        keys = []
+        for batch in ds:
+            keys.append(np.asarray(batch["key"]))
+            if rng.random() < 0.4:
+                time.sleep(rng.random() * 0.05)
+        keys = np.concatenate(keys)
+        assert np.array_equal(np.sort(keys), np.arange(num_rows)), (
+            f"epoch {epoch}: lost/duplicated rows under consumer jitter"
+        )

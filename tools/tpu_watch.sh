@@ -59,6 +59,21 @@ tests_ok() {
 while true; do
   if probe; then
     echo "[watch] TUNNEL UP $(date -u +%FT%TZ) — capturing" >> "$LOG"
+    # Capture lock: CPU-heavy side work (the trainer sweep) polls this and
+    # pauses while a TPU capture is running — on a 1-core host a
+    # concurrent sweep would inflate the bench's stall% measurement. The
+    # lock carries this watcher's PID so a SIGKILL-orphaned lock can be
+    # detected as stale (EXIT trap covers TERM/INT, not KILL).
+    echo $$ > "$OUT/CAPTURE_IN_PROGRESS"
+    trap 'rm -f "$OUT/CAPTURE_IN_PROGRESS"' EXIT
+    # Preempt an IN-FLIGHT sweep trial (the between-trial check can't see
+    # a window that opens mid-trial): the TPU number outranks one sweep
+    # config, and the killed config is left unrecorded so a later sweep
+    # run retries it. Pool workers self-destruct when their parent dies.
+    if pkill -f "benchmarks/benchmark.py" 2>/dev/null; then
+      echo "[watch] preempted in-flight sweep trial" >> "$LOG"
+      sleep 5
+    fi
     if ! bench_ok "$OUT/tpu_bench_quick.out"; then
       RSDL_BENCH_QUICK=1 RSDL_BENCH_INIT_ATTEMPTS=1 \
         timeout 1200 python bench.py > "$OUT/tpu_bench_quick.out" 2> "$OUT/tpu_bench_quick.err"
@@ -82,6 +97,7 @@ while true; do
       exit 0
     fi
     echo "[watch] window closed with stages pending — rewatching" >> "$LOG"
+    rm -f "$OUT/CAPTURE_IN_PROGRESS"
   else
     echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   fi
