@@ -386,20 +386,30 @@ def spawn_actor(
             # The registry keeps dead hosts until eviction, so a
             # half-dead agent must fail (letting callers' fallback pick
             # another host) rather than wedge the trial forever. A short
-            # ping filters the common case cheaply; the spawn itself gets
-            # a generous bound so a slow-but-healthy spawn (first-touch
-            # jax init in the actor ctor) isn't false-failed — on a true
-            # mid-spawn wedge the agent may still finish the spawn later
-            # and hold the orphan until session teardown reaps it
-            # (bounded, and preferable to an unbounded client hang).
+            # ping filters the common case cheaply — valid even while the
+            # agent is mid-spawn, because spawn_named_actor is async and
+            # its blocking bring-up runs off the event loop. The spawn
+            # itself gets a generous bound so a slow-but-healthy spawn
+            # (first-touch jax init in the actor ctor) isn't false-failed
+            # — on a true mid-spawn wedge the agent may still finish the
+            # spawn later and hold the orphan until session teardown
+            # reaps it (bounded, and preferable to an unbounded hang).
             if not agent.ping(timeout=5.0):
                 raise ActorDiedError(
                     f"host {host_id!r} agent unreachable (ping timeout); "
                     "host may be dead but not yet evicted"
                 )
+            # The client bound tracks the agent-side readiness deadline
+            # (spawn_actor's RSDL_SPAWN_READY_TIMEOUT_S) plus slack, so
+            # the AGENT always resolves a slow spawn first — a shorter
+            # client bound would false-fail legitimate spawns and leak a
+            # duplicate actor on the remote host.
+            ready_s = float(
+                os.environ.get("RSDL_SPAWN_READY_TIMEOUT_S", "600")
+            )
             address, _pid = agent.call_with_timeout(
                 "spawn_named_actor", cls, list(args), kwargs, name,
-                timeout=300.0,
+                timeout=ready_s + 30.0,
             )
             # pid deliberately omitted: it belongs to the REMOTE host;
             # terminate() must only use the TCP path, never signal a

@@ -495,6 +495,20 @@ def spawn_actor(
         daemon=daemon,
     )
     proc.start()
+    # Readiness handshake with two escapes beyond the mp.Queue message:
+    # (a) the registry file the child atomically writes just before its
+    #     ready_q.put — observed once (2026-07-31): the child was up and
+    #     serving while the queue's feeder thread wedged on a futex, so
+    #     the message never arrived and the old loop polled forever;
+    # (b) an overall deadline (generous: the actor ctor runs before
+    #     readiness and a first-touch jax init can legitimately take
+    #     minutes) that kills the child and fails cleanly instead of
+    #     wedging the spawner.
+    ready_timeout = float(
+        os.environ.get("RSDL_SPAWN_READY_TIMEOUT_S", "600")
+    )
+    deadline = time.monotonic() + ready_timeout
+    status = payload = None
     while True:
         try:
             status, payload = ready_q.get(timeout=0.2)
@@ -505,6 +519,22 @@ def spawn_actor(
                     f"actor {cls.__name__} process exited during startup "
                     f"(exitcode={proc.exitcode})"
                 ) from None
+            if registry_path is not None and os.path.exists(registry_path):
+                try:
+                    with open(registry_path) as f:
+                        record = json.load(f)
+                    status, payload = "ok", record["address"]
+                    break
+                except (json.JSONDecodeError, KeyError, OSError):
+                    pass  # mid-replace; next poll sees it whole
+            if time.monotonic() > deadline:
+                proc.terminate()
+                proc.join(5)
+                raise RuntimeError(
+                    f"actor {cls.__name__} did not announce readiness "
+                    f"within {ready_timeout:.0f}s (child alive; ready-"
+                    "queue handshake lost?)"
+                )
     if status != "ok":
         raise RuntimeError(f"actor {cls.__name__} failed to start:\n{payload}")
     handle = ActorHandle(tuple(payload), pid=proc.pid, name=name)

@@ -264,27 +264,37 @@ class HostAgent:
     def num_workers(self) -> int:
         return self._num_workers
 
-    def spawn_named_actor(self, cls, args, kwargs, name=None):
+    async def spawn_named_actor(self, cls, args, kwargs, name=None):
         """Spawn an actor ON THIS HOST on behalf of a remote caller — the
         placement primitive behind ``runtime.spawn_actor(host_id=...)``
         (the reference expresses the same intent with SPREAD placement
         groups + per-actor resource reservations,
         ``benchmarks/benchmark.py:125-130``, ``batch_queue.py:46-65``).
 
+        Async on purpose: the child bring-up blocks until the actor's
+        ctor finishes (possibly minutes of first-touch jax init), and a
+        sync method would block the agent's event loop for that whole
+        time — no pings answered, so placement health checks would
+        falsely declare this host dead and concurrent spawns would
+        serialize. The blocking wait runs in a thread executor instead.
+
         Returns ``(address, pid)``; the caller builds its own handle and
         registers any name with the head registry. The agent keeps the
         handle and reaps the actor in ``teardown`` — the caller's
         ``terminate`` only reaches the actor's TCP socket, not its pid.
         """
-        from .actor import spawn_actor as _spawn
+        import asyncio
 
-        handle = _spawn(
-            cls,
-            *args,
-            runtime_dir=self._runtime_dir,
-            host=self._advertise_host,
-            **kwargs,
-        )
+        def _do():
+            return spawn_actor(
+                cls,
+                *args,
+                runtime_dir=self._runtime_dir,
+                host=self._advertise_host,
+                **kwargs,
+            )
+
+        handle = await asyncio.get_running_loop().run_in_executor(None, _do)
         if name is not None:
             handle.name = name
         with self._lock:

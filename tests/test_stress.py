@@ -20,16 +20,14 @@ import time
 
 import pytest
 
-from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue, Empty
+from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue
 
 pytestmark = pytest.mark.slow
 
 DEADLINE_S = 120.0
 
 
-def _run_threads(threads, deadline_s=DEADLINE_S):
-    for t in threads:
-        t.start()
+def _join_threads(threads, deadline_s=DEADLINE_S):
     end = time.monotonic() + deadline_s
     for t in threads:
         t.join(max(0.1, end - time.monotonic()))
@@ -37,13 +35,23 @@ def _run_threads(threads, deadline_s=DEADLINE_S):
     assert not stuck, f"threads wedged past {deadline_s}s deadline: {stuck}"
 
 
+def _run_threads(threads, deadline_s=DEADLINE_S):
+    for t in threads:
+        t.start()
+    _join_threads(threads, deadline_s)
+
+
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_queue_soak_multi_rank_windowed(local_runtime, seed):
     """4 consumer threads x 6 epochs x window 2, producer jitter vs
     consumer jitter, batched and single puts interleaved. Exercises the
     new_epoch window join racing producer_done events and task_done acks
-    from four client threads at once."""
-    rng = random.Random(seed)
+    from four client threads at once.
+
+    Each thread draws from its OWN Random derived from (seed, role): a
+    shared instance would make per-thread draw sequences depend on OS
+    scheduling (and random.Random is not thread-safe), defeating the
+    replay-a-failing-seed design."""
     num_trainers, num_epochs, window = 4, 6, 2
     items_per_rank = 12
     q = BatchQueue(
@@ -61,6 +69,7 @@ def test_queue_soak_multi_rank_windowed(local_runtime, seed):
     }
 
     def producer():
+        rng = random.Random(f"{seed}-producer")
         try:
             for epoch in range(num_epochs):
                 q.new_epoch(epoch)  # blocks on the window
@@ -81,6 +90,7 @@ def test_queue_soak_multi_rank_windowed(local_runtime, seed):
             errors.append(("producer", exc))
 
     def consumer(rank):
+        rng = random.Random(f"{seed}-consumer-{rank}")
         try:
             for epoch in range(num_epochs):
                 while True:
@@ -95,8 +105,12 @@ def test_queue_soak_multi_rank_windowed(local_runtime, seed):
         except Exception as exc:  # noqa: BLE001
             errors.append((f"consumer{rank}", exc))
 
-    threads = [threading.Thread(target=producer, name="producer")] + [
-        threading.Thread(target=consumer, args=(r,), name=f"consumer{r}")
+    threads = [
+        threading.Thread(target=producer, name="producer", daemon=True)
+    ] + [
+        threading.Thread(
+            target=consumer, args=(r,), name=f"consumer{r}", daemon=True
+        )
         for r in range(num_trainers)
     ]
     _run_threads(threads)
@@ -170,8 +184,10 @@ def test_queue_consumer_dies_replacement_drains(local_runtime, seed):
         except Exception as exc:  # noqa: BLE001
             errors.append(("replacement", exc))
 
-    prod = threading.Thread(target=producer, name="producer")
-    dyer = threading.Thread(target=dying_consumer, name="dying")
+    # Daemon threads: a wedged thread must fail THIS test, not hang the
+    # whole pytest process at exit.
+    prod = threading.Thread(target=producer, name="producer", daemon=True)
+    dyer = threading.Thread(target=dying_consumer, name="dying", daemon=True)
     prod.start()
     dyer.start()
     dyer.join(DEADLINE_S)
@@ -180,13 +196,9 @@ def test_queue_consumer_dies_replacement_drains(local_runtime, seed):
     assert not admitted.wait(timeout=0.5), (
         "epoch window admitted epoch 1 while epoch 0 had unacked items"
     )
-    repl = threading.Thread(target=replacement, name="replacement")
+    repl = threading.Thread(target=replacement, name="replacement", daemon=True)
     repl.start()
-    _run_threads_joined = [prod, repl]
-    end = time.monotonic() + DEADLINE_S
-    for t in _run_threads_joined:
-        t.join(max(0.1, end - time.monotonic()))
-    assert not any(t.is_alive() for t in _run_threads_joined)
+    _join_threads([prod, repl])
     assert not errors, errors
     assert admitted.is_set()
     for epoch in range(num_epochs):

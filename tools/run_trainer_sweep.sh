@@ -13,9 +13,16 @@ ROWS=${RSDL_SWEEP_ROWS:-29761904}     # ~5 GB at 168 B/row
 FILES=${RSDL_SWEEP_FILES:-25}         # reference's smallest official file count
 EPOCHS=${RSDL_SWEEP_EPOCHS:-10}
 DATA_DIR=${RSDL_SWEEP_DATA:-.bench_cache/sweep5g}
+# Reuse only a COMPLETE dataset: a capture-preempted trial can die
+# mid-generation, and benchmarking a fragment while recording it as the
+# full workload would silently corrupt the rows/s comparison.
 GEN_ARGS=""
-if ls "$DATA_DIR"/*.parquet.snappy >/dev/null 2>&1; then
+nfiles=$(ls "$DATA_DIR"/*.parquet.snappy 2>/dev/null | wc -l)
+if [ "$nfiles" -ge "$FILES" ]; then
   GEN_ARGS="--use-old-data"
+elif [ "$nfiles" -gt 0 ]; then
+  echo "[sweep] partial dataset ($nfiles of >=$FILES files); regenerating"
+  rm -rf "$DATA_DIR"
 fi
 for T in 4 8 16; do
   for RPT in 2 4; do
@@ -32,7 +39,13 @@ for T in 4 8 16; do
     # PID is gone is stale (SIGKILL skips the EXIT trap) and is removed.
     while [ -e tools/CAPTURE_IN_PROGRESS ]; do
       wpid=$(cat tools/CAPTURE_IN_PROGRESS 2>/dev/null || echo "")
-      if [ -n "$wpid" ] && ! kill -0 "$wpid" 2>/dev/null; then
+      # Stale only if the watcher is gone AND no capture child survived
+      # it (a SIGKILLed watcher orphans its bench.py or TPU pytest
+      # stage, which keeps the core busy; clearing the lock then would
+      # defeat the exclusion).
+      if [ -n "$wpid" ] && ! kill -0 "$wpid" 2>/dev/null \
+          && ! pgrep -f "python bench.py" >/dev/null 2>&1 \
+          && ! pgrep -f "test_ops_tpu" >/dev/null 2>&1; then
         echo "[sweep] stale capture lock (pid $wpid gone); clearing"
         rm -f tools/CAPTURE_IN_PROGRESS
         break
@@ -47,11 +60,10 @@ for T in 4 8 16; do
       --num-epochs "$EPOCHS" --num-trials 1 \
       --num-trainers "$T" --num-reducers "$R" \
       --max-concurrent-epochs 2 \
-      --data-dir "$DATA_DIR" $GEN_ARGS \
+      --data-dir "$DATA_DIR" $(gen_args) \
       --stats-dir "$OUT/stats_$TAG" \
       > "$OUT/$TAG.log" 2>&1 || {
         echo "[sweep] $TAG FAILED (see $OUT/$TAG.log)"; continue; }
-    GEN_ARGS="--use-old-data"
     grep -E '^\{' "$OUT/$TAG.log" | tail -1 > "$OUT/$TAG.json"
     echo "[sweep] $TAG done: $(cat "$OUT/$TAG.json")"
   done

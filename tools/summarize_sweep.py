@@ -1,0 +1,62 @@
+"""Aggregate tools/sweep_results/*.json + stats CSVs into the BENCHLOG
+markdown table for the multi-trainer sweep (VERDICT r3 item 2).
+
+Run after tools/run_trainer_sweep.sh: python tools/summarize_sweep.py
+"""
+
+import csv
+import glob
+import json
+import os
+import re
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sweep_results")
+
+
+def main() -> None:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(OUT, "t*_r*.json"))):
+        tag = os.path.basename(path)[:-5]
+        m = re.match(r"t(\d+)_r(\d+)", tag)
+        if not m:
+            continue
+        trainers, reducers = int(m.group(1)), int(m.group(2))
+        with open(path) as f:
+            summary = json.loads(f.read().strip() or "{}")
+        trial_csv = os.path.join(OUT, f"stats_{tag}", "trial_stats.csv")
+        extra = {}
+        if os.path.exists(trial_csv):
+            with open(trial_csv) as f:
+                recs = list(csv.DictReader(f))
+            if recs:
+                r0 = recs[0]
+                extra = {
+                    "per_trainer": float(r0["batch_throughput_per_trainer"]),
+                    "map_avg": float(r0["avg_map_stage_duration"]),
+                    "reduce_avg": float(r0["avg_reduce_stage_duration"]),
+                    "consume_avg": float(r0["avg_consume_stage_duration"]),
+                    "store_peak_gb": float(r0["max_object_store_utilization"])
+                    / 1e9,
+                }
+        rows.append((trainers, reducers, summary, extra))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    print(
+        "| trainers | reducers | trial s | rows/s | batches/s/trainer "
+        "| map avg s | reduce avg s | consume avg s | peak store GB |"
+    )
+    print("|---|---|---|---|---|---|---|---|---|")
+    for trainers, reducers, s, e in rows:
+        print(
+            f"| {trainers} | {reducers} "
+            f"| {s.get('duration_mean', float('nan')):.0f} "
+            f"| {s.get('row_throughput_mean', float('nan')):,.0f} "
+            f"| {e.get('per_trainer', float('nan')):.3f} "
+            f"| {e.get('map_avg', float('nan')):.1f} "
+            f"| {e.get('reduce_avg', float('nan')):.1f} "
+            f"| {e.get('consume_avg', float('nan')):.1f} "
+            f"| {e.get('store_peak_gb', float('nan')):.1f} |"
+        )
+
+
+if __name__ == "__main__":
+    main()
