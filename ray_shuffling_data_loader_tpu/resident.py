@@ -85,11 +85,10 @@ def _decode_narrow_range_to_store(
     import pyarrow.parquet as pq
 
     from ray_shuffling_data_loader_tpu.shuffle import _narrow_column
-    from ray_shuffling_data_loader_tpu.utils import is_remote_path
+    from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
 
-    pf = pq.ParquetFile(
-        filename, memory_map=not is_remote_path(filename)
-    )
+    fs, rel = parquet_filesystem(filename)
+    pf = pq.ParquetFile(rel, memory_map=fs is None, filesystem=fs)
     md = pf.metadata
     sel = []
     first_row = None
@@ -121,9 +120,21 @@ def _decode_narrow_range_to_store(
 
 def dataset_num_rows(filenames: Sequence[str]) -> int:
     """Total rows across Parquet files from metadata only (no decode)."""
+    return sum(m.num_rows for m in _file_metadata(filenames))
+
+
+def _file_metadata(filenames: Sequence[str]):
+    """Per-file Parquet footers, resolving URI inputs (gs://, s3://,
+    memory://, ...) through :func:`~.utils.parquet_filesystem`."""
     import pyarrow.parquet as pq
 
-    return sum(pq.ParquetFile(f).metadata.num_rows for f in filenames)
+    from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
+
+    out = []
+    for f in filenames:
+        fs, rel = parquet_filesystem(f)
+        out.append(pq.ParquetFile(rel, filesystem=fs).metadata)
+    return out
 
 
 def packed_nbytes(num_rows: int, num_feature_columns: int) -> int:
@@ -547,7 +558,7 @@ class DeviceResidentShufflingDataset:
         data_shards = self.mesh.shape.get(self.batch_axis, 1)
         self._col_dtypes = {}
 
-        file_metas = [pq.ParquetFile(f).metadata for f in filenames]
+        file_metas = _file_metadata(filenames)
         file_rows = [m.num_rows for m in file_metas]
         n = sum(file_rows)
         if num_rows is not None and num_rows != n:
@@ -610,7 +621,12 @@ class DeviceResidentShufflingDataset:
         # from whichever files this process happens to decode.
         from ray_shuffling_data_loader_tpu.shuffle import narrowed_dtype
 
-        schema = pq.ParquetFile(filenames[0]).schema_arrow
+        from ray_shuffling_data_loader_tpu.utils import (
+            parquet_filesystem,
+        )
+
+        _fs0, _rel0 = parquet_filesystem(filenames[0])
+        schema = pq.ParquetFile(_rel0, filesystem=_fs0).schema_arrow
         for name in self._columns:
             np_dtype = np.dtype(schema.field(name).type.to_pandas_dtype())
             narrowed = str(narrowed_dtype(np_dtype))

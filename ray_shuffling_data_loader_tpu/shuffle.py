@@ -84,17 +84,21 @@ def read_parquet_columns(
     from the worker POOL (one mapper process per file), so Arrow's
     per-read thread pool only adds oversubscription — measured 5x slower
     with the default ``use_threads=True`` on a saturated host.
-    ``memory_map`` only applies to local paths: Arrow rejects URIs
-    (gs://, s3://) under it, and pods read shared cloud storage."""
+    ``memory_map`` only applies to local paths; URI inputs (gs://,
+    s3://, memory://, ...) resolve through
+    :func:`~.utils.parquet_filesystem` so pods can shuffle straight from
+    object storage."""
     import pyarrow.parquet as pq
 
-    from ray_shuffling_data_loader_tpu.utils import is_remote_path
+    from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
 
+    fs, rel = parquet_filesystem(filename)
     table = pq.read_table(
-        filename,
+        rel,
         columns=list(columns) if columns is not None else None,
         use_threads=False,
-        memory_map=not is_remote_path(filename),
+        memory_map=fs is None,
+        filesystem=fs,
     )
     cols = {}
     for name, col in zip(table.column_names, table.columns):
@@ -644,7 +648,13 @@ def _dataset_stats_task(
     all day — this rides the battle-tested path."""
     import pyarrow.parquet as pq
 
-    pf = pq.ParquetFile(filenames[0])
+    from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
+
+    def _pf(path):
+        fs, rel = parquet_filesystem(path)
+        return pq.ParquetFile(rel, filesystem=fs)
+
+    pf = _pf(filenames[0])
     per_row = 0.0
     for batch in pf.iter_batches(batch_size=1 << 16):
         if batch.num_rows == 0:
@@ -658,9 +668,7 @@ def _dataset_stats_task(
     if per_row == 0.0:
         raise OSError(f"empty sample from {filenames[0]}")
     total_rows = pf.metadata.num_rows
-    total_rows += sum(
-        pq.ParquetFile(f).metadata.num_rows for f in filenames[1:]
-    )
+    total_rows += sum(_pf(f).metadata.num_rows for f in filenames[1:])
     return per_row, int(total_rows)
 
 
