@@ -1,0 +1,232 @@
+"""Pod preemption recovery for the resident loader.
+
+The failure mode a multi-controller pod actually has: losing ANY process
+kills the whole SPMD program (collectives cannot continue without its
+shards), so "losing a host that owns resident-loader shards" recovers by
+RESTART — re-stage from the (immutable) Parquet source and resume the
+batch stream from a cursor checkpoint. This test runs that story end to
+end with the real components: a 2-process pod iterates mid-epoch, saves
+a ``BatchCursor`` through ``CheckpointManager`` (rank-0 writes, all
+ranks call — the multi-controller convention), dies without any cleanup
+(``os._exit``), and a fresh 2-process pod restores the cursor,
+re-stages, and resumes with ``set_epoch(epoch, skip_batches=...)``.
+Union of pre-kill and post-restart keys must be exactly-once per epoch.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["RSDL_T_REPO"])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["RSDL_T_COORD"],
+    num_processes=2,
+    process_id=int(os.environ["RSDL_T_RANK"]),
+)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.checkpoint import (
+    BatchCursor,
+    CheckpointManager,
+)
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+from ray_shuffling_data_loader_tpu.resident import (
+    DeviceResidentShufflingDataset,
+)
+
+rank = int(os.environ["RSDL_T_RANK"])
+rdv = os.environ["RSDL_T_RDV"]
+phase = os.environ["RSDL_T_PHASE"]
+NUM_ROWS, BATCH = 8000, 1000
+STOP_AFTER = 3  # batches before the simulated preemption
+
+runtime.init(num_workers=2)
+if rank == 0 and not os.path.isdir(rdv + "/data"):
+    generate_data(NUM_ROWS, 3, 2, 0.0, rdv + "/data_tmp")
+    os.rename(rdv + "/data_tmp", rdv + "/data")
+else:
+    deadline = time.time() + 120
+    while not os.path.isdir(rdv + "/data"):
+        assert time.time() < deadline
+        time.sleep(0.2)
+filenames = sorted(
+    os.path.join(rdv, "data", f)
+    for f in os.listdir(rdv + "/data")
+    if ".parquet" in f
+)
+
+mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+stream_config = {
+    "mode": "resident-pod",
+    "seed": 11,
+    "batch_size": BATCH,
+    "num_files": len(filenames),
+}
+
+
+def shard_keys(arr):
+    seen, keys = set(), []
+    for shard in arr.addressable_shards:
+        idx = tuple((s.start, s.stop) for s in shard.index)
+        if idx not in seen:
+            seen.add(idx)
+            keys.extend(np.asarray(shard.data).reshape(-1).tolist())
+    return keys
+
+
+ds = DeviceResidentShufflingDataset(
+    filenames,
+    num_epochs=2,
+    batch_size=BATCH,
+    feature_columns=["key", "embeddings_name0"],
+    label_column="labels",
+    mesh=mesh,
+    seed=11,
+)
+mgr = CheckpointManager(rdv + "/ckpt")
+
+out = {"epochs": {}}
+
+if phase == "a":
+    ds.set_epoch(0)
+    keys = []
+    it = iter(ds)
+    for i in range(STOP_AFTER):
+        features, label = next(it)
+        jax.block_until_ready(label)
+        keys.extend(shard_keys(features["key"]))
+    out["epochs"]["0"] = keys
+    # Every rank calls save (multi-controller convention); rank 0 writes.
+    mgr.save(
+        STOP_AFTER,
+        cursor=BatchCursor(
+            epoch=0,
+            batches_yielded=STOP_AFTER,
+            step=STOP_AFTER,
+            config=stream_config,
+        ),
+    )
+    with open(f"{rdv}/keys_{rank}_a.tmp", "w") as f:
+        json.dump(out, f)
+    os.rename(f"{rdv}/keys_{rank}_a.tmp", f"{rdv}/keys_{rank}_a")
+    print("RESPOD_PREEMPT_OK", rank, flush=True)
+    # Preemption: no ds.close(), no runtime.shutdown(), no teardown.
+    os._exit(0)
+
+# phase == "b": fresh pod, restore and resume.
+cursor = mgr.restore_cursor()
+assert cursor is not None, "no checkpoint found on restart"
+cursor.validate(stream_config)
+assert cursor.epoch == 0 and cursor.batches_yielded == STOP_AFTER
+
+ds.set_epoch(cursor.epoch, skip_batches=cursor.batches_yielded)
+keys = []
+for features, label in ds:
+    jax.block_until_ready(label)
+    keys.extend(shard_keys(features["key"]))
+out["epochs"]["0"] = keys
+
+ds.set_epoch(1)
+keys = []
+for features, label in ds:
+    jax.block_until_ready(label)
+    keys.extend(shard_keys(features["key"]))
+out["epochs"]["1"] = keys
+
+with open(f"{rdv}/keys_{rank}_b.tmp", "w") as f:
+    json.dump(out, f)
+os.rename(f"{rdv}/keys_{rank}_b.tmp", f"{rdv}/keys_{rank}_b")
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("done")
+runtime.shutdown()
+print("RESPOD_RESUME_OK", rank, flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_phase(tmp_path, phase, expect_marker):
+    coord = f"127.0.0.1:{_free_port()}"
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            RSDL_T_REPO=_REPO,
+            RSDL_T_COORD=coord,
+            RSDL_T_RANK=str(rank),
+            RSDL_T_RDV=str(tmp_path),
+            RSDL_T_PHASE=phase,
+        )
+        log = tmp_path / f"rank{rank}_{phase}.log"
+        logs.append(log)
+        lf = open(log, "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable, "-u", "-c", _WORKER],
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                ),
+                lf,
+            )
+        )
+    try:
+        for proc, _ in procs:
+            proc.wait(timeout=420)
+    finally:
+        for proc, lf in procs:
+            proc.kill()
+            proc.wait()
+            lf.close()
+    for rank, log in enumerate(logs):
+        tail = log.read_text()
+        assert f"{expect_marker} {rank}" in tail, (
+            f"phase {phase} rank {rank} failed:\n{tail[-2000:]}"
+        )
+
+
+def test_pod_preemption_restart_resumes_exactly_once(tmp_path):
+    _run_phase(tmp_path, "a", "RESPOD_PREEMPT_OK")
+    _run_phase(tmp_path, "b", "RESPOD_RESUME_OK")
+
+    def merged(phase, epoch):
+        keys = []
+        for rank in range(2):
+            with open(tmp_path / f"keys_{rank}_{phase}") as f:
+                keys.extend(json.load(f)["epochs"].get(str(epoch), []))
+        return keys
+
+    # Epoch 0 = pre-preemption batches + resumed remainder, exactly-once.
+    epoch0 = merged("a", 0) + merged("b", 0)
+    assert sorted(epoch0) == list(range(8000)), (
+        "resumed epoch-0 stream lost or duplicated rows "
+        f"(got {len(epoch0)} keys)"
+    )
+    # Epoch 1 runs wholly after the restart, exactly-once.
+    assert sorted(merged("b", 1)) == list(range(8000))
