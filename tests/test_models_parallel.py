@@ -189,6 +189,10 @@ def test_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+# Slow tier: ~57 s — the full 8-device dryrun, which the driver also
+# runs standalone every round; the fast lane keeps the unit-level
+# parallel tests.
+@pytest.mark.slow
 def test_graft_entry_and_dryrun():
     import __graft_entry__
 

@@ -109,6 +109,8 @@ if __name__ == "__main__":
 """
 
 
+# Slow tier: ~18 s of deliberate store-overflow churn (integration).
+@pytest.mark.slow
 def test_shuffle_completes_with_dataset_over_capacity(tmp_path):
     """End-to-end: dataset working set ~2x the shm budget completes
     (VERDICT r1 item 6 'Done' criterion) with spill active."""
