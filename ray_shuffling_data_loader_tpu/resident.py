@@ -61,22 +61,35 @@ from ray_shuffling_data_loader_tpu.jax_dataset import HostToDeviceStats
 DEFAULT_PIECE_ROWS = 1 << 20
 
 
-def _decode_narrow_to_store(filename: str, columns: Sequence[str]):
+def _decode_narrow_to_store(
+    filename: str, columns: Sequence[str], stage_tasks: int = 0
+):
     """Pool task: decode one Parquet file, narrow to 32-bit, publish the
-    requested columns to the shared-memory store. Returns the ref."""
+    requested columns to the shared-memory store. Returns the ref.
+    ``stage_tasks`` = how many decode tasks the stage submitted; the
+    thread decision is made HERE, on the worker's own core count."""
     from ray_shuffling_data_loader_tpu.shuffle import (
         _narrow_column,
         read_parquet_columns,
     )
+    from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
 
-    batch = read_parquet_columns(filename, columns=columns)
+    batch = read_parquet_columns(
+        filename,
+        columns=columns,
+        use_threads=stage_tasks > 0 and arrow_decode_threads(stage_tasks),
+    )
     cols = {name: _narrow_column(name, batch.columns[name]) for name in columns}
     ctx = runtime.ensure_initialized()
     return ctx.store.put_columns(cols)
 
 
 def _decode_narrow_range_to_store(
-    filename: str, columns: Sequence[str], row_lo: int, row_hi: int
+    filename: str,
+    columns: Sequence[str],
+    row_lo: int,
+    row_hi: int,
+    stage_tasks: int = 0,
 ):
     """Pool task: decode only the row range ``[row_lo, row_hi)`` of one
     Parquet file — at row-group granularity, so a pod process staging a
@@ -108,7 +121,13 @@ def _decode_narrow_range_to_store(
             f"row range [{row_lo}, {row_hi}) outside file {filename!r} "
             f"({g_start} rows)"
         )
-    table = pf.read_row_groups(sel, columns=list(columns), use_threads=False)
+    from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
+
+    table = pf.read_row_groups(
+        sel,
+        columns=list(columns),
+        use_threads=stage_tasks > 0 and arrow_decode_threads(stage_tasks),
+    )
     a, b = row_lo - first_row, row_hi - first_row
     cols = {}
     for name in columns:
@@ -444,12 +463,16 @@ class DeviceResidentShufflingDataset:
         window = max(2, getattr(ctx.scheduler, "width", 1) + 2)
         pending = list(filenames)
         futs: List = []
+        stage_tasks = min(len(filenames), window)
 
         def topup():
             while pending and len(futs) < window:
                 futs.append(
                     ctx.scheduler.submit(
-                        _decode_narrow_to_store, pending.pop(0), self._columns
+                        _decode_narrow_to_store,
+                        pending.pop(0),
+                        self._columns,
+                        stage_tasks,
                     )
                 )
 
@@ -677,6 +700,7 @@ class DeviceResidentShufflingDataset:
             file_hi = min(hi, int(offsets[i + 1]))
             if file_lo < file_hi:
                 spans_by_file.append((i, file_lo, file_hi))
+        _stage_tasks = max(1, len(spans_by_file))
         futs = {
             i: ctx.pool.submit(
                 _decode_narrow_range_to_store,
@@ -684,6 +708,7 @@ class DeviceResidentShufflingDataset:
                 self._columns,
                 file_lo - int(offsets[i]),
                 file_hi - int(offsets[i]),
+                _stage_tasks,
             )
             for i, file_lo, file_hi in spans_by_file
         }

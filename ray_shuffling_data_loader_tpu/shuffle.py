@@ -45,6 +45,7 @@ import numpy as np
 from ray_shuffling_data_loader_tpu import runtime
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
 from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture, wait
+from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
 
 
 class BatchConsumer:
@@ -74,16 +75,21 @@ class BatchConsumer:
 
 
 def read_parquet_columns(
-    filename: str, columns: Optional[Sequence[str]] = None
+    filename: str,
+    columns: Optional[Sequence[str]] = None,
+    use_threads: bool = False,
 ) -> ColumnBatch:
     """Decode a Parquet file to contiguous numpy columns (Arrow C++ decode
     stays on host CPUs, per SURVEY §2b). ``columns`` restricts the decode
     to a projection (None = all columns).
 
-    Single-threaded decode + memory-mapped input: parallelism here comes
-    from the worker POOL (one mapper process per file), so Arrow's
-    per-read thread pool only adds oversubscription — measured 5x slower
-    with the default ``use_threads=True`` on a saturated host.
+    ``use_threads`` defaults OFF: parallelism here normally comes from
+    the worker POOL (one mapper process per file), so Arrow's per-read
+    thread pool only adds oversubscription — measured 5x slower with the
+    default ``use_threads=True`` on a saturated host. Decode tasks that
+    know their stage's concurrency pass
+    :func:`~.utils.arrow_decode_threads`'s worker-local decision (which
+    also caps Arrow's pool to the task's fair share of the host).
     ``memory_map`` only applies to local paths; URI inputs (gs://,
     s3://, memory://, ...) resolve through
     :func:`~.utils.parquet_filesystem` so pods can shuffle straight from
@@ -96,7 +102,7 @@ def read_parquet_columns(
     table = pq.read_table(
         rel,
         columns=list(columns) if columns is not None else None,
-        use_threads=False,
+        use_threads=use_threads,
         memory_map=fs is None,
         filesystem=fs,
     )
@@ -161,6 +167,7 @@ def shuffle_map(
     narrow_to_32: bool = False,
     cache_ref: Optional[ObjectRef] = None,
     publish_cache: bool = False,
+    stage_tasks: int = 0,
 ):
     """Map stage: load one file, randomly partition its rows across reducers.
 
@@ -188,7 +195,10 @@ def shuffle_map(
     if cache_ref is not None:
         batch = ctx.store.get_columns(cache_ref)
     else:
-        batch = read_parquet_columns(filename)
+        # Worker-side thread decision: this host's cores, capped pool
+        # (utils.arrow_decode_threads; stage_tasks == files this epoch).
+        use_threads = stage_tasks > 0 and arrow_decode_threads(stage_tasks)
+        batch = read_parquet_columns(filename, use_threads=use_threads)
         if narrow_to_32:
             batch = ColumnBatch(
                 {k: _narrow_column(k, v) for k, v in batch.columns.items()}
@@ -859,6 +869,7 @@ def shuffle_epoch(
                 narrow_to_32,
                 cache_ref,
                 publish,
+                len(filenames),
             )
             if cache_ref is not None:
                 # Locality: run the map on the host that owns the cached

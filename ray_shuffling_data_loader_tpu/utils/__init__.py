@@ -1,10 +1,62 @@
 """Shared utilities: platform pinning, wall-clock timing, path kinds."""
 
+import os
+
 from ray_shuffling_data_loader_tpu.utils.platform import (  # noqa: F401
     force_platform_from_env,
     pin_platform,
 )
 from ray_shuffling_data_loader_tpu.utils.timing import timer  # noqa: F401
+
+
+def decode_use_threads(num_concurrent_tasks: int) -> bool:
+    """Should one Parquet decode task use Arrow's internal thread pool?
+
+    Parallelism normally comes from the worker POOL (one decode task per
+    file); per-task Arrow threads only help when the host has idle cores
+    beyond the concurrently-decoding tasks — e.g. a ~120-core TPU-VM
+    host decoding a 16-file dataset leaves >100 cores idle without them.
+    On a saturated host they oversubscribe instead (measured 5x slower,
+    see ``shuffle.read_parquet_columns``). Heuristic: engage when the
+    host has at least twice as many cores as concurrent decode tasks.
+    ``RSDL_DECODE_THREADS=on|off`` overrides.
+    """
+    env = os.environ.get("RSDL_DECODE_THREADS", "").lower()
+    if env in ("on", "1", "true"):
+        return True
+    if env in ("off", "0", "false"):
+        return False
+    return (os.cpu_count() or 1) >= 2 * max(1, num_concurrent_tasks)
+
+
+def arrow_decode_threads(stage_tasks: int) -> bool:
+    """Worker-side decision + pool cap for one decode task.
+
+    Called INSIDE the pool worker that is about to decode (so the core
+    count consulted is the core count of the host actually doing the
+    work — the driver that submitted the stage may have a different
+    shape). ``stage_tasks`` is how many decode tasks the stage submitted
+    cluster-wide; concurrency on THIS host can't exceed
+    ``min(stage_tasks, local cores)``.
+
+    When threads engage, Arrow's process-global thread pool is CAPPED to
+    this task's fair share of the host (``cores // concurrent``) —
+    Arrow's default pool is cpu_count-sized PER PROCESS, so N concurrent
+    uncapped readers would run N x cores threads, re-creating the
+    oversubscription the pool-parallel design avoids. A pool worker runs
+    one task at a time, so setting the cap here is race-free.
+    """
+    cores = os.cpu_count() or 1
+    concurrent = min(max(1, stage_tasks), cores)
+    if not decode_use_threads(concurrent):
+        return False
+    try:
+        import pyarrow as pa
+
+        pa.set_cpu_count(max(2, cores // concurrent))
+    except Exception:
+        return False
+    return True
 
 
 def is_remote_path(path: str) -> bool:
@@ -46,6 +98,8 @@ def parquet_filesystem(path: str):
 
 
 __all__ = [
+    "arrow_decode_threads",
+    "decode_use_threads",
     "force_platform_from_env",
     "is_remote_path",
     "parquet_filesystem",

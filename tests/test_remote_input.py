@@ -101,3 +101,60 @@ def test_resident_dataset_from_file_uri(local_runtime, uri_files):
     )
     assert np.array_equal(np.sort(keys), np.arange(4000))
     ds.close()
+
+
+def test_decode_threads_policy(monkeypatch):
+    """Arrow per-read threads engage only when the host has idle cores
+    beyond the concurrent decode tasks; env forces either way."""
+    import ray_shuffling_data_loader_tpu.utils as utils
+
+    monkeypatch.delenv("RSDL_DECODE_THREADS", raising=False)
+    monkeypatch.setattr(utils.os, "cpu_count", lambda: 128)
+    assert utils.decode_use_threads(16) is True  # 128 >= 2*16
+    assert utils.decode_use_threads(64) is True
+    assert utils.decode_use_threads(65) is False
+    monkeypatch.setattr(utils.os, "cpu_count", lambda: 1)
+    assert utils.decode_use_threads(1) is False
+    monkeypatch.setenv("RSDL_DECODE_THREADS", "on")
+    assert utils.decode_use_threads(10**6) is True
+    monkeypatch.setenv("RSDL_DECODE_THREADS", "off")
+    assert utils.decode_use_threads(1) is False
+
+
+def test_threaded_decode_same_columns(local_runtime, uri_files):
+    """Threaded and single-threaded decode produce identical columns."""
+    from ray_shuffling_data_loader_tpu.shuffle import read_parquet_columns
+
+    a = read_parquet_columns(uri_files[0], use_threads=False)
+    b = read_parquet_columns(uri_files[0], use_threads=True)
+    assert set(a.columns) == set(b.columns)
+    for k in a.columns:
+        assert np.array_equal(a.columns[k], b.columns[k])
+
+
+def test_arrow_decode_threads_caps_pool(monkeypatch):
+    """When threads engage, Arrow's process-global pool is capped to the
+    task's fair share of the host (uncapped, N concurrent readers would
+    run N x cores threads — the oversubscription the policy exists to
+    avoid)."""
+    import pyarrow as pa
+
+    import ray_shuffling_data_loader_tpu.utils as utils
+
+    monkeypatch.delenv("RSDL_DECODE_THREADS", raising=False)
+    before = pa.cpu_count()
+    try:
+        monkeypatch.setattr(utils.os, "cpu_count", lambda: 128)
+        assert utils.arrow_decode_threads(16) is True
+        assert pa.cpu_count() == 8  # 128 cores / 16 concurrent tasks
+        # Saturated host: stays single-threaded, pool untouched.
+        pa.set_cpu_count(before)
+        monkeypatch.setattr(utils.os, "cpu_count", lambda: 16)
+        assert utils.arrow_decode_threads(16) is False
+        assert pa.cpu_count() == before
+        # stage_tasks beyond cores clamps to cores (concurrency on this
+        # host cannot exceed its own core count).
+        monkeypatch.setattr(utils.os, "cpu_count", lambda: 256)
+        assert utils.arrow_decode_threads(100000) is False
+    finally:
+        pa.set_cpu_count(before)
