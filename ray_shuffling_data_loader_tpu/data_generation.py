@@ -108,12 +108,27 @@ def generate_file(
     }
     data_size = sum(v.nbytes for v in columns.values())
     table = pa.table({k: pa.array(v) for k, v in columns.items()})
-    filename = os.path.join(
-        data_dir, f"input_data_{file_index}.parquet.snappy"
+    from ray_shuffling_data_loader_tpu.utils import (
+        is_remote_path,
+        parquet_filesystem,
     )
-    pq.write_table(
-        table, filename, compression="snappy", row_group_size=group_size
-    )
+
+    if is_remote_path(data_dir):
+        # URI output (gs://, s3://, memory://, ...): generate straight
+        # into object storage — symmetric with the URI read side.
+        filename = f"{data_dir.rstrip('/')}/input_data_{file_index}.parquet.snappy"
+        fs, rel = parquet_filesystem(filename)
+        pq.write_table(
+            table, rel, compression="snappy", row_group_size=group_size,
+            filesystem=fs,
+        )
+    else:
+        filename = os.path.join(
+            data_dir, f"input_data_{file_index}.parquet.snappy"
+        )
+        pq.write_table(
+            table, filename, compression="snappy", row_group_size=group_size
+        )
     return filename, data_size
 
 
@@ -129,7 +144,10 @@ def generate_data(
     ``generate_data``, ``data_generation.py:13-27``)."""
     assert max_row_group_skew == 0.0, "row-group skew not implemented"
     ctx = runtime.ensure_initialized()
-    os.makedirs(data_dir, exist_ok=True)
+    from ray_shuffling_data_loader_tpu.utils import is_remote_path
+
+    if not is_remote_path(data_dir):
+        os.makedirs(data_dir, exist_ok=True)
     futures = []
     rows_per_file = max(1, num_rows // num_files)
     for file_index, global_row_index in enumerate(

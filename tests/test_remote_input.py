@@ -158,3 +158,21 @@ def test_arrow_decode_threads_caps_pool(monkeypatch):
         assert utils.arrow_decode_threads(100000) is False
     finally:
         pa.set_cpu_count(before)
+
+
+def test_generate_data_to_uri(local_runtime, tmp_path):
+    """Synthetic data generation writes straight to a URI destination
+    (pool workers resolve it too); reading back is exactly-once."""
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+    from ray_shuffling_data_loader_tpu.shuffle import read_parquet_columns
+
+    out = tmp_path / "gen-uri"
+    out.mkdir()
+    filenames, nbytes = generate_data(2000, 2, 1, 0.0, f"file://{out}")
+    assert nbytes > 0 and len(filenames) >= 2
+    assert all(f.startswith("file://") for f in filenames)
+    keys = np.concatenate(
+        [np.asarray(read_parquet_columns(f).columns[KEY_COLUMN])
+         for f in filenames]
+    )
+    assert np.array_equal(np.sort(keys), np.arange(2000))
