@@ -9,24 +9,120 @@ was always left to the user loop, ``ray_torch_shuffle.py:204-207``); TPU
 users should prefer :class:`~.jax_dataset.JaxShufflingDataset`, which
 stages batches into HBM directly.
 
-Differences: the converter consumes :class:`~.runtime.ColumnBatch` columns
-(already contiguous numpy arrays — ``torch.as_tensor`` wraps them zero-copy)
-instead of DataFrame columns, and object-dtype columns of
-ndarrays/lists/tuples are stacked the same way the reference handles them
-(``torch_dataset.py:211-221``).
+Design differences from the reference: the spec is a pair of dataclasses
+(:class:`ColumnSpec` per column, :class:`TensorBatchSpec` for the batch)
+rather than six parallel lists threaded through every function; mismatch
+errors are ``ValueError`` with the offending sizes; the converter consumes
+:class:`~.runtime.ColumnBatch` columns (contiguous numpy arrays —
+``torch.as_tensor`` wraps them zero-copy) but accepts DataFrames too, and
+object-dtype columns of ndarrays/lists/tuples are stacked as the
+reference's users expect (``torch_dataset.py:211-221``).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Iterable, List, Optional, Tuple
 
 import numpy as np
 import torch
 from torch.utils.data import IterableDataset
 
 from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
-from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch  # noqa: F401
+
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    """One output tensor: source column, dtype, and row shape.
+
+    ``shape=None`` means a trailing unit dimension (``[batch, 1]``), the
+    reference adapter's default for scalar columns."""
+
+    name: Any
+    dtype: torch.dtype = torch.float
+    shape: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if not isinstance(self.dtype, torch.dtype):
+            raise ValueError(
+                f"column {self.name!r}: dtype must be a torch.dtype, "
+                f"got {self.dtype!r}"
+            )
+
+    def to_tensor(self, values: np.ndarray) -> torch.Tensor:
+        t = torch.as_tensor(values, dtype=self.dtype)
+        if self.shape is not None:
+            return t.view(-1, *self.shape)
+        return t.view(-1, 1)
+
+
+@dataclass(frozen=True)
+class TensorBatchSpec:
+    """The whole batch contract: feature columns plus one label column."""
+
+    features: Tuple[ColumnSpec, ...]
+    label: ColumnSpec
+
+    @classmethod
+    def build(
+        cls,
+        feature_columns,
+        feature_shapes=None,
+        feature_types=None,
+        label_column=None,
+        label_shape=None,
+        label_type=None,
+    ) -> "TensorBatchSpec":
+        """Assemble from the reference adapter's keyword surface
+        (reference ``torch_dataset.py:144-201``): scalars promote to
+        one-element lists, dtypes default to ``torch.float``, shapes to
+        ``None`` (= unit trailing dim)."""
+        names = (
+            list(feature_columns)
+            if isinstance(feature_columns, list)
+            else [feature_columns]
+        )
+
+        def _broadcast(value, what, wrap_scalar):
+            if not value:
+                return [None] * len(names)
+            items = list(value) if isinstance(value, list) else [value]
+            if len(items) != len(names):
+                raise ValueError(
+                    f"{what} has {len(items)} entries for "
+                    f"{len(names)} feature_columns"
+                )
+            return [wrap_scalar(v) for v in items]
+
+        shapes = _broadcast(
+            feature_shapes,
+            "feature_shapes",
+            lambda s: tuple(s) if isinstance(s, Iterable) else (s,),
+        )
+        dtypes = _broadcast(feature_types, "feature_types", lambda d: d)
+        features = tuple(
+            ColumnSpec(
+                name=n,
+                dtype=d if d is not None else torch.float,
+                shape=s,
+            )
+            for n, s, d in zip(names, shapes, dtypes)
+        )
+        label = ColumnSpec(
+            name=label_column,
+            dtype=label_type if label_type else torch.float,
+            shape=(label_shape,) if label_shape else None,
+        )
+        return cls(features=features, label=label)
+
+    def __call__(self, batch) -> Tuple[List[torch.Tensor], torch.Tensor]:
+        feature_tensors = [
+            spec.to_tensor(_column_values(batch, spec.name))
+            for spec in self.features
+        ]
+        label = self.label.to_tensor(_column_values(batch, self.label.name))
+        return feature_tensors, label
 
 
 class TorchShufflingDataset(IterableDataset):
@@ -79,7 +175,7 @@ class TorchShufflingDataset(IterableDataset):
             narrow_to_32=narrow_to_32,
             cache_decoded=cache_decoded,
         )
-        self._batch_transform = batch_to_tensor_factory(
+        self._spec = TensorBatchSpec.build(
             feature_columns=feature_columns,
             feature_shapes=feature_shapes,
             feature_types=feature_types,
@@ -95,7 +191,7 @@ class TorchShufflingDataset(IterableDataset):
 
     def __iter__(self):
         for batch in iter(self._ds):
-            yield self._batch_transform(batch)
+            yield self._spec(batch)
 
 
 def batch_to_tensor_factory(
@@ -105,26 +201,11 @@ def batch_to_tensor_factory(
     label_column: Any = None,
     label_shape: Optional[int] = None,
     label_type: Optional[torch.dtype] = None,
-) -> Callable[[ColumnBatch], Tuple[List[torch.Tensor], torch.Tensor]]:
-    """Returns a ColumnBatch → ``(feature_tensors, label_tensor)`` converter
-    (reference ``dataframe_to_tensor_factory``, ``torch_dataset.py:95-141``)."""
-    (
-        feature_columns,
-        feature_shapes,
-        feature_types,
-        label_column,
-        label_shape,
-        label_type,
-    ) = _normalize_torch_data_spec(
-        feature_columns,
-        feature_shapes,
-        feature_types,
-        label_column,
-        label_shape,
-        label_type,
-    )
-    return functools.partial(
-        convert_to_tensor,
+) -> TensorBatchSpec:
+    """Batch → ``(feature_tensors, label_tensor)`` converter (the spec
+    itself is callable; reference ``dataframe_to_tensor_factory``,
+    ``torch_dataset.py:95-141``)."""
+    return TensorBatchSpec.build(
         feature_columns=feature_columns,
         feature_shapes=feature_shapes,
         feature_types=feature_types,
@@ -136,56 +217,6 @@ def batch_to_tensor_factory(
 
 # Backwards-compatible alias for users porting from the reference API.
 dataframe_to_tensor_factory = batch_to_tensor_factory
-
-
-def _normalize_torch_data_spec(
-    feature_columns: List[Any] = None,
-    feature_shapes: Optional[List[Any]] = None,
-    feature_types: Optional[List[torch.dtype]] = None,
-    label_column: Any = None,
-    label_shape: Optional[int] = None,
-    label_type: Optional[torch.dtype] = None,
-):
-    """Defaults for unspecified spec fields (reference
-    ``torch_dataset.py:144-201``): float dtype, ``(-1, 1)`` shapes."""
-    if not isinstance(feature_columns, list):
-        feature_columns = [feature_columns]
-
-    if feature_shapes:
-        if not isinstance(feature_shapes, list):
-            feature_shapes = [feature_shapes]
-        assert len(feature_columns) == len(
-            feature_shapes
-        ), "The feature_shapes size must match the feature_columns"
-        feature_shapes = [
-            s if isinstance(s, Iterable) else [s] for s in feature_shapes
-        ]
-    else:
-        feature_shapes = [None] * len(feature_columns)
-
-    if feature_types:
-        if not isinstance(feature_types, list):
-            feature_types = [feature_types]
-        assert len(feature_columns) == len(
-            feature_types
-        ), "The feature_types size must match the feature_columns"
-        assert all(
-            isinstance(dtype, torch.dtype) for dtype in feature_types
-        ), "All values in feature_types should be torch.dtype instances"
-    else:
-        feature_types = [torch.float] * len(feature_columns)
-
-    if not label_type:
-        label_type = torch.float
-
-    return (
-        feature_columns,
-        feature_shapes,
-        feature_types,
-        label_column,
-        label_shape,
-        label_type,
-    )
 
 
 def _column_values(batch, col) -> np.ndarray:
@@ -202,10 +233,10 @@ def _column_values(batch, col) -> np.ndarray:
         elif isinstance(first, (list, tuple)):
             values = np.asarray([np.asarray(v) for v in values])
         else:
-            raise Exception(
-                f"Column {col}'s type: {type(first)} is not supported. It "
-                "must be a numpy built-in type or a numpy object of "
-                "(ndarray, list, tuple)"
+            raise TypeError(
+                f"column {col!r} holds {type(first).__name__} objects, "
+                "which is not supported: object columns must contain "
+                "ndarray, list, or tuple rows"
             )
     return values
 
@@ -219,25 +250,18 @@ def convert_to_tensor(
     label_shape: Optional[int],
     label_type: torch.dtype,
 ):
-    """Column-spec-driven conversion (reference ``convert_to_tensor``,
-    ``torch_dataset.py:204-236``). Accepts a ColumnBatch or DataFrame."""
-    feature_tensor = []
-    for col, shape, dtype in zip(feature_columns, feature_shapes, feature_types):
-        t = torch.as_tensor(_column_values(batch, col), dtype=dtype)
-        if shape is not None:
-            t = t.view(*(-1, *shape))
-        else:
-            t = t.view(-1, 1)
-        feature_tensor.append(t)
-
-    label_tensor = torch.as_tensor(
-        _column_values(batch, label_column), dtype=label_type
+    """One-shot functional form of the conversion, for callers that hold
+    plain lists (reference ``convert_to_tensor``, ``torch_dataset.py:
+    204-236``). Accepts a ColumnBatch or DataFrame."""
+    spec = TensorBatchSpec.build(
+        feature_columns=feature_columns,
+        feature_shapes=feature_shapes,
+        feature_types=feature_types,
+        label_column=label_column,
+        label_shape=label_shape,
+        label_type=label_type,
     )
-    if label_shape:
-        label_tensor = label_tensor.view(-1, label_shape)
-    else:
-        label_tensor = label_tensor.view(-1, 1)
-    return feature_tensor, label_tensor
+    return spec(batch)
 
 
 if __name__ == "__main__":

@@ -81,16 +81,20 @@ def test_convert_object_unsupported():
         transform(cb)
 
 
-def test_spec_size_mismatch_asserts():
-    with pytest.raises(AssertionError, match="feature_shapes"):
+def test_spec_size_mismatch_raises():
+    with pytest.raises(ValueError, match="feature_shapes"):
         batch_to_tensor_factory(
             feature_columns=["a", "b"], feature_shapes=[(1,)], label_column="y"
         )
-    with pytest.raises(AssertionError, match="feature_types"):
+    with pytest.raises(ValueError, match="feature_types"):
         batch_to_tensor_factory(
             feature_columns=["a"],
             feature_types=[torch.float, torch.int64],
             label_column="y",
+        )
+    with pytest.raises(ValueError, match="torch.dtype"):
+        batch_to_tensor_factory(
+            feature_columns=["a"], feature_types=["float32"], label_column="y"
         )
 
 
