@@ -1,0 +1,121 @@
+"""Property-based re-batching invariants (hypothesis).
+
+The carry-buffer re-batcher (``dataset.ShufflingDataset.__iter__``,
+reference ``dataset.py:118-182``) must, for ANY partitioning of the
+shuffled stream into reducer outputs and ANY batch size:
+
+* yield batches of exactly ``batch_size`` rows (except an optional final
+  partial, dropped under ``drop_last``);
+* preserve the stream's row ORDER (re-batching is a reshape, not a
+  shuffle);
+* lose and duplicate nothing;
+* honor ``skip_batches`` resume (the yielded suffix equals the full
+  stream minus the first k batches).
+
+Randomized structure generation finds the boundary cases enumerated
+tests miss (empty reducer outputs, outputs smaller than the buffer
+top-up, exact-multiple boundaries) — the reference's tail-drop bug
+(``dataset.py:160-168``) is exactly the kind of case this sweeps for.
+The queue/store machinery is bypassed on purpose: the property under
+test is the pure re-batching algebra, driven through the same
+``ColumnBatch.concat``/``slice`` operations the real iterator uses.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+
+
+def _rebatch(outputs, batch_size, drop_last=False, skip_batches=0):
+    """The iterator's carry-buffer algebra, isolated — an exact mirror of
+    ``dataset.py:210-251``'s loop over in-memory reducer outputs."""
+    buf = None
+    to_skip = skip_batches
+    out = []
+    for cb in outputs:
+        offset = batch_size - (buf.num_rows if buf else 0)
+        buf = ColumnBatch.concat([buf, cb.slice(0, offset)])
+        if buf.num_rows == batch_size:
+            if to_skip > 0:
+                to_skip -= 1
+            else:
+                out.append(buf)
+            buf = None
+        start = min(offset, cb.num_rows)
+        num_full = (cb.num_rows - start) // batch_size
+        num_skipped = min(to_skip, num_full)
+        to_skip -= num_skipped
+        for i in range(num_skipped, num_full):
+            lo = start + i * batch_size
+            out.append(cb.slice(lo, lo + batch_size))
+        tail = start + num_full * batch_size
+        if tail < cb.num_rows:
+            buf = cb.slice(tail, cb.num_rows)
+    if buf is not None and buf.num_rows > 0 and not drop_last:
+        if to_skip > 0:
+            to_skip -= 1
+        else:
+            out.append(buf)
+    return out
+
+
+@st.composite
+def stream_partition(draw):
+    """A random row stream cut into random reducer-output sizes."""
+    total = draw(st.integers(min_value=0, max_value=400))
+    sizes = []
+    left = total
+    while left > 0:
+        s = draw(st.integers(min_value=0, max_value=left))
+        sizes.append(s)
+        left -= s
+    # Sprinkle empty outputs anywhere (reducers can legally emit none).
+    for _ in range(draw(st.integers(0, 2))):
+        sizes.insert(draw(st.integers(0, len(sizes))) if sizes else 0, 0)
+    batch_size = draw(st.integers(min_value=1, max_value=64))
+    return sizes, batch_size
+
+
+def _outputs(sizes):
+    rows = np.arange(sum(sizes), dtype=np.int64)
+    outputs, at = [], 0
+    for s in sizes:
+        outputs.append(ColumnBatch({"key": rows[at : at + s]}))
+        at += s
+    return rows, outputs
+
+
+@given(stream_partition(), st.booleans())
+@settings(max_examples=200, deadline=None)
+def test_rebatch_exact_sizes_order_exactly_once(case, drop_last):
+    sizes, batch_size = case
+    rows, outputs = _outputs(sizes)
+    batches = _rebatch(outputs, batch_size, drop_last=drop_last)
+    n = len(rows)
+    full, tail = divmod(n, batch_size)
+    assert len(batches) == full + (0 if drop_last or tail == 0 else 1)
+    for b in batches[:full]:
+        assert b.num_rows == batch_size
+    got = np.concatenate(
+        [np.asarray(b.columns["key"]) for b in batches]
+    ) if batches else np.array([], dtype=np.int64)
+    expect = rows if not drop_last else rows[: full * batch_size]
+    assert np.array_equal(got, expect), "order / exactly-once violated"
+
+
+@given(stream_partition(), st.integers(min_value=0, max_value=12))
+@settings(max_examples=200, deadline=None)
+def test_rebatch_skip_batches_is_suffix(case, skip):
+    sizes, batch_size = case
+    rows, outputs = _outputs(sizes)
+    all_batches = _rebatch(outputs, batch_size)
+    resumed = _rebatch(outputs, batch_size, skip_batches=skip)
+    # Skipping k batches yields the same stream minus the first k
+    # (the final partial counts as a batch in yield order too).
+    k = min(skip, len(all_batches))
+    expect = [np.asarray(b.columns["key"]) for b in all_batches[k:]]
+    got = [np.asarray(b.columns["key"]) for b in resumed]
+    assert len(got) == len(expect)
+    for g, e in zip(got, expect):
+        assert np.array_equal(g, e)
