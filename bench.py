@@ -188,18 +188,20 @@ def _shm_free_bytes() -> int:
         return 1 << 62
 
 
-def _sized_workload(platform: str):
+def _sized_workload(platform: str, full_size: bool = False):
     """Pick (num_rows, dataset_gb): TARGET_GB unless /dev/shm headroom
     forces smaller. Peak store residency is ~2x dataset (one epoch's map
     partitions + reducer outputs) x up to 2 epochs in flight; require 5x
     so the bench never ENOSPCs mid-epoch.
 
-    CPU failover shrinks the workload (``RSDL_BENCH_CPU_GB``, default
-    0.1 GB — sized so 10 epochs of real 250k-row DLRM steps at CPU speed
-    still finish in minutes): the real train step is ~3 orders slower
-    without the MXU and a 10 GB run would blow any reasonable window."""
+    CPU runs keep the full TARGET_GB when the train step is mocked
+    (``full_size`` — loader-isolation methodology, where the pipeline is
+    the thing measured) and shrink to ``RSDL_BENCH_CPU_GB`` (default
+    0.1 GB) when a REAL step runs on CPU: a real train step is ~3 orders
+    slower without the MXU and 10 GB of real steps would blow any
+    reasonable window."""
     target_gb = TARGET_GB
-    if platform == "cpu":
+    if platform == "cpu" and not full_size:
         target_gb = min(
             target_gb, float(os.environ.get("RSDL_BENCH_CPU_GB", "0.1"))
         )
@@ -469,7 +471,27 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # same steady-state GB/s at 1/2/4 workers on 1 core, but +5s cold
     # start at 4).
     ctx = runtime.init(num_workers=max(2, os.cpu_count() or 1))
-    num_rows, scaled_down = _sized_workload(platform)
+    # CPU-failover methodology: mock the train step (the reference's own
+    # harness measures the loader this way — --mock-train-step-time,
+    # ray_torch_shuffle.py:214) and run the FULL workload. A real DLRM
+    # step without an MXU is ~3 orders slower, so r3's real-step CPU
+    # number was ~95% CPU matmul time — a liveness check mislabeled as a
+    # loader measurement (VERDICT r3 "what's weak" #1). The TPU path
+    # keeps the real step; RSDL_BENCH_REAL_STEP=1 forces it on CPU too.
+    mock_step_s = None
+    env_mock = os.environ.get("RSDL_BENCH_MOCK_STEP_S")
+    if env_mock is not None:
+        # Explicitly set: a value mocks at that duration; the empty
+        # string is the established real-step opt-out.
+        mock_step_s = float(env_mock) if env_mock else None
+    elif (
+        platform == "cpu"
+        and os.environ.get("RSDL_BENCH_REAL_STEP") != "1"
+    ):
+        mock_step_s = 0.002  # the r3-calibrated loader-isolation step
+    num_rows, scaled_down = _sized_workload(
+        platform, full_size=mock_step_s is not None
+    )
     filenames, dataset_bytes = _get_data(num_rows)
 
     peak_gbps = _measure_peak_h2d_gbps(platform)
@@ -523,9 +545,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # ray_torch_shuffle.py:214): the train step is a fixed sleep, so skip
     # model build + compile + warm-up entirely — they would cost ~10 s of
     # startup (CPU backend) to produce a step_fn the loop never calls.
-    mock_step_env = os.environ.get("RSDL_BENCH_MOCK_STEP_S")
-    mock_step_s = float(mock_step_env) if mock_step_env else None
-
+    # mock_step_s decided above (env override, else CPU-failover default).
     pallas_env = os.environ.get("RSDL_BENCH_PALLAS", "auto")
     pallas_mode = "off"
     state = step_fn = step_body = None
@@ -938,6 +958,9 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "num_chips": num_chips,
         "host_cpus": os.cpu_count(),
         "backend": platform,
+        "step": (
+            f"mock-{mock_step_s}s" if mock_step_s is not None else "real"
+        ),
         "loader": "resident" if use_resident else "mapreduce",
         **({"resident_error": resident_error[:300]} if resident_error else {}),
         "pallas": pallas_mode,
