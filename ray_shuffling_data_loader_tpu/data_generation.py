@@ -78,6 +78,51 @@ def generate_row_group(
     return buffer
 
 
+def row_group_sizes(
+    num_rows_in_file: int,
+    num_row_groups_per_file: int,
+    max_row_group_skew: float,
+    file_index: int,
+    seed: int,
+) -> List[int]:
+    """Row counts per group within one file.
+
+    ``max_row_group_skew == 0``: the uniform split (identical to the
+    historical layout, so existing generation caches and pod content
+    digests stay valid). ``0 < skew <= 1``: group sizes vary by up to
+    ±skew around the uniform mean, deterministically in
+    ``(seed, file_index)``, summing exactly to ``num_rows_in_file`` —
+    the knob the reference ACCEPTS but never implemented
+    (``data_generation.py:33`` "TODO ... Generate skewed row groups");
+    skewed groups exercise boundary-straddling decode paths (pod
+    row-range staging, row-group-granular mappers) the uniform layout
+    cannot."""
+    if not 0.0 <= max_row_group_skew <= 1.0:
+        raise ValueError(
+            f"max_row_group_skew must be in [0, 1], got {max_row_group_skew}"
+        )
+    group_size = max(1, num_rows_in_file // num_row_groups_per_file)
+    if max_row_group_skew == 0.0:
+        sizes = []
+        for at in range(0, num_rows_in_file, group_size):
+            sizes.append(min(group_size, num_rows_in_file - at))
+        return sizes
+    num_groups = max(1, min(num_row_groups_per_file, num_rows_in_file))
+    rng = np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(3, file_index))
+    )
+    weights = 1.0 + max_row_group_skew * rng.uniform(-1.0, 1.0, num_groups)
+    weights = np.clip(weights, 1e-3, None)
+    sizes = np.maximum(
+        1, np.floor(weights / weights.sum() * num_rows_in_file)
+    ).astype(int)
+    # Exact total: trim/extend the largest groups (keeps every group >=1).
+    while sizes.sum() > num_rows_in_file:
+        sizes[int(np.argmax(sizes))] -= 1
+    sizes[int(np.argmax(sizes))] += num_rows_in_file - sizes.sum()
+    return [int(x) for x in sizes if x > 0]
+
+
 def generate_file(
     file_index: int,
     global_row_index: int,
@@ -85,23 +130,27 @@ def generate_file(
     num_row_groups_per_file: int,
     data_dir: str,
     seed: int = 0,
+    max_row_group_skew: float = 0.0,
 ) -> Tuple[str, int]:
     """Generate one Parquet file (reference ``generate_file``,
     ``data_generation.py:30-53``). Returns (filename, in-memory bytes)."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    sizes = row_group_sizes(
+        num_rows_in_file, num_row_groups_per_file, max_row_group_skew,
+        file_index, seed,
+    )
     group_size = max(1, num_rows_in_file // num_row_groups_per_file)
     groups = []
-    for group_index, group_row_index in enumerate(
-        range(0, num_rows_in_file, group_size)
-    ):
-        n = min(group_size, num_rows_in_file - group_row_index)
+    at = 0
+    for group_index, n in enumerate(sizes):
         groups.append(
             generate_row_group(
-                group_index, global_row_index + group_row_index, n, seed
+                group_index, global_row_index + at, n, seed
             )
         )
+        at += n
     columns = {
         name: np.concatenate([g[name] for g in groups])
         for name in groups[0]
@@ -118,17 +167,28 @@ def generate_file(
         # into object storage — symmetric with the URI read side.
         filename = f"{data_dir.rstrip('/')}/input_data_{file_index}.parquet.snappy"
         fs, rel = parquet_filesystem(filename)
+    else:
+        filename = rel = os.path.join(
+            data_dir, f"input_data_{file_index}.parquet.snappy"
+        )
+        fs = None
+    if max_row_group_skew == 0.0:
+        # Identical bytes to the historical uniform writer (gen caches
+        # and pod digests depend on it).
         pq.write_table(
             table, rel, compression="snappy", row_group_size=group_size,
             filesystem=fs,
         )
     else:
-        filename = os.path.join(
-            data_dir, f"input_data_{file_index}.parquet.snappy"
-        )
-        pq.write_table(
-            table, filename, compression="snappy", row_group_size=group_size
-        )
+        # Ragged groups: one write per group (row_group_size can only
+        # express uniform splits).
+        with pq.ParquetWriter(
+            rel, table.schema, compression="snappy", filesystem=fs
+        ) as writer:
+            at = 0
+            for n in sizes:
+                writer.write_table(table.slice(at, n), row_group_size=n)
+                at += n
     return filename, data_size
 
 
@@ -141,8 +201,9 @@ def generate_data(
     seed: int = 0,
 ) -> Tuple[List[str], int]:
     """Generate the synthetic dataset across the worker pool (reference
-    ``generate_data``, ``data_generation.py:13-27``)."""
-    assert max_row_group_skew == 0.0, "row-group skew not implemented"
+    ``generate_data``, ``data_generation.py:13-27``; the reference
+    accepts ``max_row_group_skew`` but never implemented it — here it
+    works, see :func:`row_group_sizes`)."""
     ctx = runtime.ensure_initialized()
     from ray_shuffling_data_loader_tpu.utils import is_remote_path
 
@@ -163,6 +224,7 @@ def generate_data(
                 num_row_groups_per_file,
                 data_dir,
                 seed,
+                max_row_group_skew,
             )
         )
     results = [f.result() for f in futures]

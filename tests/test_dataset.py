@@ -142,3 +142,35 @@ def test_small_reducer_outputs_tail_not_dropped(local_runtime, dataset_files):
     batches = _collect_epoch(ds, 0)
     keys = np.concatenate([b["key"] for b in batches])
     assert sorted(keys.tolist()) == list(range(3000))
+
+
+def test_row_group_skew_generates_ragged_exactly_once(local_runtime, tmp_path):
+    """max_row_group_skew — accepted-but-unimplemented in the reference
+    (data_generation.py:33 TODO) — produces deterministic ragged row
+    groups here, with no row lost."""
+    import pyarrow.parquet as pq
+
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        generate_data,
+        row_group_sizes,
+    )
+
+    files, _ = generate_data(8000, 2, 4, 0.5, str(tmp_path / "skew"))
+    sizes, keys = [], []
+    for f in files:
+        md = pq.ParquetFile(f).metadata
+        sizes.extend(
+            md.row_group(i).num_rows for i in range(md.num_row_groups)
+        )
+        keys.append(
+            np.asarray(pq.read_table(f, columns=["key"]).column("key"))
+        )
+    assert np.array_equal(np.sort(np.concatenate(keys)), np.arange(8000))
+    assert max(sizes) != min(sizes), "skew produced a uniform layout"
+    # Deterministic in (seed, file_index); exact total; bounds checked.
+    assert row_group_sizes(4000, 4, 0.5, 0, 0) == row_group_sizes(
+        4000, 4, 0.5, 0, 0
+    )
+    assert sum(row_group_sizes(4001, 4, 0.9, 3, 7)) == 4001
+    with pytest.raises(ValueError, match="max_row_group_skew"):
+        row_group_sizes(100, 2, 1.5, 0, 0)
