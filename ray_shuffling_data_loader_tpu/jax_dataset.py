@@ -143,7 +143,10 @@ class JaxShufflingDataset:
         prefetch_depth: in-flight device batches (2 = double buffering).
         drop_last: defaults to **True** here (unlike the reference's False,
             ``dataset.py:43``): a ragged final batch would retrigger XLA
-            compilation; opt back in explicitly if you want the tail.
+            compilation; opt back in explicitly if you want the tail. A
+            tail whose row count doesn't divide the data axis arrives
+            REPLICATED (single-process only; pods raise with the remedy)
+            since ``device_put`` cannot shard it evenly.
     """
 
     def __init__(
@@ -247,6 +250,9 @@ class JaxShufflingDataset:
             and label.dtype.itemsize == 4
             and len({a.shape[0] for a in host.values()} | {label.shape[0]})
             == 1
+            # A ragged final partial can't take the row-sharded packed
+            # layout; the per-column path replicates it (see _put).
+            and self._rows_shardable(label.shape[0])
         )
 
         t0 = time.perf_counter()
@@ -377,7 +383,39 @@ class JaxShufflingDataset:
             self._unpack_cache[key] = fn
         return fn
 
+    def _rows_shardable(self, local_rows: int) -> bool:
+        """Can a batch with this many PROCESS-LOCAL rows take the
+        row-sharded layout? Single-process: rows must divide the batch
+        axis. Pods: this process's rows land on its own slice of the
+        batch axis (``make_array_from_process_local_data``), so the
+        constraint is against the LOCAL device count."""
+        shards = self.mesh.shape.get(self.batch_axis, 1)
+        if jax.process_count() > 1:
+            shards = max(1, shards // jax.process_count())
+        return local_rows % shards == 0
+
     def _put(self, arr: np.ndarray):
+        shards = self.mesh.shape.get(self.batch_axis, 1)
+        if not self._rows_shardable(arr.shape[0]):
+            # A drop_last=False final partial that doesn't divide the
+            # data axis: device_put/make_array require exact
+            # divisibility. Single-process delivers it REPLICATED (every
+            # device holds the whole ragged tail — ragged finals
+            # recompile the step anyway, and exactly-once outranks
+            # sharding one small batch). Pods can't (each process holds
+            # only its local rows; replication would need a gather the
+            # loader must not insert) — fail with the remedy.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"final partial batch of {arr.shape[0]} rows does not "
+                    f"divide the {shards}-way '{self.batch_axis}' axis on "
+                    "a multi-controller pod; use drop_last=True (the "
+                    "default) or a batch_size/dataset combination with no "
+                    "partial tail"
+                )
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, P(*([None] * arr.ndim)))
+            )
         sharding = NamedSharding(
             self.mesh, P(self.batch_axis, *([None] * (arr.ndim - 1)))
         )

@@ -214,3 +214,65 @@ def test_packed_staging_float_features(local_runtime, tmp_path):
         assert (vals >= 0).all() and (vals <= 1).all()
         seen_keys.extend(np.asarray(label).tolist())
     assert sorted(seen_keys) == list(range(4000))
+
+
+def test_two_trainer_ranks_disjoint_exactly_once(local_runtime, jax_files):
+    """DP delivery with num_trainers=2 in one process: rank 0 kicks off
+    the shuffle, rank 1 connects by queue name; each rank's stream is
+    drawn from its own (epoch, rank) queue, and the UNION across ranks
+    is the dataset exactly once — disjoint shards, nothing lost to the
+    rank split (reference np.array_split, shuffle.py:125-126)."""
+    import threading
+
+    mesh = make_mesh(model_parallelism=1)
+    feature_columns = ["key"]
+    kwargs = dict(
+        num_epochs=2,
+        num_trainers=2,
+        batch_size=256,
+        feature_columns=feature_columns,
+        label_column=LABEL_COLUMN,
+        num_reducers=4,
+        mesh=mesh,
+        queue_name="q-jax-2rank",
+        seed=5,
+        # Unlike the reference, this layer defaults drop_last=True
+        # (static device shapes); exactly-once across ranks needs the
+        # partial rank tails delivered.
+        drop_last=False,
+    )
+    ds0 = JaxShufflingDataset(jax_files, rank=0, **kwargs)
+    ds1 = JaxShufflingDataset(jax_files, rank=1, **kwargs)  # rank!=0 connects
+    got = {0: [], 1: []}
+    errors = []
+
+    def consume(rank, ds):
+        try:
+            for epoch in range(2):
+                ds.set_epoch(epoch)
+                keys = []
+                for features, label in ds:
+                    keys.append(np.asarray(features["key"]))
+                got[rank].append(np.concatenate(keys) if keys else
+                                 np.array([], dtype=np.int32))
+        except Exception as exc:  # noqa: BLE001
+            errors.append((rank, exc))
+
+    threads = [
+        threading.Thread(target=consume, args=(r, d), daemon=True)
+        for r, d in ((0, ds0), (1, ds1))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not any(t.is_alive() for t in threads), "rank consumption wedged"
+    assert not errors, errors
+    for epoch in range(2):
+        a, b = got[0][epoch], got[1][epoch]
+        assert len(a) > 0 and len(b) > 0, "a rank received no rows"
+        assert len(set(a.tolist()) & set(b.tolist())) == 0, "shards overlap"
+        union = np.sort(np.concatenate([a, b]))
+        assert np.array_equal(union, np.arange(4096)), (
+            f"epoch {epoch}: union across ranks is not exactly-once"
+        )
