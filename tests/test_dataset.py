@@ -174,3 +174,58 @@ def test_row_group_skew_generates_ragged_exactly_once(local_runtime, tmp_path):
     assert sum(row_group_sizes(4001, 4, 0.9, 3, 7)) == 4001
     with pytest.raises(ValueError, match="max_row_group_skew"):
         row_group_sizes(100, 2, 1.5, 0, 0)
+
+
+def test_two_trainer_ranks_disjoint_exactly_once(local_runtime, tmp_path):
+    """Host-level DP delivery, both ranks in one process: rank 0 kicks
+    off the shuffle, rank 1 connects by name; per-epoch union across the
+    ranks is the dataset exactly once, shards disjoint (reference rank
+    split np.array_split, shuffle.py:125-126)."""
+    import threading
+
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+    filenames, _ = generate_data(4000, 4, 1, 0.0, str(tmp_path / "dp2"))
+    kwargs = dict(
+        num_epochs=2,
+        num_trainers=2,
+        batch_size=300,
+        num_reducers=4,
+        queue_name="q-host-2rank",
+        seed=9,
+    )
+    ds0 = ShufflingDataset(filenames, rank=0, **kwargs)
+    ds1 = ShufflingDataset(filenames, rank=1, **kwargs)
+    got = {0: [], 1: []}
+    errors = []
+
+    def consume(rank, ds):
+        try:
+            for epoch in range(2):
+                ds.set_epoch(epoch)
+                keys = [np.asarray(b["key"]) for b in ds]
+                got[rank].append(
+                    np.concatenate(keys)
+                    if keys
+                    else np.array([], dtype=np.int64)
+                )
+        except Exception as exc:  # noqa: BLE001
+            errors.append((rank, exc))
+
+    threads = [
+        threading.Thread(target=consume, args=(r, d), daemon=True)
+        for r, d in ((0, ds0), (1, ds1))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not any(t.is_alive() for t in threads), "rank consumption wedged"
+    assert not errors, errors
+    for epoch in range(2):
+        a, b = got[0][epoch], got[1][epoch]
+        assert len(a) and len(b)
+        assert not set(a.tolist()) & set(b.tolist()), "rank shards overlap"
+        assert np.array_equal(
+            np.sort(np.concatenate([a, b])), np.arange(4000)
+        ), f"epoch {epoch}: union across ranks not exactly-once"
