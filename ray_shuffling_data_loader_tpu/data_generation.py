@@ -89,14 +89,17 @@ def row_group_sizes(
 
     ``max_row_group_skew == 0``: the uniform split (identical to the
     historical layout, so existing generation caches and pod content
-    digests stay valid). ``0 < skew <= 1``: group sizes vary by up to
-    ±skew around the uniform mean, deterministically in
-    ``(seed, file_index)``, summing exactly to ``num_rows_in_file`` —
-    the knob the reference ACCEPTS but never implemented
-    (``data_generation.py:33`` "TODO ... Generate skewed row groups");
-    skewed groups exercise boundary-straddling decode paths (pod
-    row-range staging, row-group-granular mappers) the uniform layout
-    cannot."""
+    digests stay valid). ``0 < skew <= 1``: each group draws a relative
+    weight from ``[1 - skew, 1 + skew]`` (deterministically in
+    ``(seed, file_index)``) and sizes are the weights normalized to sum
+    exactly to ``num_rows_in_file`` — so RELATIVE group sizes differ by
+    up to ``(1+skew)/(1-skew)``, and a single group can exceed
+    ``mean x (1+skew)`` when the other draws are small (size buffers
+    from ``max(sizes)``, not from the weight bound). This is the knob
+    the reference ACCEPTS but never implemented (``data_generation.py:
+    33`` "TODO ... Generate skewed row groups"); skewed groups exercise
+    boundary-straddling decode paths (pod row-range staging,
+    row-group-granular mappers) the uniform layout cannot."""
     if not 0.0 <= max_row_group_skew <= 1.0:
         raise ValueError(
             f"max_row_group_skew must be in [0, 1], got {max_row_group_skew}"

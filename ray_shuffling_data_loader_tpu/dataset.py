@@ -59,6 +59,60 @@ class _ShuffleResult:
             raise self.error
 
 
+class CarryRebatcher:
+    """The exact-``batch_size`` re-batching algebra, isolated.
+
+    Reducer outputs arrive in arbitrary sizes; training wants exact
+    batches with a carry buffer spanning output boundaries (reference
+    ``dataset.py:118-182``, minus its dropped-tail bug at ``:160-168``).
+    Kept free of queue/store machinery so the hypothesis property suite
+    (``tests/test_rebatch_property.py``) drives the PRODUCTION algebra
+    with in-memory outputs — the iterator below feeds it the real
+    stream. ``skip_batches`` counts suppressed batches in yield order
+    (the final partial counts as one batch).
+    """
+
+    def __init__(self, batch_size: int, skip_batches: int = 0):
+        self.batch_size = batch_size
+        self.to_skip = skip_batches
+        self.buf: Optional[ColumnBatch] = None
+
+    def feed(self, cb: ColumnBatch) -> Iterator[ColumnBatch]:
+        """Yield every full batch completed by this reducer output."""
+        batch_size = self.batch_size
+        offset = batch_size - (self.buf.num_rows if self.buf else 0)
+        # Top up the carry buffer with a front slice.
+        self.buf = ColumnBatch.concat([self.buf, cb.slice(0, offset)])
+        if self.buf.num_rows == batch_size:
+            if self.to_skip > 0:
+                self.to_skip -= 1
+            else:
+                yield self.buf
+            self.buf = None
+        # Whole batches straight from this output, then the short tail
+        # into the carry buffer.
+        start = min(offset, cb.num_rows)
+        num_full = (cb.num_rows - start) // batch_size
+        num_skipped = min(self.to_skip, num_full)
+        self.to_skip -= num_skipped
+        for i in range(num_skipped, num_full):
+            lo = start + i * batch_size
+            yield cb.slice(lo, lo + batch_size)
+        tail = start + num_full * batch_size
+        if tail < cb.num_rows:
+            self.buf = cb.slice(tail, cb.num_rows)
+
+    def finish(self, drop_last: bool) -> Optional[ColumnBatch]:
+        """The final partial batch, unless dropped/skipped/empty."""
+        buf, self.buf = self.buf, None
+        if buf is not None and buf.num_rows > 0 and not drop_last:
+            if self.to_skip > 0:
+                self.to_skip -= 1
+                return None
+            return buf
+        return None
+
+
 class ShufflingDataset:
     """A shuffling dataset that yields batches upon iteration.
 
@@ -191,9 +245,8 @@ class ShufflingDataset:
                 "the beginning of each epoch, before iterating over this "
                 "dataset."
             )
-        to_skip = self._skip_batches
         store = runtime.get_context().store
-        buf: Optional[ColumnBatch] = None
+        rebatch = CarryRebatcher(self._batch_size, self._skip_batches)
         is_done = False
         while not is_done:
             pending = self._batch_queue.get_batch(self._rank, self._epoch)
@@ -211,31 +264,7 @@ class ShufflingDataset:
                 cb = store.get_columns(ref)
                 # Segment pages outlive the unlink until views drop.
                 store.free(ref)
-                offset = self._batch_size - (buf.num_rows if buf else 0)
-                # Top up the carry buffer with a front slice.
-                buf = ColumnBatch.concat([buf, cb.slice(0, offset)])
-                if buf.num_rows == self._batch_size:
-                    if to_skip > 0:
-                        to_skip -= 1
-                    else:
-                        yield buf
-                    buf = None
-                # Whole batches straight from this reducer output, then the
-                # short tail into the carry buffer. (The reference's pointer
-                # arithmetic drops the tail whenever a reducer output yields
-                # zero full batches after the buffer top-up —
-                # ``dataset.py:160-168``; fixed here, covered by the
-                # exactly-once tests.)
-                start = min(offset, cb.num_rows)
-                num_full = (cb.num_rows - start) // self._batch_size
-                num_skipped = min(to_skip, num_full)
-                to_skip -= num_skipped
-                for i in range(num_skipped, num_full):
-                    lo = start + i * self._batch_size
-                    yield cb.slice(lo, lo + self._batch_size)
-                tail = start + num_full * self._batch_size
-                if tail < cb.num_rows:
-                    buf = cb.slice(tail, cb.num_rows)
+                yield from rebatch.feed(cb)
                 del cb
 
             if num_outstanding > 0:
@@ -243,11 +272,9 @@ class ShufflingDataset:
                     self._rank, self._epoch, num_outstanding
                 )
 
-        if buf is not None and buf.num_rows > 0 and not self._drop_last:
-            if to_skip > 0:
-                to_skip -= 1
-            else:
-                yield buf
+        final = rebatch.finish(self._drop_last)
+        if final is not None:
+            yield final
         # Ack the producer-done sentinel itself (reference dataset.py:184).
         self._batch_queue.task_done(self._rank, self._epoch, 1)
         self._last_epoch = self._epoch

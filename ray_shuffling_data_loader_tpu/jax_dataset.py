@@ -279,12 +279,15 @@ class JaxShufflingDataset:
                     exc_info=True,
                 )
         if features is None:
+            # True final partial (fewer host rows than the configured
+            # batch): the only case _put may legally replicate.
+            partial = cb.num_rows < self._ds.batch_size
             features = {}
             nbytes = 0
             for col, arr in host.items():
-                features[col] = self._put(arr)
+                features[col] = self._put(arr, partial=partial)
                 nbytes += arr.nbytes
-            label_arr = self._put(label)
+            label_arr = self._put(label, partial=partial)
             nbytes += label.nbytes
         self.stats.put_dispatch_s += time.perf_counter() - t0
         self.stats.bytes_staged += nbytes
@@ -394,10 +397,26 @@ class JaxShufflingDataset:
             shards = max(1, shards // jax.process_count())
         return local_rows % shards == 0
 
-    def _put(self, arr: np.ndarray):
+    def _put(self, arr: np.ndarray, partial: bool = False):
         shards = self.mesh.shape.get(self.batch_axis, 1)
         if not self._rows_shardable(arr.shape[0]):
-            # A drop_last=False final partial that doesn't divide the
+            local = (
+                max(1, shards // jax.process_count())
+                if jax.process_count() > 1
+                else shards
+            )
+            if not partial:
+                # A FULL batch that doesn't divide the axis is a
+                # misconfiguration — silently replicating every batch
+                # would erase data parallelism for the whole run; fail
+                # with the remedy instead (the pre-fix device_put error
+                # said "not evenly divisible" with no guidance).
+                raise ValueError(
+                    f"batch rows ({arr.shape[0]}) do not divide the "
+                    f"{local}-way local '{self.batch_axis}' slice; pick a "
+                    "batch_size divisible by the data-axis device count"
+                )
+            # A drop_last=False FINAL partial that doesn't divide the
             # data axis: device_put/make_array require exact
             # divisibility. Single-process delivers it REPLICATED (every
             # device holds the whole ragged tail — ragged finals
@@ -408,10 +427,10 @@ class JaxShufflingDataset:
             if jax.process_count() > 1:
                 raise ValueError(
                     f"final partial batch of {arr.shape[0]} rows does not "
-                    f"divide the {shards}-way '{self.batch_axis}' axis on "
-                    "a multi-controller pod; use drop_last=True (the "
-                    "default) or a batch_size/dataset combination with no "
-                    "partial tail"
+                    f"divide the {local}-way local '{self.batch_axis}' "
+                    "slice on a multi-controller pod; use drop_last=True "
+                    "(the default) or a batch_size/dataset combination "
+                    "with no partial tail"
                 )
             return jax.device_put(
                 arr, NamedSharding(self.mesh, P(*([None] * arr.ndim)))

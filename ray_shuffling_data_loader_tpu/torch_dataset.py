@@ -98,7 +98,14 @@ class TensorBatchSpec:
         shapes = _broadcast(
             feature_shapes,
             "feature_shapes",
-            lambda s: tuple(s) if isinstance(s, Iterable) else (s,),
+            # None inside the list = this column keeps the default
+            # (-1, 1) view, matching the normalized-list form the
+            # reference API produced.
+            lambda s: (
+                None
+                if s is None
+                else tuple(s) if isinstance(s, Iterable) else (s,)
+            ),
         )
         dtypes = _broadcast(feature_types, "feature_types", lambda d: d)
         features = tuple(

@@ -276,3 +276,25 @@ def test_two_trainer_ranks_disjoint_exactly_once(local_runtime, jax_files):
         assert np.array_equal(union, np.arange(4096)), (
             f"epoch {epoch}: union across ranks is not exactly-once"
         )
+
+
+def test_indivisible_full_batch_raises_clear_error(local_runtime, jax_files):
+    """A FULL batch whose size doesn't divide the data axis is a
+    misconfiguration and must fail with the remedy — not silently
+    replicate away data parallelism for the whole run."""
+    mesh = make_mesh(model_parallelism=1)
+    ds = JaxShufflingDataset(
+        jax_files,
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=100,  # 100 % 8 devices != 0
+        rank=0,
+        feature_columns=["key"],
+        label_column=LABEL_COLUMN,
+        num_reducers=2,
+        mesh=mesh,
+        queue_name="q-jax-indiv",
+    )
+    ds.set_epoch(0)
+    with pytest.raises(ValueError, match="batch_size divisible"):
+        next(iter(ds))

@@ -16,47 +16,29 @@ Randomized structure generation finds the boundary cases enumerated
 tests miss (empty reducer outputs, outputs smaller than the buffer
 top-up, exact-multiple boundaries) — the reference's tail-drop bug
 (``dataset.py:160-168``) is exactly the kind of case this sweeps for.
-The queue/store machinery is bypassed on purpose: the property under
-test is the pure re-batching algebra, driven through the same
-``ColumnBatch.concat``/``slice`` operations the real iterator uses.
+The suite drives the PRODUCTION ``CarryRebatcher`` — the object
+``ShufflingDataset.__iter__`` itself feeds — so the invariants hold for
+the real iterator, not a hand-copied mirror.
 """
 
 import numpy as np
 from hypothesis import given, settings, strategies as st
 
+from ray_shuffling_data_loader_tpu.dataset import CarryRebatcher
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
 
 
 def _rebatch(outputs, batch_size, drop_last=False, skip_batches=0):
-    """The iterator's carry-buffer algebra, isolated — an exact mirror of
-    ``dataset.py:210-251``'s loop over in-memory reducer outputs."""
-    buf = None
-    to_skip = skip_batches
+    """Drive the PRODUCTION re-batcher (the same CarryRebatcher
+    ShufflingDataset.__iter__ feeds with the real stream) over in-memory
+    reducer outputs."""
+    rb = CarryRebatcher(batch_size, skip_batches)
     out = []
     for cb in outputs:
-        offset = batch_size - (buf.num_rows if buf else 0)
-        buf = ColumnBatch.concat([buf, cb.slice(0, offset)])
-        if buf.num_rows == batch_size:
-            if to_skip > 0:
-                to_skip -= 1
-            else:
-                out.append(buf)
-            buf = None
-        start = min(offset, cb.num_rows)
-        num_full = (cb.num_rows - start) // batch_size
-        num_skipped = min(to_skip, num_full)
-        to_skip -= num_skipped
-        for i in range(num_skipped, num_full):
-            lo = start + i * batch_size
-            out.append(cb.slice(lo, lo + batch_size))
-        tail = start + num_full * batch_size
-        if tail < cb.num_rows:
-            buf = cb.slice(tail, cb.num_rows)
-    if buf is not None and buf.num_rows > 0 and not drop_last:
-        if to_skip > 0:
-            to_skip -= 1
-        else:
-            out.append(buf)
+        out.extend(rb.feed(cb))
+    final = rb.finish(drop_last)
+    if final is not None:
+        out.append(final)
     return out
 
 

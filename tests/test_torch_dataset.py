@@ -138,3 +138,16 @@ def test_torch_dataset_end_to_end(local_runtime, tmp_path_factory):
             assert label.dtype == torch.float64
             total += label.shape[0]
         assert total == 2000
+
+
+def test_none_shape_inside_list_defaults():
+    """A None entry in a feature_shapes list keeps that column's default
+    (-1, 1) view (the normalized-list form of the reference API)."""
+    cb = {"a": np.arange(6), "b": np.arange(12).reshape(6, 2),
+          "y": np.zeros(6)}
+    features, label = convert_to_tensor(
+        cb, ["a", "b"], [None, (2,)], [torch.float, torch.float],
+        "y", None, torch.float,
+    )
+    assert features[0].shape == (6, 1)
+    assert features[1].shape == (6, 2)
