@@ -57,6 +57,13 @@ tests_ok() {
 }
 
 while true; do
+  # Never capture concurrently with ANOTHER bench.py (the driver's
+  # round-end run): two benches sharing the core would distort the
+  # artifact that actually counts. Wait for it to finish instead.
+  while pgrep -f "python bench.py" >/dev/null 2>&1; do
+    echo "[watch] foreign bench.py running; standing by $(date -u +%FT%TZ)" >> "$LOG"
+    sleep 60
+  done
   if probe; then
     echo "[watch] TUNNEL UP $(date -u +%FT%TZ) — capturing" >> "$LOG"
     # Capture lock: CPU-heavy side work (the trainer sweep) polls this and
