@@ -14,6 +14,7 @@ assert the two invariants every delivery path must keep:
 Randomness is seeded per test case so a failing interleaving replays.
 """
 
+import os
 import random
 import threading
 import time
@@ -25,6 +26,10 @@ from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue
 pytestmark = pytest.mark.slow
 
 DEADLINE_S = 120.0
+# Soak depth: default 3 seeds per scenario; RSDL_STRESS_SEEDS=N widens
+# the interleaving search (used by long idle-host soaks).
+_N_SEEDS = int(os.environ.get("RSDL_STRESS_SEEDS", "3"))
+_SEEDS = list(range(_N_SEEDS))
 
 
 def _join_threads(threads, deadline_s=DEADLINE_S):
@@ -41,7 +46,7 @@ def _run_threads(threads, deadline_s=DEADLINE_S):
     _join_threads(threads, deadline_s)
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("seed", _SEEDS)
 def test_queue_soak_multi_rank_windowed(local_runtime, seed):
     """4 consumer threads x 6 epochs x window 2, producer jitter vs
     consumer jitter, batched and single puts interleaved. Exercises the
@@ -125,7 +130,7 @@ def test_queue_soak_multi_rank_windowed(local_runtime, seed):
     q.shutdown(force=True, grace_period_s=1)
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("seed", _SEEDS[: max(2, _N_SEEDS // 2)])
 def test_queue_consumer_dies_replacement_drains(local_runtime, seed):
     """A consumer stops acking mid-epoch (simulated death); the epoch
     window must block the producer's NEXT new_epoch until a replacement
@@ -208,7 +213,7 @@ def test_queue_consumer_dies_replacement_drains(local_runtime, seed):
     q.shutdown(force=True, grace_period_s=1)
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("seed", _SEEDS)
 def test_shuffle_delivery_soak_jittery_consumer(local_runtime, seed, tmp_path):
     """End-to-end soak: the real shuffle engine feeding a ShufflingDataset
     consumer whose iteration jitters (random sleeps), across 6 epochs with
