@@ -21,8 +21,16 @@ The suite drives the PRODUCTION ``CarryRebatcher`` — the object
 the real iterator, not a hand-copied mirror.
 """
 
+import os
+
 import numpy as np
 from hypothesis import given, settings, strategies as st
+
+# Depth profiles: default 200 examples; HYPOTHESIS_PROFILE=deep (or the
+# soak runner) sweeps 5000 per property.
+settings.register_profile("default", max_examples=200, deadline=None)
+settings.register_profile("deep", max_examples=5000, deadline=None)
+settings.load_profile(os.environ.get("HYPOTHESIS_PROFILE", "default"))
 
 from ray_shuffling_data_loader_tpu.dataset import CarryRebatcher
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
@@ -69,7 +77,6 @@ def _outputs(sizes):
 
 
 @given(stream_partition(), st.booleans())
-@settings(max_examples=200, deadline=None)
 def test_rebatch_exact_sizes_order_exactly_once(case, drop_last):
     sizes, batch_size = case
     rows, outputs = _outputs(sizes)
@@ -87,7 +94,6 @@ def test_rebatch_exact_sizes_order_exactly_once(case, drop_last):
 
 
 @given(stream_partition(), st.integers(min_value=0, max_value=12))
-@settings(max_examples=200, deadline=None)
 def test_rebatch_skip_batches_is_suffix(case, skip):
     sizes, batch_size = case
     rows, outputs = _outputs(sizes)
