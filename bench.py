@@ -84,6 +84,32 @@ def _mean(xs) -> float:
 METRIC = "Shuffle GB/s/chip + trainer stall % on synthetic Parquet"
 
 
+def _target_context(platform: str) -> str:
+    """Which of the three measurement regimes produced this number, so
+    ``vs_baseline`` cannot be misread across rounds (VERDICT r4 item 7):
+
+    * ``cpu-failover`` — TPU unavailable; target is 0.8x the CPU's own
+      measured H2D. A portable ratio, NOT evidence against the v5e target.
+    * ``tunneled-tpu`` — real chip behind the axon tunnel; peak H2D is
+      tunnel-throttled (r2 measured 1.2 GB/s vs real v5e tens of GB/s),
+      so vs_baseline is against the tunnel ceiling, not silicon's.
+    * ``direct-tpu`` — local TPU runtime; vs_baseline is the real
+      BASELINE.md claim.
+    """
+    forced = os.environ.get("RSDL_BENCH_TARGET_CONTEXT")
+    if forced:
+        # Operator override for tunnels the heuristic below can't see
+        # (it only knows this box's axon markers).
+        return forced
+    if platform != "tpu":
+        return "cpu-failover"
+    axon = os.path.exists(os.path.expanduser("~/.axon_site")) or any(
+        "axon" in (os.environ.get(v) or "")
+        for v in ("JAX_PLATFORMS", "PJRT_DEVICE", "PYTHONPATH")
+    )
+    return "tunneled-tpu" if axon else "direct-tpu"
+
+
 def _error_result(platform, msg: str) -> dict:
     """The failure shape of the one-JSON-line contract (shared by the
     stall watchdog and main()'s last-resort handler so the contract has
@@ -94,6 +120,7 @@ def _error_result(platform, msg: str) -> dict:
         "unit": "GB/s/chip",
         "vs_baseline": 0.0,
         "backend": platform,
+        "target_context": _target_context(platform),
         "error": msg[:300],
     }
     if QUICK:
@@ -289,7 +316,10 @@ def _measure_peak_h2d_gbps(platform: str, budget_s: float = 300.0) -> float:
         )
         result = _error_result(platform, msg)
         print(json.dumps(result), flush=True)
-        os._exit(0)  # the JSON line IS the artifact; cleanup may wedge
+        # Nonzero so rc-keyed tooling (tpu_watch.sh's "rc=$?" log) records
+        # the failed capture truthfully; the JSON error field is still the
+        # primary signal. os._exit because cleanup may wedge on a dead tunnel.
+        os._exit(1)
     return out[0]
 
 
@@ -728,7 +758,11 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                         jax.profiler.stop_trace()
                     except Exception:
                         pass
-                os._exit(0)  # the JSON line IS the contract; rc!=0 reads as a crash
+                # Nonzero rc: same contract as the H2D-probe watchdog —
+                # rc-keyed tooling must record the failed capture
+                # truthfully (the JSON error field stays the primary
+                # signal for bench_ok()-style consumers).
+                os._exit(1)
 
     if watchdog_enabled:
         threading.Thread(
@@ -941,6 +975,16 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "unit": "GB/s/chip",
         "vs_baseline": round(pipeline_gbps / target, 4) if target else 0.0,
         "stall_pct": round(stall_pct, 2),
+        # Attribution (VERDICT r4 item 2): upstream = consumer waited while
+        # the loader had no host batch (epoch window closed / shuffle still
+        # producing); staging = host batch existed, H2D pipeline was behind.
+        # Cross-check against throttle_s (driver-side window-gating time).
+        "stall_upstream_pct": round(
+            100.0 * stats.get("stall_upstream_s", 0.0) / total_s, 2
+        ),
+        "stall_staging_pct": round(
+            100.0 * stats.get("stall_staging_s", 0.0) / total_s, 2
+        ),
         "peak_h2d_gbps": round(peak_gbps, 2),
         "dataset_gb": round(dataset_bytes / 1e9, 3),
         "scaled_down": scaled_down,
@@ -958,6 +1002,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "num_chips": num_chips,
         "host_cpus": os.cpu_count(),
         "backend": platform,
+        "target_context": _target_context(platform),
         "step": (
             f"mock-{mock_step_s}s" if mock_step_s is not None else "real"
         ),

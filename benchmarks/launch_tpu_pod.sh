@@ -12,39 +12,107 @@
 #   TPU_NAME=my-v5e-16 ZONE=us-west4-a ./benchmarks/launch_tpu_pod.sh \
 #       --num-rows 400000000 --num-files 100 --num-trainers 16 \
 #       --num-reducers 48 --num-epochs 10
+#
+# --print-only (first arg): emit the exact gcloud command sequence, one
+# per line, without executing anything — the launcher's logic is testable
+# without pod hardware (VERDICT r4 item 8). The worker count that gcloud
+# would report comes from PRINT_ONLY_WORKERS (default 4); the head join
+# address, unknowable without a live head, is the <HEAD_ADDRESS>
+# placeholder.
 set -euo pipefail
+
+PRINT_ONLY=0
+if [ "${1:-}" = "--print-only" ]; then
+    PRINT_ONLY=1
+    shift
+fi
 
 TPU_NAME=${TPU_NAME:?set TPU_NAME}
 ZONE=${ZONE:?set ZONE}
 REPO_DIR=${REPO_DIR:-"\$HOME/ray_shuffling_data_loader_tpu"}
 HEAD_PORT=${HEAD_PORT:-43211}
 
+ssh_cmd() {  # ssh_cmd <worker-index> <command> -> the argv, one line, quoted
+    printf 'gcloud compute tpus tpu-vm ssh %q --zone %q --worker=%q --command=%q\n' \
+        "$TPU_NAME" "$ZONE" "$1" "$2"
+}
+
+# run_on executes EXACTLY what ssh_cmd prints (eval of the %q-quoted
+# line), so the --print-only output and the tests over it cannot drift
+# from the live command sequence.
 run_on() {  # run_on <worker-index|all> <command>
-    gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" \
-        --worker="$1" --command="$2"
+    eval "$(ssh_cmd "$1" "$2")"
 }
 
 # Head host (worker 0) starts the cluster and prints the join address
 # (tcp://ip:port/token — the token gates the pickle RPC plane).
-ADDRESS=$(run_on 0 "cd $REPO_DIR && python - <<'PY'
+HEAD_CMD="cd $REPO_DIR && python - <<'PY'
 from ray_shuffling_data_loader_tpu import runtime
 ctx = runtime.init_cluster(listen_port=$HEAD_PORT)
 print(ctx.cluster.address, flush=True)
 import time
 time.sleep(86400)  # keep the head alive; benchmark attaches via env
-PY" | tail -1)
-echo "head up at $ADDRESS"
+PY"
+if [ "$PRINT_ONLY" = 1 ]; then
+    ssh_cmd 0 "$HEAD_CMD"
+    ADDRESS="<HEAD_ADDRESS>"
+else
+    # The head command never EOFs (the trailing sleep keeps the cluster
+    # alive for the whole benchmark), so a plain $(...) capture would
+    # block forever. Stream its output to a file in the background and
+    # poll for the printed join address instead.
+    HEAD_LOG=$(mktemp)
+    run_on 0 "$HEAD_CMD" > "$HEAD_LOG" 2>&1 &
+    HEAD_PID=$!
+    ADDRESS=""
+    for _ in $(seq 1 150); do
+        ADDRESS=$(grep -oE 'tcp://[^[:space:]]+' "$HEAD_LOG" | head -1 || true)
+        [ -n "$ADDRESS" ] && break
+        # Fail fast if the head ssh already died (auth failure, bad
+        # REPO_DIR) instead of sleeping out the full timeout.
+        kill -0 "$HEAD_PID" 2>/dev/null || break
+        sleep 2
+    done
+    if [ -z "$ADDRESS" ]; then
+        echo "head never printed a join address; log:" >&2
+        cat "$HEAD_LOG" >&2
+        exit 1
+    fi
+    echo "head up at $ADDRESS"
+fi
 
 # Every other host joins as a worker.
-NUM_WORKERS=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" --zone "$ZONE" \
-    --format="value(networkEndpoints.len())")
+DESCRIBE=(gcloud compute tpus tpu-vm describe "$TPU_NAME" --zone "$ZONE"
+          --format="value(networkEndpoints.len())")
+if [ "$PRINT_ONLY" = 1 ]; then
+    printf '%q ' "${DESCRIBE[@]}"
+    printf '\n'
+    NUM_WORKERS=${PRINT_ONLY_WORKERS:-4}
+else
+    NUM_WORKERS=$("${DESCRIBE[@]}")
+fi
+JOIN_CMD_PREFIX="cd $REPO_DIR && nohup python -m \
+    ray_shuffling_data_loader_tpu.runtime.cluster join"
+JOIN_PIDS=()
 for w in $(seq 1 $((NUM_WORKERS - 1))); do
-    run_on "$w" "cd $REPO_DIR && nohup python -m \
-        ray_shuffling_data_loader_tpu.runtime.cluster join $ADDRESS \
-        > join.log 2>&1 &" &
+    if [ "$PRINT_ONLY" = 1 ]; then
+        ssh_cmd "$w" "$JOIN_CMD_PREFIX $ADDRESS > join.log 2>&1 &"
+    else
+        run_on "$w" "$JOIN_CMD_PREFIX $ADDRESS > join.log 2>&1 &" &
+        JOIN_PIDS+=($!)
+    fi
 done
-wait
-echo "all $NUM_WORKERS hosts joined"
+if [ "$PRINT_ONLY" != 1 ]; then
+    # Wait on the join ssh jobs ONLY: a bare `wait` would also block on
+    # the backgrounded head ssh, which stays alive for the whole run.
+    [ "${#JOIN_PIDS[@]}" -gt 0 ] && wait "${JOIN_PIDS[@]}"
+    echo "all $NUM_WORKERS hosts joined"
+fi
 
 # Benchmark runs on the head, scattering shuffle stages across the pod.
-run_on 0 "cd $REPO_DIR && python benchmarks/benchmark.py --address $ADDRESS $*"
+BENCH_CMD="cd $REPO_DIR && python benchmarks/benchmark.py --address $ADDRESS $*"
+if [ "$PRINT_ONLY" = 1 ]; then
+    ssh_cmd 0 "$BENCH_CMD"
+else
+    run_on 0 "$BENCH_CMD"
+fi

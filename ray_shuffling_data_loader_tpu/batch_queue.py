@@ -57,6 +57,15 @@ class _QueueActor:
             [asyncio.Event() for _ in range(num_trainers)]
             for _ in range(num_epochs)
         ]
+        # Space wakeups for batched producers: every consume sets the
+        # event; a waiting put_batch wakes, re-checks room, and re-arms.
+        # Event.set() resolves ALL current waiters (a later clear() does
+        # not revoke them), so with several blocked producers none can
+        # miss the wakeup — each re-checks room in its own loop turn.
+        self.space_events: List[List[asyncio.Event]] = [
+            [asyncio.Event() for _ in range(num_trainers)]
+            for _ in range(num_epochs)
+        ]
 
     async def new_epoch(self, epoch: int):
         # Admission control: with max_epochs epochs in flight, wait for the
@@ -120,6 +129,7 @@ class _QueueActor:
             )
         loop = asyncio.get_running_loop()
         deadline = None if timeout is None else loop.time() + timeout
+        space = self.space_events[epoch][rank]
         while True:
             # Room check and enqueue in ONE synchronous block — no await
             # between them, so a concurrent producer scheduled in the gap
@@ -131,17 +141,30 @@ class _QueueActor:
                 for item in items:
                     queue.put_nowait(item)
                 return
-            if deadline is not None and loop.time() >= deadline:
-                raise Full
-            await asyncio.sleep(0.005)
+            # Event-driven wait: armed (cleared) atomically with the failed
+            # room check — no await separates them, so a consume landing
+            # after the check sets the event and the wait returns at once.
+            space.clear()
+            if deadline is None:
+                await space.wait()
+            else:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise Full
+                try:
+                    await asyncio.wait_for(space.wait(), remaining)
+                except asyncio.TimeoutError:
+                    raise Full from None
 
     async def get(self, rank, epoch, timeout=None):
         try:
-            return await asyncio.wait_for(
+            item = await asyncio.wait_for(
                 self.queues[epoch][rank].get(), timeout
             )
         except asyncio.TimeoutError:
             raise Empty from None
+        self.space_events[epoch][rank].set()
+        return item
 
     async def get_batch(self, rank, epoch):
         # Block for one item, then opportunistically drain whatever else has
@@ -153,6 +176,7 @@ class _QueueActor:
                 batch.append(queue.get_nowait())
             except asyncio.QueueEmpty:
                 break
+        self.space_events[epoch][rank].set()
         return batch
 
     def put_nowait(self, rank, epoch, item):
@@ -171,7 +195,9 @@ class _QueueActor:
             self.queues[epoch][rank].put_nowait(item)
 
     def get_nowait(self, rank, epoch):
-        return self.queues[epoch][rank].get_nowait()
+        item = self.queues[epoch][rank].get_nowait()
+        self.space_events[epoch][rank].set()
+        return item
 
     def get_nowait_batch(self, rank, epoch, num_items=None):
         if num_items is None:
@@ -181,11 +207,16 @@ class _QueueActor:
                 f"Cannot get {num_items} items from queue of size "
                 f"{self.qsize(rank, epoch)}."
             )
-        return [self.queues[epoch][rank].get_nowait() for _ in range(num_items)]
+        out = [self.queues[epoch][rank].get_nowait() for _ in range(num_items)]
+        self.space_events[epoch][rank].set()
+        return out
 
     def task_done(self, rank, epoch, num_items: int = 1):
         for _ in range(num_items):
             self.queues[epoch][rank].task_done()
+        # Room is qsize-based so task_done frees none, but waking here is
+        # harmless (waiters re-check) and covers consumers that ack late.
+        self.space_events[epoch][rank].set()
 
 
 class BatchQueue:

@@ -16,6 +16,7 @@ from ray_shuffling_data_loader_tpu.parallel.mesh import (  # noqa: F401
 )
 from ray_shuffling_data_loader_tpu.parallel.train import (  # noqa: F401
     TrainState,
+    adasum_reduce,
     bce_loss,
     init_state,
     make_psum_train_step,

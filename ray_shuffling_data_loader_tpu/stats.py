@@ -116,6 +116,10 @@ class StagingStats:
     put_dispatch_s: float = 0.0
     stall_s: float = 0.0
     stalls: int = 0
+    # stall_s split by cause: upstream (no host batch — epoch window /
+    # shuffle) vs staging (H2D pipeline behind). See HostToDeviceStats.
+    stall_upstream_s: float = 0.0
+    stall_staging_s: float = 0.0
     first_batch_s: float = 0.0
     peak_device_bytes_in_use: int = 0
 
@@ -378,6 +382,8 @@ class TrialStatsCollector:
                 put_dispatch_s=float(staging.get("put_dispatch_s", 0.0)),
                 stall_s=float(staging.get("stall_s", 0.0)),
                 stalls=int(staging.get("stalls", 0)),
+                stall_upstream_s=float(staging.get("stall_upstream_s", 0.0)),
+                stall_staging_s=float(staging.get("stall_staging_s", 0.0)),
                 first_batch_s=float(staging.get("first_batch_s", 0.0)),
                 peak_device_bytes_in_use=int(
                     staging.get("peak_device_bytes_in_use", 0)

@@ -311,3 +311,43 @@ def test_pull_from_streaming_batch_queue(local_runtime, make_queue):
     assert done.wait(timeout=30)
     t.join()
     assert sorted(consumed) == data
+
+
+def test_put_batch_small_maxsize_stress(make_queue):
+    """Event-driven producer wakeups under contention (regression for the
+    5 ms poll loop): several threads race timed put_batch calls into a
+    maxsize-2 queue against a slow consumer. Every batch must land intact
+    (all-or-nothing) with no lost wakeup — a missed set() would surface
+    here as a Full timeout despite the consumer draining."""
+    q = make_queue(maxsize=2)
+    n_producers = 4
+    batches_per_producer = 25
+    errors = []
+
+    def producer(pid):
+        try:
+            for i in range(batches_per_producer):
+                q.put_batch(
+                    rank=0, epoch=0, items=[(pid, i, 0), (pid, i, 1)],
+                    timeout=30,
+                )
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=producer, args=(p,))
+        for p in range(n_producers)
+    ]
+    for t in threads:
+        t.start()
+    total = n_producers * batches_per_producer * 2
+    got = [q.get(rank=0, epoch=0, timeout=30) for _ in range(total)]
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not errors
+    assert len(got) == total
+    # Atomicity: the two items of any batch are adjacent in FIFO order
+    # (the enqueue loop never awaits between put_nowait calls).
+    for a, b in zip(got[::2], got[1::2]):
+        assert a[:2] == b[:2] and (a[2], b[2]) == (0, 1)

@@ -15,12 +15,17 @@ EPOCHS=${RSDL_SWEEP_EPOCHS:-10}
 DATA_DIR=${RSDL_SWEEP_DATA:-.bench_cache/sweep5g}
 # Reuse only a COMPLETE dataset: a capture-preempted trial can die
 # mid-generation, and benchmarking a fragment while recording it as the
-# full workload would silently corrupt the rows/s comparison.
-GEN_ARGS=""
-nfiles=$(ls "$DATA_DIR"/*.parquet.snappy 2>/dev/null | wc -l)
-if [ "$nfiles" -ge "$FILES" ]; then
-  GEN_ARGS="--use-old-data"
-elif [ "$nfiles" -gt 0 ]; then
+# full workload would silently corrupt the rows/s comparison. Re-counted
+# before every trial so the first successful generation flips later
+# trials to reuse (and a fragment left by a preempted trial is wiped).
+count_files() {
+  find "$DATA_DIR" -name '*.parquet.snappy' 2>/dev/null | wc -l
+}
+gen_args() {
+  if [ "$(count_files)" -ge "$FILES" ]; then echo "--use-old-data"; fi
+}
+nfiles=$(count_files)
+if [ "$nfiles" -gt 0 ] && [ "$nfiles" -lt "$FILES" ]; then
   echo "[sweep] partial dataset ($nfiles of >=$FILES files); regenerating"
   rm -rf "$DATA_DIR"
 fi
