@@ -103,9 +103,14 @@ def _target_context(platform: str) -> str:
         return forced
     if platform != "tpu":
         return "cpu-failover"
-    axon = os.path.exists(os.path.expanduser("~/.axon_site")) or any(
-        "axon" in (os.environ.get(v) or "")
-        for v in ("JAX_PLATFORMS", "PJRT_DEVICE", "PYTHONPATH")
+    # Deliberate tunnel markers only — an exact platform token, not a
+    # substring scan (a stray "jaxon"/"saxonpy" path must never demote a
+    # real direct-TPU capture to the tunnel regime).
+    platforms = (os.environ.get("JAX_PLATFORMS") or "").split(",")
+    axon = (
+        os.path.isdir(os.path.expanduser("~/.axon_site"))
+        or "axon" in [p.strip() for p in platforms]
+        or (os.environ.get("PJRT_DEVICE") or "").strip().lower() == "axon"
     )
     return "tunneled-tpu" if axon else "direct-tpu"
 
@@ -454,14 +459,19 @@ class _ShmSampler(threading.Thread):
         # NB: not "_stop" — threading.Thread uses that name internally.
         self._halt = threading.Event()
         self.peak_bytes = 0
+        self.peak_spill_bytes = 0
 
     def run(self):
         while not self._halt.wait(self._period):
             try:
                 s = self._store.store_stats()
-                # shm residency only — spilled bytes live on disk.
+                # shm residency only — spilled bytes live on disk and are
+                # tracked separately (capacity-budget evidence).
                 self.peak_bytes = max(
                     self.peak_bytes, s.total_bytes - s.spill_bytes
+                )
+                self.peak_spill_bytes = max(
+                    self.peak_spill_bytes, s.spill_bytes
                 )
             except OSError:
                 pass
@@ -1016,6 +1026,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             stats.get("peak_device_bytes_in_use", 0) / 1e9, 3
         ),
         "peak_shm_gb": round(sampler.peak_bytes / 1e9, 3),
+        "peak_spill_gb": round(sampler.peak_spill_bytes / 1e9, 3),
         **phase,
     }
     if QUICK:

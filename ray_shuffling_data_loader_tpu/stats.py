@@ -104,6 +104,9 @@ class StoreSample:
     timestamp: float
     num_objects: int
     total_bytes: int
+    # Portion of total_bytes living in the disk spill tier (0 when the
+    # capacity budget was never exceeded).
+    spill_bytes: int = 0
 
 
 @dataclass
@@ -173,6 +176,19 @@ class TrialStats:
         return float(np.mean([s.total_bytes for s in self.store_samples]))
 
     @property
+    def max_spill_bytes(self) -> int:
+        return max((s.spill_bytes for s in self.store_samples), default=0)
+
+    @property
+    def max_shm_bytes(self) -> int:
+        """Peak SHARED-MEMORY residency: total minus whatever had spilled
+        at that sample — the number the capacity budget pins."""
+        return max(
+            (s.total_bytes - s.spill_bytes for s in self.store_samples),
+            default=0,
+        )
+
+    @property
     def total_stall_s(self) -> float:
         return sum(s.stall_s for s in self.staging)
 
@@ -200,6 +216,10 @@ class TrialStats:
             "batch_throughput_per_trainer": self.per_trainer_batch_throughput,
             "avg_object_store_utilization": self.avg_store_bytes,
             "max_object_store_utilization": self.max_store_bytes,
+            # Spill-tier evidence (no reference analog — Ray OOMs where
+            # this spills): peak shm residency vs peak bytes on disk.
+            "max_store_shm_bytes": self.max_shm_bytes,
+            "max_store_spill_bytes": self.max_spill_bytes,
         }
 
         def put_agg(name: str, values: Sequence[float]) -> None:
@@ -391,12 +411,15 @@ class TrialStatsCollector:
             )
         )
 
-    def store_sample(self, num_objects: int, total_bytes: int) -> None:
+    def store_sample(
+        self, num_objects: int, total_bytes: int, spill_bytes: int = 0
+    ) -> None:
         self.stats.store_samples.append(
             StoreSample(
                 timestamp=time.time(),
                 num_objects=num_objects,
                 total_bytes=total_bytes,
+                spill_bytes=spill_bytes,
             )
         )
 
@@ -473,12 +496,16 @@ class ObjectStoreStatsCollector:
                 timestamp=time.time(),
                 num_objects=s.num_objects,
                 total_bytes=s.total_bytes,
+                spill_bytes=getattr(s, "spill_bytes", 0),
             )
             self.samples.append(sample)
             if self._collector is not None:
                 try:
                     self._collector.call_oneway(
-                        "store_sample", s.num_objects, s.total_bytes
+                        "store_sample",
+                        sample.num_objects,
+                        sample.total_bytes,
+                        sample.spill_bytes,
                     )
                 except Exception:
                     pass
