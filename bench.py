@@ -96,21 +96,36 @@ def _target_context(platform: str) -> str:
     * ``direct-tpu`` — local TPU runtime; vs_baseline is the real
       BASELINE.md claim.
     """
+    _CONTEXTS = ("cpu-failover", "tunneled-tpu", "direct-tpu")
     forced = os.environ.get("RSDL_BENCH_TARGET_CONTEXT")
     if forced:
-        # Operator override for tunnels the heuristic below can't see
-        # (it only knows this box's axon markers).
+        # Operator override for deployments the heuristic below misreads
+        # (it only knows this box's axon markers). Validated so a typo
+        # cannot stamp an unknown regime into the evidence record.
+        if forced not in _CONTEXTS:
+            raise ValueError(
+                f"RSDL_BENCH_TARGET_CONTEXT={forced!r} is not one of "
+                f"{_CONTEXTS}"
+            )
         return forced
     if platform != "tpu":
         return "cpu-failover"
-    # Deliberate tunnel markers only — an exact platform token, not a
-    # substring scan (a stray "jaxon"/"saxonpy" path must never demote a
-    # real direct-TPU capture to the tunnel regime).
+    # Deliberate tunnel markers only — exact tokens/basenames, not
+    # substring scans (a stray "jaxon"/"saxonpy" path must never demote a
+    # real direct-TPU capture to the tunnel regime). The PYTHONPATH leg
+    # catches a relocated axon site dir (the tunnel injects itself via a
+    # sitecustomize.py on PYTHONPATH and may set no env markers at all).
     platforms = (os.environ.get("JAX_PLATFORMS") or "").split(",")
+    pythonpath = (os.environ.get("PYTHONPATH") or "").split(os.pathsep)
     axon = (
         os.path.isdir(os.path.expanduser("~/.axon_site"))
-        or "axon" in [p.strip() for p in platforms]
+        or "axon" in [p.strip().lower() for p in platforms]
         or (os.environ.get("PJRT_DEVICE") or "").strip().lower() == "axon"
+        or any(
+            os.path.basename(os.path.normpath(e)) == ".axon_site"
+            for e in pythonpath
+            if e
+        )
     )
     return "tunneled-tpu" if axon else "direct-tpu"
 

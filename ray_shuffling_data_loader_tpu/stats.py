@@ -538,6 +538,28 @@ def _write_rows(f, rows: List[Dict], write_header: bool) -> None:
     writer.writerows(rows)
 
 
+def _check_append_schema(header_line: str, rows: List[Dict], path: str) -> None:
+    """Appending headerless rows under an OLD header silently shifts every
+    value after a schema change — corrupted CSVs with no error. Refuse
+    instead: the operator overwrites or picks a fresh stats dir."""
+    existing = next(csv.reader([header_line])) if header_line.strip() else []
+    current = list(rows[0].keys())
+    if existing != current:
+        diff = "existing header is empty"
+        for i in range(max(len(existing), len(current))):
+            a = existing[i] if i < len(existing) else "<missing>"
+            b = current[i] if i < len(current) else "<missing>"
+            if a != b:
+                diff = f"first difference at column {i}: {a!r} vs {b!r}"
+                break
+        raise ValueError(
+            f"cannot append to {path}: its header ({len(existing)} cols) "
+            f"does not match the current stats schema ({len(current)} "
+            f"cols; {diff}). The file predates a schema change — use "
+            "overwrite_stats=True or a new stats dir."
+        )
+
+
 def _write_csv(path: str, rows: List[Dict], overwrite: bool) -> None:
     if not rows:
         return
@@ -556,11 +578,16 @@ def _write_csv(path: str, rows: List[Dict], overwrite: bool) -> None:
         else:
             with fsspec.open(path, "r", newline="") as f:
                 existing = f.read()
+            lines = existing.splitlines()
+            _check_append_schema(lines[0] if lines else "", rows, path)
             with fsspec.open(path, "w", newline="") as f:
                 f.write(existing)
                 _write_rows(f, rows, write_header=False)
         return
     write_header = overwrite or not os.path.exists(path)
+    if not write_header:
+        with open(path, newline="") as f:
+            _check_append_schema(f.readline(), rows, path)
     with open(path, "w" if overwrite else "a", newline="") as f:
         _write_rows(f, rows, write_header)
 

@@ -298,3 +298,34 @@ def test_indivisible_full_batch_raises_clear_error(local_runtime, jax_files):
     ds.set_epoch(0)
     with pytest.raises(ValueError, match="batch_size divisible"):
         next(iter(ds))
+
+
+def test_stall_decomposition_accounts_for_all_stall(local_runtime, jax_files):
+    """stall_s must equal stall_upstream_s + stall_staging_s (same
+    increment site), and a deliberately slow consumer registers no stall
+    at all (the ring is always ahead of it)."""
+    import time
+
+    mesh = make_mesh(model_parallelism=1)
+    ds = JaxShufflingDataset(
+        jax_files,
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=512,
+        rank=0,
+        feature_columns=["key"],
+        label_column=LABEL_COLUMN,
+        num_reducers=2,
+        mesh=mesh,
+        queue_name="q-jax-stall",
+        seed=5,
+    )
+    ds.set_epoch(0)
+    for _features, _label in ds:
+        time.sleep(0.05)  # consumer is the bottleneck
+    stats = ds.stats.as_dict()
+    assert stats["stall_s"] == pytest.approx(
+        stats["stall_upstream_s"] + stats["stall_staging_s"], abs=1e-9
+    )
+    # The slow consumer never outran the prefetch ring on this workload.
+    assert stats["stall_s"] < 0.5

@@ -294,6 +294,17 @@ def test_process_stats_writes_csvs(tmp_path):
         rows = list(csv.DictReader(f))
     assert len(rows) == 2
 
+    # Appending under a STALE header (file predates a schema change) must
+    # refuse loudly — headerless rows in a new column order would land
+    # values under the wrong headers with no error.
+    with open(tmp_path / "trial_stats.csv") as f:
+        lines = f.read().splitlines()
+    old_header = ",".join(lines[0].split(",")[:-2])  # drop two columns
+    with open(tmp_path / "trial_stats.csv", "w") as f:
+        f.write("\n".join([old_header] + lines[1:]) + "\n")
+    with pytest.raises(ValueError, match="does not match"):
+        process_stats([stats], stats_dir=str(tmp_path), overwrite_stats=False)
+
 
 def test_store_stats_sampler(local_runtime):
     import numpy as np
