@@ -93,12 +93,34 @@ def parse_args(argv=None):
         "to fit the device budget), 'mapreduce' is the general host "
         "pipeline, 'auto' picks resident when it fits.",
     )
+    # Gradient plane (reference: Horovod op=Average/Adasum + fp16
+    # compression flags, ray_torch_shuffle.py:183-193).
+    p.add_argument(
+        "--grad-reduce",
+        choices=("pjit", "mean", "adasum"),
+        default="pjit",
+        help="'pjit' (default): sharding-driven step, XLA derives the "
+        "all-reduce. 'mean'/'adasum': the explicit shard_map step with a "
+        "hand-written collective — 'adasum' is the hvd.Adasum analog "
+        "(adaptive summation). Both need --model-parallelism 1 "
+        "(replicated params).",
+    )
+    p.add_argument(
+        "--grad-bf16",
+        action="store_true",
+        help="bf16 gradient wire compression (the fp16-compression "
+        "analog; explicit --grad-reduce modes only).",
+    )
     p.add_argument(
         "--smoke",
         action="store_true",
         help="Tiny CI workload preset (overrides the size knobs).",
     )
     args = p.parse_args(argv)
+    if args.grad_reduce != "pjit" and args.model_parallelism != 1:
+        p.error("--grad-reduce mean/adasum requires --model-parallelism 1")
+    if args.grad_bf16 and args.grad_reduce == "pjit":
+        p.error("--grad-bf16 needs an explicit mode (--grad-reduce mean/adasum)")
     if args.smoke:
         args.num_rows = 50_000
         args.num_files = 4
@@ -226,7 +248,28 @@ def main(argv=None) -> int:
         c: jnp.zeros((args.batch_size,), jnp.int32) for c in feature_columns
     }
     state, state_shardings = init_state(model, optimizer, mesh, example)
-    train_step = make_train_step(model, optimizer, mesh, state_shardings)
+    if args.grad_reduce == "pjit":
+        train_step = make_train_step(model, optimizer, mesh, state_shardings)
+    else:
+        # Explicit gradient plane (replicated params): hand-written
+        # pmean or Adasum collective under shard_map — the literal
+        # Horovod-allreduce analog, selectable like the reference's
+        # op=Average/Adasum flag (ray_torch_shuffle.py:183-193).
+        from ray_shuffling_data_loader_tpu.parallel import (
+            make_psum_train_step,
+        )
+
+        train_step = make_psum_train_step(
+            model,
+            optimizer,
+            mesh,
+            grad_dtype=jnp.bfloat16 if args.grad_bf16 else None,
+            grad_reduce=args.grad_reduce,
+        )
+        print(
+            f"gradient plane: explicit {args.grad_reduce}"
+            + (" + bf16 wire" if args.grad_bf16 else "")
+        )
 
     # Compile off the hot path, with inputs placed exactly as real batches
     # will arrive (committed + mesh-sharded). AOT lower/compile: no

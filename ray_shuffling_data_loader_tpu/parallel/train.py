@@ -217,6 +217,7 @@ def make_psum_train_step(
     mesh: Mesh,
     grad_dtype: Optional[Any] = None,
     grad_reduce: str = "mean",
+    donate_state: bool = True,
 ) -> Callable:
     """Explicit-DP train step: per-device compute under ``shard_map`` with a
     hand-written ``lax.psum`` gradient exchange over ICI — the literal
@@ -239,6 +240,11 @@ def make_psum_train_step(
     of the reference's ``hvd.Adasum`` option. With ``grad_dtype`` set the
     exchange still rides the reduced dtype; the Adasum dot products are
     computed in f32.
+
+    ``donate_state``: donate the input state's buffers (default, matching
+    :func:`make_train_step`) so a step never holds two copies of params +
+    optimizer state; pass ``False`` to keep reusing the input state
+    object after the call.
     """
     from jax import shard_map
 
@@ -286,4 +292,7 @@ def make_psum_train_step(
         out_specs=(rep, rep),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # State donation, like make_train_step: without it each step holds TWO
+    # copies of params + optimizer state in HBM. donate_state=False only
+    # for callers that reuse the input state object after the call.
+    return jax.jit(sharded, donate_argnums=(0,) if donate_state else ())

@@ -7,6 +7,10 @@ cd "$(dirname "$0")"
 export JAX_PLATFORMS=cpu
 python examples/train_dlrm.py --smoke
 python examples/train_dlrm.py --smoke --loader resident --model transformer
+# 2 devices: one full butterfly round + the bf16 wire path at a fraction
+# of the 8-device cost (8 virtual devices on shared cores is ~6 min).
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python examples/train_dlrm.py --smoke --grad-reduce adasum --grad-bf16
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python examples/train_long_context.py --dp 2 --sp 4 --steps 8 \
     --seq-len 256
