@@ -14,6 +14,7 @@ from ray_shuffling_data_loader_tpu.models import (
 from ray_shuffling_data_loader_tpu.parallel import (
     DATA_AXIS,
     MODEL_AXIS,
+    adasum_reduce,
     batch_sharding,
     init_state,
     make_mesh,
@@ -210,7 +211,6 @@ def test_adasum_reduce_orthogonal_adds_parallel_averages():
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from ray_shuffling_data_loader_tpu.parallel import adasum_reduce
 
     mesh = make_mesh(model_parallelism=1)
     n = mesh.shape[DATA_AXIS]
@@ -302,3 +302,14 @@ def test_adasum_step_trains():
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
     assert all(np.isfinite(losses))
+
+
+def test_gradient_reduce_option_validation():
+    """Config errors fail fast with actionable messages."""
+    mesh = make_mesh(model_parallelism=1)
+    model = small_model()
+    opt = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="grad_reduce"):
+        make_psum_train_step(model, opt, mesh, grad_reduce="median")
+    with pytest.raises(ValueError, match="power-of-two"):
+        adasum_reduce({"g": jnp.ones(3)}, DATA_AXIS, 6)
