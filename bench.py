@@ -84,7 +84,10 @@ def _mean(xs) -> float:
 METRIC = "Shuffle GB/s/chip + trainer stall % on synthetic Parquet"
 
 
-def _target_context(platform: str) -> str:
+_TARGET_CONTEXTS = ("cpu-failover", "tunneled-tpu", "direct-tpu")
+
+
+def _target_context(platform: str, strict: bool = True) -> str:
     """Which of the three measurement regimes produced this number, so
     ``vs_baseline`` cannot be misread across rounds (VERDICT r4 item 7):
 
@@ -95,31 +98,41 @@ def _target_context(platform: str) -> str:
       so vs_baseline is against the tunnel ceiling, not silicon's.
     * ``direct-tpu`` — local TPU runtime; vs_baseline is the real
       BASELINE.md claim.
+
+    ``strict=False`` (the error-JSON path) falls back to the heuristic on
+    a bad ``RSDL_BENCH_TARGET_CONTEXT`` instead of raising: the watchdogs
+    call this while PRINTING the failure artifact, and a typo'd override
+    must never be able to break the error-JSON contract (ADVICE r5).
+    main() validates the override up front, so strict failures surface
+    before any work runs.
     """
-    _CONTEXTS = ("cpu-failover", "tunneled-tpu", "direct-tpu")
     forced = os.environ.get("RSDL_BENCH_TARGET_CONTEXT")
     if forced:
         # Operator override for deployments the heuristic below misreads
         # (it only knows this box's axon markers). Validated so a typo
         # cannot stamp an unknown regime into the evidence record.
-        if forced not in _CONTEXTS:
+        if forced in _TARGET_CONTEXTS:
+            return forced
+        if strict:
             raise ValueError(
                 f"RSDL_BENCH_TARGET_CONTEXT={forced!r} is not one of "
-                f"{_CONTEXTS}"
+                f"{_TARGET_CONTEXTS}"
             )
-        return forced
+        # Non-strict: ignore the bad override and classify heuristically.
     if platform != "tpu":
         return "cpu-failover"
-    # Deliberate tunnel markers only — exact tokens/basenames, not
+    # Deliberate ACTIVE tunnel markers only — exact tokens/basenames, not
     # substring scans (a stray "jaxon"/"saxonpy" path must never demote a
-    # real direct-TPU capture to the tunnel regime). The PYTHONPATH leg
-    # catches a relocated axon site dir (the tunnel injects itself via a
-    # sitecustomize.py on PYTHONPATH and may set no env markers at all).
+    # real direct-TPU capture to the tunnel regime), and not mere
+    # existence of ~/.axon_site on disk (ADVICE r5: a tunnel-equipped
+    # host running a genuine direct TPU runtime must not be permanently
+    # labeled tunnel-throttled). The PYTHONPATH leg catches a relocated
+    # axon site dir (the tunnel injects itself via a sitecustomize.py on
+    # PYTHONPATH and may set no env markers at all).
     platforms = (os.environ.get("JAX_PLATFORMS") or "").split(",")
     pythonpath = (os.environ.get("PYTHONPATH") or "").split(os.pathsep)
     axon = (
-        os.path.isdir(os.path.expanduser("~/.axon_site"))
-        or "axon" in [p.strip().lower() for p in platforms]
+        "axon" in [p.strip().lower() for p in platforms]
         or (os.environ.get("PJRT_DEVICE") or "").strip().lower() == "axon"
         or any(
             os.path.basename(os.path.normpath(e)) == ".axon_site"
@@ -140,7 +153,7 @@ def _error_result(platform, msg: str) -> dict:
         "unit": "GB/s/chip",
         "vs_baseline": 0.0,
         "backend": platform,
-        "target_context": _target_context(platform),
+        "target_context": _target_context(platform, strict=False),
         "error": msg[:300],
     }
     if QUICK:
@@ -336,6 +349,7 @@ def _measure_peak_h2d_gbps(platform: str, budget_s: float = 300.0) -> float:
         )
         result = _error_result(platform, msg)
         print(json.dumps(result), flush=True)
+        _export_telemetry_for_exit()
         # Nonzero so rc-keyed tooling (tpu_watch.sh's "rc=$?" log) records
         # the failed capture truthfully; the JSON error field is still the
         # primary signal. os._exit because cleanup may wedge on a dead tunnel.
@@ -460,6 +474,65 @@ def _kernel_microchecks(budget_s: float = 240.0) -> dict:
         snap["hung"] = f">{budget_s:.0f}s (left on watchdog thread)"
         return snap
     return out
+
+
+# Stop callables for the sampler threads run_bench starts. run_bench pops
+# them on its straight-line teardown; main()'s error path pops whatever is
+# left BEFORE exporting the trace/metrics artifacts, so an orphaned 1 Hz
+# sampler cannot race the export of exactly the failed run whose artifacts
+# matter most.
+_LIVE_SAMPLERS: list = []
+
+
+def _stop_live_samplers() -> None:
+    # pop-until-empty, not check-then-pop: main's error path and a
+    # watchdog thread can drain this list concurrently (both react to the
+    # same wedge), and the loser of a check/pop race must exit the loop,
+    # not die on IndexError before its export/JSON contract work.
+    while True:
+        try:
+            stop = _LIVE_SAMPLERS.pop()
+        except IndexError:
+            return
+        try:
+            stop()
+        except Exception:
+            pass
+
+
+# Artifact paths for the watchdogs' hard-exit path, set by main() when
+# --trace-out is given: os._exit skips atexit and main()'s export block,
+# and the trace of a wedged run is the one artifact that shows WHERE it
+# wedged. [trace_out, metrics_out].
+_TELEMETRY_EXIT_PATHS: list = [None, None]
+
+
+def _export_telemetry_for_exit() -> None:
+    """Best-effort trace/metrics export before a watchdog os._exit. Never
+    touches cross-process metrics sources (the wedged actor could hang
+    this very exit) — the trace spool and sampled timeline are local."""
+    from ray_shuffling_data_loader_tpu import telemetry
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+    # Uphold stop-before-export on the watchdog paths too (sampler stops
+    # join with a timeout, so this cannot wedge the exit). The two
+    # artifacts are guarded independently, like main()'s export block —
+    # a full/read-only trace volume must not also cost the metrics dump.
+    _stop_live_samplers()
+    try:
+        if telemetry.enabled():
+            telemetry.flush()
+            if _TELEMETRY_EXIT_PATHS[0]:
+                telemetry.trace_export(_TELEMETRY_EXIT_PATHS[0])
+    except Exception:
+        pass
+    try:
+        if _metrics.enabled() and _TELEMETRY_EXIT_PATHS[1]:
+            _metrics.dump_json(
+                _TELEMETRY_EXIT_PATHS[1], include_sources=False
+            )
+    except Exception:
+        pass
 
 
 class _ShmSampler(threading.Thread):
@@ -741,6 +814,24 @@ def run_bench(platform: str, num_chips: int, tpu_error):
 
     sampler = _ShmSampler(ctx.store)
     sampler.start()
+    _LIVE_SAMPLERS.append(sampler.stop)
+
+    # Live-metrics sampler (telemetry): only when the metrics half is on
+    # (bench --trace-out / RSDL_METRICS=1). Feeds the batch-queue depth
+    # source + store gauges into the sampled timeline that
+    # telemetry.metrics.dump_json() writes next to the trace artifact.
+    from ray_shuffling_data_loader_tpu.stats import ObjectStoreStatsCollector
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+    metrics_sampler = None
+    if _metrics.enabled():
+        metrics_sampler = ObjectStoreStatsCollector(
+            collector, sample_period_s=1.0
+        )
+        metrics_sampler.__enter__()
+        _LIVE_SAMPLERS.append(
+            lambda: metrics_sampler.__exit__(None, None, None)
+        )
 
     # Optional trace (SURVEY §5 tracing): RSDL_PROFILE_DIR=/tmp/trace
     # wraps the measured region in a jax.profiler trace for xprof.
@@ -783,6 +874,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                         jax.profiler.stop_trace()
                     except Exception:
                         pass
+                _export_telemetry_for_exit()
                 # Nonzero rc: same contract as the H2D-probe watchdog —
                 # rc-keyed tooling must record the failed capture
                 # truthfully (the JSON error field stays the primary
@@ -940,6 +1032,12 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             1,
             name="bench-stats-fallback",
         )
+        if metrics_sampler is not None:
+            # The 1 Hz metrics sampler captured the ORIGINAL collector
+            # handle; re-point it so the failover run — exactly the one
+            # whose live-metrics series is diagnostically interesting —
+            # doesn't forward samples to the abandoned actor.
+            metrics_sampler.set_collector(collector)
         use_resident = False
         # Fresh model/optimizer state: the failed resident attempt already
         # trained on some batches (donate_state=False keeps its state
@@ -958,7 +1056,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         jax.block_until_ready(state.params)
     if profile_dir:
         jax.profiler.stop_trace()
-    sampler.stop()
+    _stop_live_samplers()
 
     stats = ds.stats.as_dict()
     staged_gb = stats["bytes_staged"] / 1e9
@@ -1055,7 +1153,97 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     return result
 
 
+def _parse_args(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "--trace-out",
+        default=os.environ.get("RSDL_TRACE_OUT") or None,
+        help="write a merged Chrome-trace/Perfetto JSON of the whole run "
+        "here (enables tracing + live metrics; see docs/observability.md)",
+    )
+    parser.add_argument(
+        "--metrics-out",
+        default=os.environ.get("RSDL_METRICS_OUT") or None,
+        help="write the sampled metrics timeline + final snapshot JSON "
+        "here (default: <trace-out>.metrics.json when --trace-out is set)",
+    )
+    try:
+        return parser.parse_args(argv)
+    except SystemExit as exc:
+        # argparse already printed usage to stderr; keep the one-JSON-line
+        # stdout contract for genuine errors (--help exits 0, no JSON).
+        if exc.code not in (0, None):
+            print(
+                json.dumps(
+                    _error_result(
+                        "unknown",
+                        "bad command line: "
+                        + " ".join(sys.argv[1:])[:200],
+                    )
+                ),
+                flush=True,
+            )
+        raise
+
+
 def main() -> None:
+    args = _parse_args()
+    # Fail fast on a typo'd regime override (ADVICE r5): before this ran
+    # only at result-assembly time — after the full benchmark on a healthy
+    # run, and inside the watchdogs' error paths on a wedged one, where
+    # the raise broke the error-JSON contract entirely.
+    forced = os.environ.get("RSDL_BENCH_TARGET_CONTEXT")
+    if forced and forced not in _TARGET_CONTEXTS:
+        print(
+            json.dumps(
+                _error_result(
+                    "unknown",
+                    f"RSDL_BENCH_TARGET_CONTEXT={forced!r} is not one of "
+                    f"{_TARGET_CONTEXTS}",
+                )
+            ),
+            flush=True,
+        )
+        sys.exit(1)
+
+    from ray_shuffling_data_loader_tpu import telemetry
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+    metrics_out = args.metrics_out
+    if args.trace_out:
+        # Enable BEFORE any runtime bring-up so every spawned worker and
+        # actor inherits the spool dir through the environment.
+        spool = args.trace_out + ".spool"
+        # Drop spool files left by a previous run with the same
+        # --trace-out: flush appends and trace_export merges every
+        # trace-*.jsonl it finds, so stale files would splice the old
+        # run's spans (possibly under reused pids) into the new artifact.
+        if os.path.isdir(spool):
+            for fname in os.listdir(spool):
+                if fname.startswith("trace-") and fname.endswith(".jsonl"):
+                    try:
+                        os.unlink(os.path.join(spool, fname))
+                    except OSError:
+                        pass
+        telemetry.enable(spool_dir=spool)
+        _metrics.enable()
+        telemetry.set_process_name("bench-driver")
+        telemetry.set_context(trial=0)
+        if metrics_out is None:
+            metrics_out = args.trace_out + ".metrics.json"
+        _TELEMETRY_EXIT_PATHS[0] = args.trace_out
+        _TELEMETRY_EXIT_PATHS[1] = metrics_out
+    elif metrics_out:
+        # --metrics-out alone is an explicit opt-in to the metrics half;
+        # without this the guard below would silently skip the requested
+        # artifact.
+        _metrics.enable()
+        _TELEMETRY_EXIT_PATHS[1] = metrics_out
+
     platform, num_chips, tpu_error = init_backend()
     try:
         result = run_bench(platform, num_chips, tpu_error)
@@ -1066,6 +1254,29 @@ def main() -> None:
         result = _error_result(platform, f"{type(exc).__name__}: {exc}")
         if tpu_error is not None:
             result["tpu_error"] = str(tpu_error)[:300]
+    # Stop any sampler threads run_bench left running (it only reaches its
+    # own teardown on the straight-line path) so the exports below cannot
+    # race a live sampler appending to the metrics timeline.
+    _stop_live_samplers()
+    # Export the trace/metrics artifacts even for a failed run — the
+    # trace of a failed run is the artifact that shows where it died.
+    # Guarded: artifact export must never break the one-JSON-line
+    # contract.
+    if args.trace_out:
+        try:
+            result["trace_out"] = telemetry.trace_export(args.trace_out)
+        except Exception as exc:
+            result["trace_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    if metrics_out and _metrics.enabled():
+        try:
+            # On a failed run the batch-queue source's actor may be wedged
+            # rather than dead, and a source poll blocks with no timeout —
+            # restrict the final snapshot to local instruments there.
+            result["metrics_out"] = _metrics.dump_json(
+                metrics_out, include_sources="error" not in result
+            )
+        except Exception as exc:
+            result["metrics_error"] = f"{type(exc).__name__}: {exc}"[:200]
     print(json.dumps(result), flush=True)
 
 

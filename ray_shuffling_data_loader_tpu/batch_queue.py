@@ -25,6 +25,7 @@ from collections.abc import Iterable
 from typing import Any, Dict, List, Optional
 
 from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 
 
 class Empty(Exception):
@@ -218,6 +219,23 @@ class _QueueActor:
         # harmless (waiters re-check) and covers consumers that ack late.
         self.space_events[epoch][rank].set()
 
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """Live per-``(epoch, rank)`` queue depths in the metrics-registry
+        key vocabulary — polled by the driver's metrics sampler through a
+        registered source (:func:`telemetry.metrics.register_source`).
+        Only in-flight epochs (the admission window) are keyed
+        individually, bounding the series to ``max_epochs x trainers``."""
+        out: Dict[str, float] = {}
+        for epoch in self.curr_epochs:
+            for rank, q in enumerate(self.queues[epoch]):
+                out[
+                    _metrics.format_key(
+                        "queue.depth", {"epoch": epoch, "rank": rank}
+                    )
+                ] = float(q.qsize())
+        out["queue.depth.total"] = float(self.size())
+        return out
+
 
 class BatchQueue:
     """Client-side handle; sync and async, single and batched operations.
@@ -239,6 +257,7 @@ class BatchQueue:
         connect_retries: int = 5,
     ) -> None:
         runtime.ensure_initialized()
+        self._metrics_source: Optional[str] = None
         if connect:
             assert name is not None
             self.actor = runtime.connect_actor(name, num_retries=connect_retries)
@@ -251,12 +270,27 @@ class BatchQueue:
                 maxsize,
                 name=name,
             )
+            if _metrics.enabled():
+                # Cross-process metrics source: the sampler thread pulls
+                # the actor's live per-(epoch, rank) depths into every
+                # global_snapshot. Dropped automatically if the actor dies
+                # (source failure limit), and explicitly on shutdown().
+                actor = self.actor
+                self._metrics_source = (
+                    f"batch_queue:{name or DEFAULT_QUEUE_NAME}-{id(self)}"
+                )
+                _metrics.register_source(
+                    self._metrics_source,
+                    lambda: actor.call("metrics_snapshot"),
+                )
 
     def __getstate__(self):
         return {"actor": self.actor}
 
     def __setstate__(self, state):
         self.actor = state["actor"]
+        # The metrics source is owned by the creating process only.
+        self._metrics_source = None
 
     def ready(self) -> None:
         """Block until the queue actor is up (reference ``batch_queue.py:67``)."""
@@ -376,6 +410,9 @@ class BatchQueue:
     def shutdown(self, force: bool = False, grace_period_s: int = 5) -> None:
         """Graceful-then-forceful actor termination (reference
         ``batch_queue.py:333-355``)."""
+        if self._metrics_source is not None:
+            _metrics.unregister_source(self._metrics_source)
+            self._metrics_source = None
         if self.actor:
             self.actor.terminate(force=force, grace_period_s=grace_period_s)
         self.actor = None

@@ -38,8 +38,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_shuffling_data_loader_tpu import telemetry
 from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 
 
 def _default_device_dtype(np_dtype: np.dtype) -> jnp.dtype:
@@ -220,6 +222,18 @@ class JaxShufflingDataset:
         self._unpack_cache: Dict[Any, Any] = {}
         self._packed_ok = True
         self.stats = HostToDeviceStats()
+        # Pre-resolved H2D instruments: _stage runs per batch on the
+        # staging hot path; instruments are registry singletons, so hoist
+        # the keyed lookups (format_key + registry lock) out of it.
+        if _metrics.enabled():
+            reg = _metrics.registry
+            self._h2d_bytes = reg.counter("h2d.bytes")
+            self._h2d_batches = reg.counter("h2d.batches")
+            self._h2d_dispatch_s = reg.histogram("h2d.dispatch_seconds")
+        else:
+            self._h2d_bytes = None
+            self._h2d_batches = None
+            self._h2d_dispatch_s = None
 
     # -- spec application ---------------------------------------------------
 
@@ -301,9 +315,14 @@ class JaxShufflingDataset:
                 nbytes += arr.nbytes
             label_arr = self._put(label, partial=partial)
             nbytes += label.nbytes
-        self.stats.put_dispatch_s += time.perf_counter() - t0
+        dispatch_s = time.perf_counter() - t0
+        self.stats.put_dispatch_s += dispatch_s
         self.stats.bytes_staged += nbytes
         self.stats.batches_staged += 1
+        if self._h2d_bytes is not None:
+            self._h2d_bytes.inc(nbytes)
+            self._h2d_batches.inc()
+            self._h2d_dispatch_s.observe(dispatch_s)
         if self.stats.batches_staged % 8 == 0:
             self.stats.sample_device_memory()
         return features, label_arr
@@ -490,6 +509,13 @@ class JaxShufflingDataset:
         cancel = threading.Event()
         error: List[BaseException] = []
         epoch_start = time.perf_counter()
+        epoch = self._ds._epoch  # pinned before iteration starts
+        if _metrics.enabled():
+            # Resolve the stall counters up front so the stall-by-cause
+            # series exists in every snapshot, zeros included — a run with
+            # no stalls should report 0.0, not a missing key.
+            _metrics.registry.counter("stall_seconds", cause="upstream")
+            _metrics.registry.counter("stall_seconds", cause="staging")
 
         # Stall attribution: the stager publishes which pipeline phase it
         # is in; a consumer stall is charged to the phase observed when
@@ -510,7 +536,14 @@ class JaxShufflingDataset:
                         # can advance; stage nothing more to HBM.
                         continue
                     phase[0] = "staging"
-                    item = self._stage(cb)
+                    with telemetry.trace_span(
+                        "stage:h2d",
+                        cat="staging",
+                        epoch=epoch,
+                        batch=self.stats.batches_staged,
+                        rows=cb.num_rows,
+                    ):
+                        item = self._stage(cb)
                     while not cancel.is_set():
                         try:
                             ring.put(item, timeout=0.1)
@@ -558,6 +591,21 @@ class JaxShufflingDataset:
                         self.stats.stall_staging_s += waited
                     else:
                         self.stats.stall_upstream_s += waited
+                    # Same increment, telemetry vocabulary: a span on the
+                    # consumer thread's timeline plus the stall-by-cause
+                    # counter (both no-op when their half is disabled).
+                    telemetry.record_span(
+                        "stall",
+                        time.time() - waited,
+                        waited,
+                        cat="staging",
+                        epoch=epoch,
+                        cause=phase_at_wait,
+                    )
+                    if _metrics.enabled():
+                        _metrics.registry.counter(
+                            "stall_seconds", cause=phase_at_wait
+                        ).inc(waited)
                 if item is SENTINEL:
                     break
                 yield item

@@ -25,6 +25,7 @@ so the same actor code serves as the multi-host control plane over DCN.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import multiprocessing as mp
 import os
@@ -36,8 +37,43 @@ import time
 import traceback
 from typing import Any, Dict, Optional
 
+from ray_shuffling_data_loader_tpu import telemetry
+
 from . import transport
 from .transport import Address
+
+
+# The caller's trace context to ship with a request frame, or None when
+# tracing is off (the common case — one cached boolean check).
+_trace_ctx = telemetry.outbound_context
+
+
+# Virtual thread ids for traced dispatches: concurrent dispatches all run
+# on the one event-loop thread, so their spans can overlap WITHOUT
+# nesting — which a single Chrome-trace thread track cannot render. Each
+# in-flight traced dispatch borrows a virtual tid from a free list (ids
+# are reused, keeping the track count = peak concurrency, not dispatch
+# count).
+_VTID_BASE = 1 << 20
+_vtid_lock = threading.Lock()
+_vtid_free: list = []
+_vtid_high = 0
+
+
+def _acquire_vtid() -> int:
+    global _vtid_high
+    with _vtid_lock:
+        if _vtid_free:
+            return _vtid_free.pop()
+        _vtid_high += 1
+        tid = _VTID_BASE + _vtid_high
+    telemetry.name_thread_track(tid, f"dispatch-{tid - _VTID_BASE}")
+    return tid
+
+
+def _release_vtid(tid: int) -> None:
+    with _vtid_lock:
+        _vtid_free.append(tid)
 
 
 class ActorDiedError(Exception):
@@ -80,12 +116,18 @@ class _ActorHost:
                     frame = await transport.read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
-                req_id, method, args, kwargs, oneway = frame
+                # Frames are 5-tuples, or 6 with the caller's trace context
+                # appended (tracing enabled caller-side; see _trace_ctx).
+                req_id, method, args, kwargs, oneway = frame[:5]
+                trace_ctx = frame[5] if len(frame) > 5 else None
                 # Dispatch as a task: requests on one connection must not
                 # head-of-line-block each other (a blocked queue.get would
                 # otherwise deadlock the producer's puts).
                 asyncio.get_running_loop().create_task(
-                    self._dispatch(writer, req_id, method, args, kwargs, oneway)
+                    self._dispatch(
+                        writer, req_id, method, args, kwargs, oneway,
+                        trace_ctx,
+                    )
                 )
         finally:
             try:
@@ -93,7 +135,8 @@ class _ActorHost:
             except Exception:
                 pass
 
-    async def _dispatch(self, writer, req_id, method, args, kwargs, oneway):
+    async def _dispatch(self, writer, req_id, method, args, kwargs, oneway,
+                        trace_ctx=None):
         try:
             if method == "__ping__":
                 result = "pong"
@@ -101,10 +144,28 @@ class _ActorHost:
                 result = None
                 self._shutdown.set()
             else:
+                # With a propagated trace context, re-enter it and span
+                # the whole dispatch, awaits included — for the queue
+                # actor that IS the interesting number (e.g. how long
+                # new_epoch blocked on the admission window). Dispatches
+                # interleave on this one event-loop thread, but each runs
+                # as its own asyncio task with its own contextvars
+                # Context, so a context held across an await cannot leak
+                # into other dispatches' spans; the virtual tid gives
+                # each concurrent dispatch its own renderable track (see
+                # _acquire_vtid).
                 fn = getattr(self.instance, method)
-                result = fn(*args, **kwargs)
-                if asyncio.iscoroutine(result):
-                    result = await result
+                vtid = _acquire_vtid() if trace_ctx is not None else None
+                try:
+                    with telemetry.propagated_span(
+                        f"actor:{method}", trace_ctx, cat="actor", tid=vtid
+                    ) if vtid is not None else contextlib.nullcontext():
+                        result = fn(*args, **kwargs)
+                        if asyncio.iscoroutine(result):
+                            result = await result
+                finally:
+                    if vtid is not None:
+                        _release_vtid(vtid)
             if not oneway:
                 transport.write_frame(writer, (req_id, "ok", result))
                 await writer.drain()
@@ -174,6 +235,8 @@ def _actor_main(
                     os._exit(0)
 
         threading.Thread(target=_watch, daemon=True).start()
+    if telemetry.enabled():
+        telemetry.set_process_name(f"actor:{cls.__name__}-{os.getpid()}")
     try:
         instance = cls(*args, **kwargs)
         host = _ActorHost(instance, address)
@@ -202,6 +265,10 @@ def _actor_main(
     except KeyboardInterrupt:
         pass
     finally:
+        # Graceful terminate reaches here; drain this actor's spans to
+        # the spool before the process exits (atexit also fires on clean
+        # exits, but not on the SIGKILL escalation path).
+        telemetry.safe_flush()
         if registry_path is not None:
             try:
                 os.unlink(registry_path)
@@ -261,7 +328,7 @@ class ActorHandle:
         conn = self._conn()
         req_id = self._next_id()
         try:
-            conn.send((req_id, method, args, kwargs, False))
+            conn.send((req_id, method, args, kwargs, False, _trace_ctx()))
             while True:
                 resp_id, status, payload = conn.recv()
                 if resp_id == req_id:
@@ -281,7 +348,9 @@ class ActorHandle:
     def call_oneway(self, method: str, *args, **kwargs) -> None:
         conn = self._conn()
         try:
-            conn.send((self._next_id(), method, args, kwargs, True))
+            conn.send(
+                (self._next_id(), method, args, kwargs, True, _trace_ctx())
+            )
         except (ConnectionError, OSError) as e:
             self._local.conn = None
             raise ActorDiedError(
@@ -314,7 +383,7 @@ class ActorHandle:
                 f"actor {self.name or self.address} unreachable: {e}"
             ) from e
         try:
-            conn.send((0, method, args, kwargs, False))
+            conn.send((0, method, args, kwargs, False, _trace_ctx()))
             while True:
                 resp_id, status, payload = conn.recv()
                 if resp_id == 0:
@@ -434,7 +503,9 @@ class _AsyncActorClient:
         req_id = self._req
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        transport.write_frame(self._writer, (req_id, method, args, kwargs, False))
+        transport.write_frame(
+            self._writer, (req_id, method, args, kwargs, False, _trace_ctx())
+        )
         await self._writer.drain()
         return await fut
 

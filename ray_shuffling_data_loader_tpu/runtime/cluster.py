@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_shuffling_data_loader_tpu import telemetry
+
 from . import transport
 from .actor import ActorDiedError, ActorHandle, spawn_actor
 from .store import ObjectRef
@@ -479,18 +481,25 @@ class ClusterScheduler:
             self._drop_agent(agent)
             return False, None
 
-    def _run(self, fn, args, kwargs):
+    def _run(self, fn, args, kwargs, trace_ctx=None):
         # Task bodies are idempotent pure functions over the store (map/
         # reduce stages), so retrying on another host after an agent death
         # is safe; at most len(agents) attempts.
-        while True:
-            agent = self._next_agent()
-            ok, result = self._submit_once(agent, fn, args, kwargs)
-            if ok:
-                return result
+        # trace_ctx is the SUBMITTER thread's context, re-entered here on
+        # the executor thread so the agent call (and through it the
+        # worker-side span) carries (epoch, schedule, ...) — contextvars
+        # don't cross the executor hop by themselves.
+        with telemetry.context(**(trace_ctx or {})):
+            while True:
+                agent = self._next_agent()
+                ok, result = self._submit_once(agent, fn, args, kwargs)
+                if ok:
+                    return result
 
     def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
-        inner = self._executor.submit(self._run, fn, args, kwargs)
+        inner = self._executor.submit(
+            self._run, fn, args, kwargs, telemetry.outbound_context()
+        )
         return ClusterTaskFuture(inner)
 
     def _locality_agent(self, refs) -> Optional[ActorHandle]:
@@ -524,12 +533,13 @@ class ClusterScheduler:
             live = {a.address for a in self._agents}
         return agent if agent.address in live else None
 
-    def _run_preferring(self, preferred, fn, args, kwargs):
-        if preferred is not None:
-            ok, result = self._submit_once(preferred, fn, args, kwargs)
-            if ok:
-                return result
-        return self._run(fn, args, kwargs)
+    def _run_preferring(self, preferred, fn, args, kwargs, trace_ctx=None):
+        with telemetry.context(**(trace_ctx or {})):
+            if preferred is not None:
+                ok, result = self._submit_once(preferred, fn, args, kwargs)
+                if ok:
+                    return result
+            return self._run(fn, args, kwargs)
 
     def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
         """Locality-aware submit: place the task on the host holding the
@@ -539,7 +549,8 @@ class ClusterScheduler:
         no host dominates or the preferred host died."""
         preferred = self._locality_agent(refs)
         inner = self._executor.submit(
-            self._run_preferring, preferred, fn, args, kwargs
+            self._run_preferring, preferred, fn, args, kwargs,
+            telemetry.outbound_context(),
         )
         return ClusterTaskFuture(inner)
 

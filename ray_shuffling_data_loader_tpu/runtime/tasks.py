@@ -19,6 +19,8 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ray_shuffling_data_loader_tpu import telemetry
+
 
 class TaskError(Exception):
     """A task raised; carries the remote traceback."""
@@ -125,6 +127,8 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
 
     os.environ.update(env)
     pid = os.getpid()
+    if telemetry.enabled():
+        telemetry.set_process_name(f"task-worker-{pid}")
     # Orphan self-destruct: if the pool owner dies without shutdown (e.g.
     # SIGKILL), exit rather than linger holding inherited pipes/fds.
     parent = os.getppid()
@@ -147,10 +151,20 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
         task_id, blob = item
         result_q.put(("start", task_id, pid))
         try:
-            fn, args, kwargs = pickle.loads(blob)
-            result = fn(*args, **kwargs)
+            # Blob carries the submitter's trace context; the span + the
+            # re-entered context give every task a runtime-layer span and
+            # make in-task spans inherit (trial, epoch, ...).
+            fn, args, kwargs, trace_ctx = pickle.loads(blob)
+            with telemetry.propagated_span(
+                f"task:{getattr(fn, '__name__', 'task')}", trace_ctx
+            ):
+                result = fn(*args, **kwargs)
+            # Flush BEFORE reporting done: by the time the caller can
+            # observe the result, this task's spans are on the spool.
+            telemetry.safe_flush()
             result_q.put(("done", task_id, result, None))
         except Exception:
+            telemetry.safe_flush()
             result_q.put(("done", task_id, None, traceback.format_exc()))
 
 
@@ -248,7 +262,11 @@ class WorkerPool:
         # Pickle eagerly: mp.Queue pickles in a background feeder thread
         # where a PicklingError would be swallowed and the future never
         # fulfilled; raising here puts the error in the caller's lap.
-        blob = pickle.dumps((fn, args, kwargs))
+        # The submitter's trace context rides along so the worker-side
+        # span carries (trial, epoch, ...) without changing task args.
+        blob = pickle.dumps(
+            (fn, args, kwargs, telemetry.outbound_context())
+        )
         with self._futures_lock:
             task_id = self._next_id
             self._next_id += 1

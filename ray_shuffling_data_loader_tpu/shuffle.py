@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu import runtime, telemetry
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
 from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture, wait
 from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
@@ -190,6 +190,7 @@ def shuffle_map(
     if stats_collector is not None:
         stats_collector.call_oneway("map_start", epoch)
     start = timeit.default_timer()
+    wall0 = time.time()
     ctx = runtime.ensure_initialized()
     new_cache_ref = None
     if cache_ref is not None:
@@ -256,6 +257,16 @@ def shuffle_map(
     del pending  # drop writable views before readers map the segment
     del batch  # drop (possibly mmapped-cache) views before returning
     duration = timeit.default_timer() - start
+    # Retroactive spans (record_span no-ops when tracing is off): the
+    # whole map plus its decode sub-interval, on the worker's timeline.
+    telemetry.record_span(
+        "map:read", wall0, end_read - start, cat="shuffle",
+        epoch=epoch, file=file_index, cached=cache_ref is not None,
+    )
+    telemetry.record_span(
+        "map", wall0, duration, cat="shuffle",
+        epoch=epoch, file=file_index, rows=n,
+    )
     if stats_collector is not None:
         stats_collector.call_oneway(
             "map_done", epoch, duration, end_read - start
@@ -286,6 +297,7 @@ def shuffle_plan(
     if stats_collector is not None:
         stats_collector.call_oneway("map_start", epoch)
     start = timeit.default_timer()
+    wall0 = time.time()
     ctx = runtime.ensure_initialized()
     cached = ctx.store.get_columns(cache_ref)
     n = cached.num_rows
@@ -313,6 +325,10 @@ def shuffle_plan(
         pending.abort()
     del pending
     duration = timeit.default_timer() - start
+    telemetry.record_span(
+        "map", wall0, duration, cat="shuffle",
+        epoch=epoch, file=file_index, rows=n, schedule="index",
+    )
     if stats_collector is not None:
         stats_collector.call_oneway(
             "map_done", epoch, duration, end_read - start
@@ -340,6 +356,7 @@ def shuffle_gather_reduce(
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_start", epoch)
     start = timeit.default_timer()
+    wall0 = time.time()
     ctx = runtime.ensure_initialized()
     caches: List[ColumnBatch] = []
     idx_parts: List[ColumnBatch] = []
@@ -394,6 +411,10 @@ def shuffle_gather_reduce(
         del caches, idx_parts
         ctx.store.drop_cache(list(idx_refs))
     duration = timeit.default_timer() - start
+    telemetry.record_span(
+        "reduce", wall0, duration, cat="shuffle",
+        epoch=epoch, reducer=reduce_index, schedule="index",
+    )
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
     return out_ref
@@ -415,6 +436,7 @@ def shuffle_reduce(
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_start", epoch)
     start = timeit.default_timer()
+    wall0 = time.time()
     ctx = runtime.ensure_initialized()
     parts: List[ColumnBatch] = []
     try:
@@ -447,6 +469,10 @@ def shuffle_reduce(
         del parts  # drop mmap views before unlinking
         ctx.store.drop_cache(list(part_refs))
     duration = timeit.default_timer() - start
+    telemetry.record_span(
+        "reduce", wall0, duration, cat="shuffle",
+        epoch=epoch, reducer=reduce_index, schedule="mapreduce",
+    )
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
     return out_ref
@@ -841,46 +867,55 @@ def shuffle_epoch(
         schedule_log.append((epoch, schedule))
     map_futs: List[TaskFuture] = []
     map_published: List[bool] = []
-    if schedule == "index":
-        for i in range(len(filenames)):
-            map_futs.append(
-                pool.submit_local_to(
-                    [cache_refs[i]],
-                    shuffle_plan,
+    # Trace context for everything this epoch submits from THIS thread:
+    # the task layer pickles the submitter's context next to each task, so
+    # worker-side map spans inherit the epoch id (the deliver thread below
+    # re-enters it separately — thread-local context does not cross
+    # threads).
+    with telemetry.context(epoch=epoch, schedule=schedule):
+        if schedule == "index":
+            for i in range(len(filenames)):
+                map_futs.append(
+                    pool.submit_local_to(
+                        [cache_refs[i]],
+                        shuffle_plan,
+                        i,
+                        num_reducers,
+                        epoch,
+                        seed,
+                        cache_refs[i],
+                        stats_collector,
+                    )
+                )
+                map_published.append(False)
+        else:
+            for i, fname in enumerate(filenames):
+                cache_ref, publish = decode_cache.claim_or_wait(i)
+                args = (
+                    fname,
                     i,
                     num_reducers,
                     epoch,
                     seed,
-                    cache_refs[i],
                     stats_collector,
+                    narrow_to_32,
+                    cache_ref,
+                    publish,
+                    len(filenames),
                 )
-            )
-            map_published.append(False)
-    else:
-        for i, fname in enumerate(filenames):
-            cache_ref, publish = decode_cache.claim_or_wait(i)
-            args = (
-                fname,
-                i,
-                num_reducers,
-                epoch,
-                seed,
-                stats_collector,
-                narrow_to_32,
-                cache_ref,
-                publish,
-                len(filenames),
-            )
-            if cache_ref is not None:
-                # Locality: run the map on the host that owns the cached
-                # decode (cluster mode; the local pool ignores the hint).
-                fut = pool.submit_local_to([cache_ref], shuffle_map, *args)
-            else:
-                fut = pool.submit(shuffle_map, *args)
-            if publish:
-                decode_cache.register(i, fut)
-            map_futs.append(fut)
-            map_published.append(publish)
+                if cache_ref is not None:
+                    # Locality: run the map on the host that owns the
+                    # cached decode (cluster mode; the local pool ignores
+                    # the hint).
+                    fut = pool.submit_local_to(
+                        [cache_ref], shuffle_map, *args
+                    )
+                else:
+                    fut = pool.submit(shuffle_map, *args)
+                if publish:
+                    decode_cache.register(i, fut)
+                map_futs.append(fut)
+                map_published.append(publish)
 
     # Rank assignment: contiguous split of reducer indices across trainers
     # (reference np.array_split, shuffle.py:125).
@@ -896,78 +931,91 @@ def shuffle_epoch(
     def deliver():
         done_ranks = set()
         try:
-            # Wait for all maps (reduce needs one partition per mapper).
-            # Publishing maps return (refs, cache_ref); unwrap those.
-            per_file_refs = [
-                f.result()[0] if pub else f.result()
-                for f, pub in zip(map_futs, map_published)
-            ]
-            # Locality: each reduce runs on the host already holding the
-            # most of its input-partition rows (cluster mode; the local
-            # pool ignores the hint). Ray gets this from its scheduler;
-            # round-robin alone would cross DCN with ~(N-1)/N of all
-            # partition bytes.
-            reduce_fn, extra = (
-                (shuffle_gather_reduce, (cache_refs,))
-                if schedule == "index"
-                else (shuffle_reduce, ())
-            )
-            reduce_futs = [
-                pool.submit_local_to(
-                    [refs[r] for refs in per_file_refs],
-                    reduce_fn,
-                    r,
-                    epoch,
-                    seed,
-                    [refs[r] for refs in per_file_refs],
-                    *extra,
-                    stats_collector,
+            # Re-enter the epoch's trace context on this (fresh) thread
+            # so the reduce submissions and delivery spans below carry
+            # the epoch id — INSIDE the try, so the finally's sentinel
+            # delivery can never depend on telemetry.
+            with telemetry.context(epoch=epoch, schedule=schedule):
+                # Wait for all maps (reduce needs one partition per mapper).
+                # Publishing maps return (refs, cache_ref); unwrap those.
+                with telemetry.trace_span("deliver:wait-maps", cat="shuffle"):
+                    per_file_refs = [
+                        f.result()[0] if pub else f.result()
+                        for f, pub in zip(map_futs, map_published)
+                    ]
+                # Locality: each reduce runs on the host already holding the
+                # most of its input-partition rows (cluster mode; the local
+                # pool ignores the hint). Ray gets this from its scheduler;
+                # round-robin alone would cross DCN with ~(N-1)/N of all
+                # partition bytes.
+                reduce_fn, extra = (
+                    (shuffle_gather_reduce, (cache_refs,))
+                    if schedule == "index"
+                    else (shuffle_reduce, ())
                 )
-                for r in range(num_reducers)
-            ]
-            # Free each reducer's input partitions from the driver — not
-            # inside the task (keeps reduce retryable for cluster
-            # failover) — and in COMPLETION order on a side thread, not
-            # delivery order: the delivery loop below can block on
-            # consumer backpressure while later reducers finished long
-            # ago, and holding their inputs would double peak /dev/shm.
-            def free_inputs():
-                store = runtime.get_context().store
-                index_of = {id(f): r for r, f in enumerate(reduce_futs)}
-                remaining = list(reduce_futs)
-                while remaining:
-                    finished, remaining = wait(remaining, num_returns=1)
-                    for f in finished:
-                        try:
-                            store.free(
-                                [
-                                    refs[index_of[id(f)]]
-                                    for refs in per_file_refs
-                                ]
-                            )
-                        except Exception:
-                            pass
-
-            threading.Thread(
-                target=free_inputs,
-                name=f"free-inputs-e{epoch}",
-                daemon=True,
-            ).start()
-
-            # Stream each reducer's output to its rank as soon as it
-            # completes, preserving reducer order within a rank for
-            # determinism.
-            for r, fut in enumerate(reduce_futs):
-                out_ref = fut.result()
-                rank = int(rank_of[r])
-                batch_consumer.consume(rank, epoch, [out_ref])
-                if stats_collector is not None:
-                    stats_collector.call_oneway(
-                        "consume", rank, epoch, out_ref.nbytes
+                reduce_futs = [
+                    pool.submit_local_to(
+                        [refs[r] for refs in per_file_refs],
+                        reduce_fn,
+                        r,
+                        epoch,
+                        seed,
+                        [refs[r] for refs in per_file_refs],
+                        *extra,
+                        stats_collector,
                     )
-                if r + 1 == num_reducers or rank_of[r + 1] != rank:
-                    batch_consumer.producer_done(rank, epoch)
-                    done_ranks.add(rank)
+                    for r in range(num_reducers)
+                ]
+                # Free each reducer's input partitions from the driver — not
+                # inside the task (keeps reduce retryable for cluster
+                # failover) — and in COMPLETION order on a side thread, not
+                # delivery order: the delivery loop below can block on
+                # consumer backpressure while later reducers finished long
+                # ago, and holding their inputs would double peak /dev/shm.
+                def free_inputs():
+                    store = runtime.get_context().store
+                    index_of = {id(f): r for r, f in enumerate(reduce_futs)}
+                    remaining = list(reduce_futs)
+                    while remaining:
+                        finished, remaining = wait(remaining, num_returns=1)
+                        for f in finished:
+                            try:
+                                store.free(
+                                    [
+                                        refs[index_of[id(f)]]
+                                        for refs in per_file_refs
+                                    ]
+                                )
+                            except Exception:
+                                pass
+
+                threading.Thread(
+                    target=free_inputs,
+                    name=f"free-inputs-e{epoch}",
+                    daemon=True,
+                ).start()
+
+                # Stream each reducer's output to its rank as soon as it
+                # completes, preserving reducer order within a rank for
+                # determinism.
+                for r, fut in enumerate(reduce_futs):
+                    out_ref = fut.result()
+                    rank = int(rank_of[r])
+                    # The span covers the consumer handoff INCLUDING any
+                    # blocking inside it (queue put_batch backpressure) — on
+                    # the timeline this is where delivery waits on the
+                    # trainer.
+                    with telemetry.trace_span(
+                        "deliver", cat="queue", rank=rank, reducer=r
+                    ):
+                        batch_consumer.consume(rank, epoch, [out_ref])
+                    if stats_collector is not None:
+                        stats_collector.call_oneway(
+                            "consume", rank, epoch, out_ref.nbytes
+                        )
+                    if r + 1 == num_reducers or rank_of[r + 1] != rank:
+                        batch_consumer.producer_done(rank, epoch)
+                        done_ranks.add(rank)
         except BaseException as exc:
             thread.error = exc
         finally:
@@ -1032,7 +1080,15 @@ def shuffle(
     threads = []
     for epoch in range(start_epoch, num_epochs):
         throttle_start = timeit.default_timer()
-        batch_consumer.wait_until_ready(epoch)
+        # The admission span IS the window throttle: its duration is how
+        # long this epoch waited for the oldest in-flight epoch to drain
+        # (max_concurrent_epochs backpressure) — on the trace timeline it
+        # sits between consecutive epochs' map stages. The context block
+        # (not just a span arg) ships the epoch id with the queue-actor
+        # call, so the actor-side new_epoch span carries it too.
+        with telemetry.context(epoch=epoch):
+            with telemetry.trace_span("epoch:admission", cat="queue"):
+                batch_consumer.wait_until_ready(epoch)
         if stats_collector is not None:
             stats_collector.call_oneway(
                 "epoch_throttle",

@@ -22,13 +22,19 @@ from __future__ import annotations
 
 import asyncio
 import csv
+import logging
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -144,8 +150,20 @@ class TrialStats:
     num_reducers: int = 0
     max_concurrent_epochs: int = 0
     epochs: List[EpochStats] = field(default_factory=list)
-    store_samples: List[StoreSample] = field(default_factory=list)
+    # Sampled series are rings (only max/mean reductions read them): a
+    # 1 Hz sampler on a long run must not grow the actor — and every
+    # snapshot round-trip — without bound.
+    store_samples: Deque[StoreSample] = field(
+        default_factory=lambda: deque(maxlen=_metrics.MAX_TIMELINE_SAMPLES)
+    )
     staging: List[StagingStats] = field(default_factory=list)
+    # Live-metrics snapshots ({"ts", "values"}) forwarded by the store
+    # sampler when the telemetry metrics half is on — the same series
+    # telemetry.metrics.dump_json() writes, so CSV stats and live metrics
+    # share one source of truth.
+    metrics_samples: Deque[Dict[str, Any]] = field(
+        default_factory=lambda: deque(maxlen=_metrics.MAX_TIMELINE_SAMPLES)
+    )
 
     # -- derived metrics (reference stats.py:396-401) -----------------------
 
@@ -411,6 +429,12 @@ class TrialStatsCollector:
             )
         )
 
+    def metrics_sample(self, ts: float, values: Dict[str, float]) -> None:
+        """One sampled live-metrics snapshot from the store sampler
+        (fire-and-forget, like every other report; the deque's maxlen
+        bounds the series)."""
+        self.stats.metrics_samples.append({"ts": ts, "values": values})
+
     def store_sample(
         self, num_objects: int, total_bytes: int, spill_bytes: int = 0
     ) -> None:
@@ -475,7 +499,15 @@ class TrialStatsCollector:
 class ObjectStoreStatsCollector:
     """Context manager sampling shared-memory store utilization on a daemon
     thread every ``sample_period_s`` and reporting to the collector actor
-    (or accumulating locally when ``collector`` is None)."""
+    (or accumulating locally when ``collector`` is None).
+
+    When the telemetry metrics half is on (``RSDL_METRICS=1``), this
+    thread doubles as the live-metrics sampler: every period it sets the
+    store gauges, takes a :func:`telemetry.metrics.global_snapshot`
+    (local instruments + cross-process sources like the batch-queue
+    actor's depths), appends it to the in-memory timeline that
+    ``metrics.dump_json`` writes, forwards it to the collector actor
+    (``metrics_sample``), and logs a human-readable progress line."""
 
     def __init__(self, collector=None, sample_period_s: float = 5.0):
         self._collector = collector
@@ -483,6 +515,30 @@ class ObjectStoreStatsCollector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.samples: List[StoreSample] = []
+
+    def set_collector(self, collector) -> None:
+        """Re-point the sampler at a different collector actor (e.g. the
+        bench failover respawns its stats collector mid-run). Benign
+        race with the sampler thread: the handle is re-read each period."""
+        self._collector = collector
+
+    def _sample_metrics(self, sample: StoreSample) -> None:
+        reg = _metrics.registry
+        reg.gauge("store.shm_bytes").set(
+            sample.total_bytes - sample.spill_bytes
+        )
+        reg.gauge("store.spill_bytes").set(sample.spill_bytes)
+        reg.gauge("store.objects").set(sample.num_objects)
+        snap = _metrics.global_snapshot()
+        _metrics.record_sample(snap, ts=sample.timestamp)
+        if self._collector is not None:
+            try:
+                self._collector.call_oneway(
+                    "metrics_sample", sample.timestamp, snap
+                )
+            except Exception:
+                pass
+        logger.info(_metrics.progress_line(snap))
 
     def _loop(self):
         from ray_shuffling_data_loader_tpu import runtime
@@ -508,6 +564,12 @@ class ObjectStoreStatsCollector:
                         sample.spill_bytes,
                     )
                 except Exception:
+                    pass
+            if _metrics.enabled():
+                try:
+                    self._sample_metrics(sample)
+                except Exception:
+                    # Telemetry must never sink the sampler thread.
                     pass
 
     def __enter__(self):

@@ -1,0 +1,50 @@
+"""End-to-end tracing + live metrics for the shuffle/delivery pipeline.
+
+Two halves, both env-gated off by default (zero overhead when disabled):
+
+* :mod:`.trace` — ``trace_span()`` spans with per-process buffered
+  recording, trace-context (trial/epoch/task) propagation through the
+  runtime's task and actor layers, and a Chrome-trace/Perfetto exporter
+  (:func:`trace_export`). Enable with ``RSDL_TRACE=1`` (+
+  ``RSDL_TRACE_DIR=<spool>`` for cross-process collection) or
+  :func:`enable` before ``runtime.init()``.
+* :mod:`.metrics` — counters/gauges/histograms with cross-process
+  sources, a sampled timeline, a JSON snapshot dump, and a human-readable
+  progress line. Sampled by ``stats.ObjectStoreStatsCollector`` and fed
+  into ``TrialStatsCollector`` so CSVs and live metrics share one source
+  of truth.
+
+See docs/observability.md for the span/metric vocabulary and how to open
+a trace in Perfetto. ``bench.py --trace-out=trace.json`` emits both
+artifacts for a benchmark run.
+"""
+
+from ray_shuffling_data_loader_tpu.telemetry.trace import (  # noqa: F401
+    ENV_TRACE,
+    ENV_TRACE_DIR,
+    Span,
+    context,
+    current_context,
+    disable,
+    dropped_events,
+    enable,
+    enabled,
+    flush,
+    instant,
+    name_thread_track,
+    outbound_context,
+    propagated_span,
+    record_span,
+    refresh_from_env,
+    reset_state,
+    safe_flush,
+    set_context,
+    set_process_name,
+    spool_dir,
+    trace_export,
+    trace_span,
+)
+from ray_shuffling_data_loader_tpu.telemetry import metrics  # noqa: F401
+
+metrics_snapshot = metrics.global_snapshot
+metrics_dump = metrics.dump_json

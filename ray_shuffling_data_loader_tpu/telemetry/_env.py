@@ -1,0 +1,10 @@
+"""Shared env-flag parsing for the telemetry halves (trace + metrics):
+one definition of truthiness so the two gates cannot silently diverge."""
+
+import os
+
+TRUTHY = ("1", "on", "true", "yes")
+
+
+def read_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in TRUTHY

@@ -1,0 +1,353 @@
+"""Lightweight in-process metrics: counters, gauges, histograms + sources.
+
+The live-metrics half of the telemetry subsystem (the tracing half is
+:mod:`.trace`). Off unless ``RSDL_METRICS`` is truthy — every wiring site
+checks :func:`enabled` (one cached boolean) before touching an
+instrument, so the disabled pipeline pays nothing. One :data:`registry`
+per process; instruments are cheap lock-guarded floats keyed by
+``name{label=value,...}`` (:func:`format_key`).
+Cross-process metrics (the queue actor's per-``(epoch, rank)`` depths)
+come in through **sources**: the driver registers a callable returning a
+flat ``{key: value}`` dict (:func:`register_source`) and
+:func:`global_snapshot` merges them — sources that keep failing (their
+actor died) are dropped automatically.
+
+The ``ObjectStoreStatsCollector`` thread (``stats.py``) is the sampler:
+every period it sets the store gauges, takes a :func:`global_snapshot`,
+appends it to the in-memory :func:`timeline`, forwards it to the
+``TrialStatsCollector`` actor (so CSV stats and live metrics share one
+source of truth), and logs a :func:`progress_line`. :func:`dump_json`
+writes the whole timeline plus a final snapshot as one JSON artifact.
+
+Metric names used by the pipeline (see docs/observability.md):
+
+====================================  =========  ===============================
+key                                   kind       set by
+====================================  =========  ===============================
+``queue.depth{epoch=E,rank=R}``       gauge      batch-queue actor (source)
+``queue.depth.total``                 gauge      batch-queue actor (source)
+``store.shm_bytes``                   gauge      store sampler
+``store.spill_bytes``                 gauge      store sampler
+``store.objects``                     gauge      store sampler
+``stall_seconds{cause=upstream}``     counter    trainer staging ring
+``stall_seconds{cause=staging}``      counter    trainer staging ring
+``h2d.bytes`` / ``h2d.batches``       counter    trainer staging ring
+``h2d.dispatch_seconds``              histogram  trainer staging ring
+====================================  =========  ===============================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_shuffling_data_loader_tpu.telemetry import _env
+
+ENV_METRICS = "RSDL_METRICS"
+
+# Cap for every sampled series (the local timeline AND the collector-actor
+# copies in stats.py) — public so the bound stays one number everywhere.
+MAX_TIMELINE_SAMPLES = 20_000
+
+_enabled: Optional[bool] = None  # tri-state: None = not yet read from env
+
+
+def enabled() -> bool:
+    """Is the metrics half on in this process? Every instrumentation site
+    checks this first, so disabled cost is one cached boolean check."""
+    global _enabled
+    if _enabled is None:
+        _enabled = _env.read_flag(ENV_METRICS)
+    return _enabled
+
+
+def enable() -> None:
+    """Turn metrics on for this process AND (via the environment) every
+    process spawned after this call."""
+    global _enabled
+    os.environ[ENV_METRICS] = "1"
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    os.environ.pop(ENV_METRICS, None)
+    _enabled = False
+
+
+def refresh_from_env() -> None:
+    """Forget the cached enabled state; the next check re-reads the env
+    (test harness hook)."""
+    global _enabled
+    _enabled = None
+
+
+def format_key(name: str, labels: Optional[Dict[str, Any]] = None) -> str:
+    """Flatten ``(name, labels)`` to the canonical snapshot key:
+    ``name{k1=v1,k2=v2}`` with labels sorted by key; bare ``name`` when
+    there are none."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic accumulator (bytes moved, stall seconds, ...)."""
+
+    __slots__ = ("key", "_value", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        out[self.key] = self._value
+
+
+class Gauge:
+    """Last-write-wins level (queue depth, shm residency, ...)."""
+
+    __slots__ = ("key", "_value")
+
+    def __init__(self, key: str):
+        self.key = key
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        out[self.key] = self._value
+
+
+class Histogram:
+    """Streaming count/sum/min/max — enough to answer "how many, how big,
+    how skewed" without bucket configuration."""
+
+    __slots__ = ("key", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        with self._lock:  # consistent (count, sum, min, max) vs observe()
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+        out[f"{self.key}_count"] = float(count)
+        out[f"{self.key}_sum"] = total
+        if count:
+            out[f"{self.key}_min"] = lo
+            out[f"{self.key}_max"] = hi
+
+
+class MetricsRegistry:
+    """Get-or-create instrument registry; instruments are singletons per
+    ``(name, labels)`` so call sites can re-resolve them freely."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Any] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, Any]):
+        key = format_key(name, labels)
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = self._instruments[key] = cls(key)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {key!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels: Any) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            inst.snapshot_into(out)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+registry = MetricsRegistry()
+
+# -- cross-process sources ---------------------------------------------------
+
+_sources: Dict[str, Callable[[], Dict[str, float]]] = {}
+_source_failures: Dict[str, int] = {}
+_sources_lock = threading.Lock()
+_SOURCE_MAX_FAILURES = 3
+
+
+def register_source(name: str, fn: Callable[[], Dict[str, float]]) -> None:
+    """Register a callable merged into every :func:`global_snapshot` (e.g.
+    a closure over an actor handle returning its live gauges). Re-using a
+    name replaces the previous source."""
+    with _sources_lock:
+        _sources[name] = fn
+        _source_failures[name] = 0
+
+
+def unregister_source(name: str) -> None:
+    with _sources_lock:
+        _sources.pop(name, None)
+        _source_failures.pop(name, None)
+
+
+def global_snapshot() -> Dict[str, float]:
+    """The local registry plus every live source. A source that fails
+    ``_SOURCE_MAX_FAILURES`` times in a row (its actor died) is dropped so
+    dead endpoints don't slow the sampler forever."""
+    out = registry.snapshot()
+    with _sources_lock:
+        sources = list(_sources.items())
+    for name, fn in sources:
+        try:
+            values = fn()
+        except Exception:
+            with _sources_lock:
+                _source_failures[name] = _source_failures.get(name, 0) + 1
+                if _source_failures[name] >= _SOURCE_MAX_FAILURES:
+                    _sources.pop(name, None)
+                    _source_failures.pop(name, None)
+            continue
+        with _sources_lock:
+            if name in _source_failures:
+                _source_failures[name] = 0
+        for key, value in (values or {}).items():
+            out[key] = float(value)
+    return out
+
+
+# -- timeline + JSON dump ----------------------------------------------------
+
+_timeline: "deque[Dict[str, Any]]" = deque(maxlen=MAX_TIMELINE_SAMPLES)
+# Guards iteration (list(_timeline)) against a sampler thread appending
+# concurrently — e.g. dump_json on the error path of a run whose sampler
+# is still alive; unguarded, CPython raises "deque mutated during
+# iteration" and the metrics artifact of exactly that failed run is lost.
+_timeline_lock = threading.Lock()
+
+
+def record_sample(values: Dict[str, float],
+                  ts: Optional[float] = None) -> None:
+    """Append one sampled snapshot to the in-memory series (bounded; the
+    oldest samples roll off)."""
+    sample = {"ts": ts if ts is not None else time.time(),
+              "values": dict(values)}
+    with _timeline_lock:
+        _timeline.append(sample)
+
+
+def timeline() -> List[Dict[str, Any]]:
+    with _timeline_lock:
+        return list(_timeline)
+
+
+def dump_json(path: str, include_sources: bool = True) -> str:
+    """Write the sampled series plus a final snapshot as one JSON
+    artifact: ``{"samples": [{"ts", "values"}...], "final": {...}}``.
+
+    ``include_sources=False`` restricts the final snapshot to this
+    process's registry — for error paths where a registered source's
+    actor may be wedged (not dead): a source call blocks on a reply with
+    no timeout, and an artifact dump must never hang the process that is
+    trying to report a failure. The sampled timeline is always local.
+    """
+    payload = {
+        "generated_ts": time.time(),
+        "samples": timeline(),
+        "final": global_snapshot() if include_sources else registry.snapshot(),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def reset() -> None:
+    """Clear instruments, sources, and the timeline (tests only)."""
+    registry.clear()
+    with _sources_lock:
+        _sources.clear()
+        _source_failures.clear()
+    with _timeline_lock:
+        _timeline.clear()
+
+
+# -- human-readable progress line --------------------------------------------
+
+
+def _fmt_bytes(num: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(num) < 1024.0:
+            return f"{num:.1f}{unit}"
+        num /= 1024.0
+    return f"{num:.1f}PiB"
+
+
+def progress_line(values: Dict[str, float]) -> str:
+    """One-line human summary of a snapshot — the periodic progress line
+    the sampler logs (``shm= spill= queue= h2d= stall=``)."""
+    up = values.get(format_key("stall_seconds", {"cause": "upstream"}), 0.0)
+    staging = values.get(
+        format_key("stall_seconds", {"cause": "staging"}), 0.0
+    )
+    parts = [
+        f"shm={_fmt_bytes(values.get('store.shm_bytes', 0.0))}",
+        f"spill={_fmt_bytes(values.get('store.spill_bytes', 0.0))}",
+    ]
+    depth = values.get("queue.depth.total")
+    if depth is not None:
+        parts.append(f"queue={int(depth)}")
+    parts.append(f"h2d={_fmt_bytes(values.get('h2d.bytes', 0.0))}")
+    parts.append(
+        f"stall={up + staging:.2f}s"
+        f" (upstream {up:.2f} / staging {staging:.2f})"
+    )
+    return "metrics: " + " ".join(parts)

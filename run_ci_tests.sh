@@ -11,7 +11,17 @@ cd "$(dirname "$0")"
 tier="${RSDL_CI_TIER:-all}"
 rc=0
 if [ "$tier" != "slow" ]; then
+  # Telemetry is env-gated and DEFAULT OFF: this pass asserts tier-1 is
+  # clean with it disabled (the zero-overhead path).
   python -m pytest tests/ -m "not slow" -v --durations=10 -x
+  # ... and must not perturb the data plane when ENABLED: re-run the
+  # core data-path tests with tracing + metrics on, spooling to a throwaway
+  # dir (every spawned worker/actor inherits the env and spools spans).
+  RSDL_TRACE=1 RSDL_METRICS=1 RSDL_TRACE_DIR="$(mktemp -d)" \
+    python -m pytest tests/test_telemetry.py tests/test_shuffle.py \
+      tests/test_batch_queue.py tests/test_dataset.py \
+      tests/test_jax_dataset.py tests/test_stats.py \
+      -m "not slow" -q -x
 fi
 if [ "$tier" != "fast" ]; then
   python -m pytest tests/ -m slow -v --durations=10 || rc=$?

@@ -1,0 +1,82 @@
+"""Fast in-process unit tests for bench.py's measurement-regime logic
+(ADVICE r5): `_target_context` override validation with the non-strict
+error-JSON fallback, and the tunnel heuristic requiring ACTIVE axon
+markers — not mere existence of ~/.axon_site on disk.
+
+Separate from test_bench.py, whose module-wide `slow` mark covers the
+subprocess contract runs; everything here is a plain function call.
+"""
+
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import bench
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    """No regime override, no axon markers — the heuristic's baseline."""
+    monkeypatch.delenv("RSDL_BENCH_TARGET_CONTEXT", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("PJRT_DEVICE", raising=False)
+    monkeypatch.delenv("PYTHONPATH", raising=False)
+
+
+def test_valid_override_wins(clean_env, monkeypatch):
+    monkeypatch.setenv("RSDL_BENCH_TARGET_CONTEXT", "direct-tpu")
+    assert bench._target_context("cpu") == "direct-tpu"
+
+
+def test_bad_override_strict_raises(clean_env, monkeypatch):
+    monkeypatch.setenv("RSDL_BENCH_TARGET_CONTEXT", "direct-tpuu")
+    with pytest.raises(ValueError, match="direct-tpuu"):
+        bench._target_context("tpu")
+
+
+def test_bad_override_nonstrict_falls_back(clean_env, monkeypatch):
+    """The error-JSON path must classify heuristically on a typo'd
+    override, never raise (a raise there broke the one-JSON-line
+    contract)."""
+    monkeypatch.setenv("RSDL_BENCH_TARGET_CONTEXT", "direct-tpuu")
+    assert bench._target_context("cpu", strict=False) == "cpu-failover"
+    result = bench._error_result("cpu", "boom")
+    assert result["target_context"] == "cpu-failover"
+    assert result["error"] == "boom"
+
+
+def test_axon_site_dir_alone_is_not_a_tunnel(clean_env, monkeypatch,
+                                             tmp_path):
+    """ADVICE r5: ~/.axon_site existing on disk must not demote a direct
+    TPU capture — only an ACTIVE marker (env/PYTHONPATH) may."""
+    home = tmp_path / "home"
+    (home / ".axon_site").mkdir(parents=True)
+    monkeypatch.setenv("HOME", str(home))
+    assert bench._target_context("tpu") == "direct-tpu"
+
+
+@pytest.mark.parametrize(
+    "env",
+    [
+        {"JAX_PLATFORMS": "axon,cpu"},
+        {"PJRT_DEVICE": "axon"},
+        {"PYTHONPATH": "/opt/foo:/some/where/.axon_site"},
+    ],
+)
+def test_active_axon_markers_mean_tunnel(clean_env, monkeypatch, env):
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    assert bench._target_context("tpu") == "tunneled-tpu"
+
+
+def test_stray_axon_substring_is_not_a_marker(clean_env, monkeypatch):
+    """Exact tokens/basenames only: 'jaxon'/'saxonpy' paths must not
+    demote a direct-TPU capture."""
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("PYTHONPATH", "/opt/jaxon:/usr/lib/saxonpy")
+    assert bench._target_context("tpu") == "direct-tpu"

@@ -24,6 +24,11 @@ the real iterator, not a hand-copied mirror.
 import os
 
 import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property sweep needs the hypothesis package"
+)
 from hypothesis import given, settings, strategies as st
 
 # Depth profiles: default 200 examples; HYPOTHESIS_PROFILE=deep (or the
