@@ -45,6 +45,7 @@ import math
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -146,7 +147,11 @@ def _target_context(platform: str, strict: bool = True) -> str:
 def _error_result(platform, msg: str) -> dict:
     """The failure shape of the one-JSON-line contract (shared by the
     stall watchdog and main()'s last-resort handler so the contract has
-    exactly one definition)."""
+    exactly one definition). When telemetry/audit are on, the artifact
+    carries their last-known state: the final LOCAL metrics snapshot (no
+    cross-process sources — a wedged actor must not hang the error path)
+    and the audit verdicts folded from whatever records reached the
+    spool, so a wedged run still reports its counters and digests."""
     result = {
         "metric": METRIC,
         "value": 0.0,
@@ -158,6 +163,20 @@ def _error_result(platform, msg: str) -> dict:
     }
     if QUICK:
         result["quick"] = True
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
+
+        if _m.enabled():
+            result["telemetry_final"] = _m.registry.snapshot()
+    except Exception:
+        pass
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import audit as _a
+
+        if _a.enabled():
+            result["audit"] = _a.summary()
+    except Exception:
+        pass
     return result
 
 
@@ -1171,6 +1190,17 @@ def _parse_args(argv=None):
         help="write the sampled metrics timeline + final snapshot JSON "
         "here (default: <trace-out>.metrics.json when --trace-out is set)",
     )
+    parser.add_argument(
+        "--audit",
+        action="store_true",
+        default=os.environ.get("RSDL_BENCH_AUDIT", "") == "1",
+        help="run with the data-correctness audit layer on (RSDL_AUDIT): "
+        "per-epoch exactly-once digest verdicts are embedded under "
+        "\"audit\" in the result JSON (including on watchdog/error "
+        "exits); forces the map/reduce loader unless RSDL_BENCH_RESIDENT "
+        "is set explicitly (the resident loader bypasses the audited "
+        "host pipeline)",
+    )
     try:
         return parser.parse_args(argv)
     except SystemExit as exc:
@@ -1244,6 +1274,29 @@ def main() -> None:
         _metrics.enable()
         _TELEMETRY_EXIT_PATHS[1] = metrics_out
 
+    from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+
+    if args.audit:
+        # Enable BEFORE runtime bring-up so pool workers inherit the
+        # audit env and spool their map/reduce digest records where the
+        # driver's reconciler can fold them.
+        spool = (
+            args.trace_out + ".auditspool"
+            if args.trace_out
+            else tempfile.mkdtemp(prefix="rsdl-audit-")
+        )
+        _audit.enable(spool_dir=spool)
+        # Metrics carry the audit.* counters; keep them on so the
+        # verdict counters land in the snapshot artifacts too.
+        _metrics.enable()
+        if "RSDL_BENCH_RESIDENT" not in os.environ:
+            _log(
+                "audit mode: forcing the map/reduce loader "
+                "(RSDL_BENCH_RESIDENT=off) — the device-resident loader "
+                "bypasses the audited host shuffle pipeline"
+            )
+            os.environ["RSDL_BENCH_RESIDENT"] = "off"
+
     platform, num_chips, tpu_error = init_backend()
     try:
         result = run_bench(platform, num_chips, tpu_error)
@@ -1267,6 +1320,14 @@ def main() -> None:
             result["trace_out"] = telemetry.trace_export(args.trace_out)
         except Exception as exc:
             result["trace_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    if args.audit and "audit" not in result:
+        # Success path: the shuffle driver already reconciled at epoch
+        # end; embed the per-epoch verdicts (the error path embeds them
+        # via _error_result). Guarded like the other artifact exports.
+        try:
+            result["audit"] = _audit.summary()
+        except Exception as exc:
+            result["audit_error"] = f"{type(exc).__name__}: {exc}"[:200]
     if metrics_out and _metrics.enabled():
         try:
             # On a failed run the batch-queue source's actor may be wedged

@@ -32,6 +32,7 @@ from ray_shuffling_data_loader_tpu.batch_queue import (
 )
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
 from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 
 # Default reducer share of cluster cores (reference ``dataset.py:12``).
 REDUCER_CLUSTER_CORE_SHARE = 0.6
@@ -248,6 +249,7 @@ class ShufflingDataset:
         store = runtime.get_context().store
         rebatch = CarryRebatcher(self._batch_size, self._skip_batches)
         is_done = False
+        consumed_rows = 0  # audit: this rank's consumed-stream offset
         while not is_done:
             pending = self._batch_queue.get_batch(self._rank, self._epoch)
             if pending and pending[-1] is None:
@@ -264,6 +266,16 @@ class ShufflingDataset:
                 cb = store.get_columns(ref)
                 # Segment pages outlive the unlink until views drop.
                 store.free(ref)
+                if _audit.enabled():
+                    # Consumed-side digest BEFORE rebatching: what this
+                    # rank actually read back through queue + store. A
+                    # row lost (or duplicated) anywhere between the
+                    # delivery thread and here breaks delivered==consumed
+                    # at reconcile.
+                    _audit.record_consume(
+                        self._epoch, self._rank, cb.columns, consumed_rows
+                    )
+                    consumed_rows += cb.num_rows
                 yield from rebatch.feed(cb)
                 del cb
 

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_shuffling_data_loader_tpu import telemetry
 from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 
 
@@ -526,7 +527,16 @@ class JaxShufflingDataset:
         # attribute is enough — one writer, one reader, advisory metric.
         phase = ["upstream"]
 
+        # Audit: staged-side digests — the rows the device path actually
+        # staged after rebatching, recorded PER BATCH so every record
+        # lands before the dataset's final acks can let the driver
+        # reconcile. Reconcile compares staged vs delivered only when the
+        # counts match (drop_last legitimately trims the tail).
+        audit_on = _audit.enabled()
+        staged_rows = 0
+
         def stager():
+            nonlocal staged_rows
             try:
                 for cb in self._ds:
                     if cancel.is_set():
@@ -535,6 +545,11 @@ class JaxShufflingDataset:
                         # its task_done acks still flow and the epoch window
                         # can advance; stage nothing more to HBM.
                         continue
+                    if audit_on:
+                        _audit.record_staged(
+                            epoch, self._ds._rank, cb, staged_rows
+                        )
+                        staged_rows += cb.num_rows
                     phase[0] = "staging"
                     with telemetry.trace_span(
                         "stage:h2d",

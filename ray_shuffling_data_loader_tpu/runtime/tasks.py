@@ -160,11 +160,16 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
             ):
                 result = fn(*args, **kwargs)
             # Flush BEFORE reporting done: by the time the caller can
-            # observe the result, this task's spans are on the spool.
+            # observe the result, this task's spans AND audit digest
+            # records are on their spools (the driver's reconciler relies
+            # on this ordering — all futures resolved implies all digest
+            # records visible).
             telemetry.safe_flush()
+            telemetry.audit.safe_flush()
             result_q.put(("done", task_id, result, None))
         except Exception:
             telemetry.safe_flush()
+            telemetry.audit.safe_flush()
             result_q.put(("done", task_id, None, traceback.format_exc()))
 
 

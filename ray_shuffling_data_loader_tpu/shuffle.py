@@ -45,6 +45,7 @@ import numpy as np
 from ray_shuffling_data_loader_tpu import runtime, telemetry
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
 from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture, wait
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
 
 
@@ -255,6 +256,15 @@ def shuffle_map(
         # a successful publish.
         pending.abort()
     del pending  # drop writable views before readers map the segment
+    if _audit.enabled():
+        # Map-side coverage digest + per-reducer partition counts (the
+        # source-file-entropy input) — counts come from the scatter's own
+        # offsets, so the audit pays one key-column pass and nothing
+        # else; nothing at all when RSDL_AUDIT is unset.
+        _audit.record_map(
+            epoch, file_index, batch.columns,
+            per_reducer=np.diff(offsets),
+        )
     del batch  # drop (possibly mmapped-cache) views before returning
     duration = timeit.default_timer() - start
     # Retroactive spans (record_span no-ops when tracing is off): the
@@ -309,6 +319,16 @@ def shuffle_plan(
     # the same stable grouping native.group_rows_multi applies to data.
     order = np.argsort(assignment, kind="stable")
     counts = np.bincount(assignment, minlength=num_reducers)
+    if _audit.enabled():
+        # The index schedule never touches column data; the audit pays
+        # one key-column read from the cached segment so the map side of
+        # the digest equality exists for this schedule too (counts are
+        # the plan's own bincount, not a recomputation).
+        cached = ctx.store.get_columns(cache_ref)
+        _audit.record_map(
+            epoch, file_index, cached.columns, per_reducer=counts
+        )
+        del cached
     offsets = np.zeros(num_reducers + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     idx_dtype = np.int32 if n <= np.iinfo(np.int32).max else np.int64
@@ -400,6 +420,8 @@ def shuffle_gather_reduce(
                         native.take(cache[k], idx_i, out=compact[k][lo:hi])
             for k, dst in pending.columns.items():
                 native.take(compact[k], perm, out=dst)
+            if _audit.enabled():
+                _audit.record_reduce(epoch, reduce_index, pending.columns)
             out_ref = pending.seal()
         finally:
             pending.abort()
@@ -456,6 +478,10 @@ def shuffle_reduce(
         )
         try:
             ColumnBatch.concat_take(parts, perm, out=pending.columns)
+            if _audit.enabled():
+                # Reduce-side digest of the permuted output, while the
+                # writable views are still alive.
+                _audit.record_reduce(epoch, reduce_index, pending.columns)
             out_ref = pending.seal()
         finally:
             pending.abort()  # reclaims the segment on failure; no-op on seal
@@ -821,6 +847,40 @@ def _index_schedule_allowed(
     return t_index <= t_mat
 
 
+def _audit_deliver(store, out_ref, epoch, reducer, rank, offsets):
+    """Delivery-side audit hook (audit-on only): digest the reducer
+    output exactly as it is about to be handed to the consumer, tracking
+    each rank's running row offset for the order-sensitive determinism
+    digest. Also the injection point for the test-only ``drop-row``
+    fault: the returned ref (with one row silently removed) REPLACES the
+    real output, so a delivery-path defect is reproducible on demand and
+    must surface as a digest mismatch at reconcile."""
+    try:
+        if _audit.take_fault("drop-row", epoch):
+            cb = store.get_columns(out_ref)
+            if cb.num_rows > 0:
+                dropped = store.put_columns(
+                    cb.slice(0, cb.num_rows - 1).columns
+                )
+                del cb
+                store.free(out_ref)
+                out_ref = dropped
+            else:
+                del cb
+        cb = store.get_columns(out_ref)
+        offset = offsets.get(rank, 0)
+        _audit.record_deliver(epoch, reducer, rank, cb.columns, offset)
+        offsets[rank] = offset + cb.num_rows
+        del cb
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "audit: delivery digest failed", exc_info=True
+        )
+    return out_ref
+
+
 def shuffle_epoch(
     epoch: int,
     filenames: List[str],
@@ -930,6 +990,7 @@ def shuffle_epoch(
 
     def deliver():
         done_ranks = set()
+        audit_offsets: Dict[int, int] = {}  # rank -> delivered-row offset
         try:
             # Re-enter the epoch's trace context on this (fresh) thread
             # so the reduce submissions and delivery spans below carry
@@ -1001,6 +1062,11 @@ def shuffle_epoch(
                 for r, fut in enumerate(reduce_futs):
                     out_ref = fut.result()
                     rank = int(rank_of[r])
+                    if _audit.enabled():
+                        out_ref = _audit_deliver(
+                            runtime.get_context().store,
+                            out_ref, epoch, r, rank, audit_offsets,
+                        )
                     # The span covers the consumer handoff INCLUDING any
                     # blocking inside it (queue put_batch backpressure) — on
                     # the timeline this is where delivery waits on the
@@ -1071,6 +1137,11 @@ def shuffle(
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
         raise ValueError("no input files to shuffle")
     runtime.ensure_initialized()
+    if _audit.enabled():
+        # Scope the digest records to THIS run: stale records (a previous
+        # shuffle in the same process / spool dir) would fold into this
+        # run's digests and poison the verdicts.
+        _audit.begin_run()
     if cache_decoded is None:
         cache_decoded = _decode_cache_auto(
             filenames, num_epochs - start_epoch, narrow_to_32
@@ -1116,6 +1187,15 @@ def shuffle(
     for t in threads:
         if t.error is not None:
             raise t.error
+    if _audit.enabled():
+        # Epoch-end reconciliation: every map/reduce task has completed
+        # and flushed its digest records (flush-before-done ordering in
+        # runtime/tasks.py), and consumers have acked every batch — fold
+        # all sides, emit per-epoch verdicts + audit.* metrics, and (in
+        # RSDL_AUDIT_STRICT mode) raise on any mismatch.
+        _audit.reconcile(
+            range(start_epoch, num_epochs), stats_collector=stats_collector
+        )
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway("trial_done", duration)

@@ -164,6 +164,9 @@ class TrialStats:
     metrics_samples: Deque[Dict[str, Any]] = field(
         default_factory=lambda: deque(maxlen=_metrics.MAX_TIMELINE_SAMPLES)
     )
+    # Per-epoch audit verdicts (telemetry.audit.reconcile forwards them
+    # when RSDL_AUDIT is on): digest equality + shuffle-quality metrics.
+    audit_epochs: List[Dict[str, Any]] = field(default_factory=list)
 
     # -- derived metrics (reference stats.py:396-401) -----------------------
 
@@ -306,6 +309,19 @@ class TrialStats:
         out["peak_hbm_bytes"] = max(
             (s.peak_device_bytes_in_use for s in self.staging), default=0
         )
+        # Audit columns (empty-string/zero when auditing was off so the
+        # trial CSV schema is stable either way): epochs whose digest
+        # reconciliation passed, and the ones that failed, by id.
+        out["audit_epochs_ok"] = sum(
+            1 for v in self.audit_epochs if v.get("ok")
+        )
+        out["audit_mismatch_epochs"] = ";".join(
+            str(v.get("epoch")) for v in self.audit_epochs
+            if v.get("ok") is False
+        )
+        out["audit_rows_delivered"] = sum(
+            int(v.get("rows_delivered") or 0) for v in self.audit_epochs
+        )
         return out
 
 
@@ -428,6 +444,12 @@ class TrialStatsCollector:
                 ),
             )
         )
+
+    def audit_epoch(self, epoch: int, verdict: Dict[str, Any]) -> None:
+        """One epoch's audit verdict (fire-and-forget from the shuffle
+        driver's reconciler) — joins the trial CSV via the audit_*
+        columns and rides the stats snapshot for tools/audit_report.py."""
+        self.stats.audit_epochs.append(dict(verdict))
 
     def metrics_sample(self, ts: float, values: Dict[str, float]) -> None:
         """One sampled live-metrics snapshot from the store sampler

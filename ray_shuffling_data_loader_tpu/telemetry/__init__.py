@@ -9,10 +9,17 @@ Two halves, both env-gated off by default (zero overhead when disabled):
   ``RSDL_TRACE_DIR=<spool>`` for cross-process collection) or
   :func:`enable` before ``runtime.init()``.
 * :mod:`.metrics` — counters/gauges/histograms with cross-process
-  sources, a sampled timeline, a JSON snapshot dump, and a human-readable
-  progress line. Sampled by ``stats.ObjectStoreStatsCollector`` and fed
-  into ``TrialStatsCollector`` so CSVs and live metrics share one source
-  of truth.
+  sources, a sampled timeline, a JSON snapshot dump, a Prometheus
+  text-format exporter (:func:`metrics.to_prometheus_text`), and a
+  human-readable progress line. Sampled by
+  ``stats.ObjectStoreStatsCollector`` and fed into ``TrialStatsCollector``
+  so CSVs and live metrics share one source of truth.
+
+A third half-sibling, :mod:`.audit` (``RSDL_AUDIT=1``), proves the *data*
+rather than the time: exactly-once coverage digests across
+map/reduce/delivery/consumption, per-epoch shuffle-quality metrics, and
+deterministic delivered-stream digests. See docs/observability.md and
+``tools/audit_report.py``.
 
 See docs/observability.md for the span/metric vocabulary and how to open
 a trace in Perfetto. ``bench.py --trace-out=trace.json`` emits both
@@ -45,6 +52,7 @@ from ray_shuffling_data_loader_tpu.telemetry.trace import (  # noqa: F401
     trace_span,
 )
 from ray_shuffling_data_loader_tpu.telemetry import metrics  # noqa: F401
+from ray_shuffling_data_loader_tpu.telemetry import audit  # noqa: F401
 
 metrics_snapshot = metrics.global_snapshot
 metrics_dump = metrics.dump_json

@@ -310,6 +310,75 @@ def dump_json(path: str, include_sources: bool = True) -> str:
     return path
 
 
+_PROM_NAME_SAN = None  # compiled lazily; regex import stays off hot paths
+
+
+def _prom_sample(key: str, value: float) -> str:
+    """One Prometheus text-format sample line from a snapshot key. Our
+    canonical key syntax (``name{k1=v1,k2=v2}``, :func:`format_key`) maps
+    1:1 onto the exposition format — names sanitized to the Prometheus
+    charset, label values quoted and escaped."""
+    global _PROM_NAME_SAN
+    if _PROM_NAME_SAN is None:
+        import re
+
+        _PROM_NAME_SAN = re.compile(r"[^a-zA-Z0-9_:]")
+    labels = ""
+    name = key
+    brace, close = key.find("{"), key.rfind("}")
+    if 0 <= brace < close:
+        # Labeled key — possibly with a suffix after the labels: a
+        # labeled Histogram snapshots as "name{k=v}_count" etc.; the
+        # suffix belongs to the metric NAME, not the labels.
+        name = key[:brace] + key[close + 1:]
+        inner = key[brace + 1:close]
+        pairs = []
+        for part in inner.split(","):
+            k, _, v = part.partition("=")
+            v = v.replace("\\", r"\\").replace('"', r"\"").replace(
+                "\n", r"\n"
+            )
+            pairs.append(f'{_PROM_NAME_SAN.sub("_", k)}="{v}"')
+        labels = "{" + ",".join(pairs) + "}"
+    name = _PROM_NAME_SAN.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    # Exact rendering: %g would truncate counters to 6 significant digits
+    # (1_234_567 -> "1.23457e+06"), corrupting exact row/byte counts in
+    # the export. Integral values render as integers; the rest use
+    # repr's shortest round-trip form. Non-finite values (a source can
+    # return anything) use the Prometheus literals instead of crashing
+    # int(value).
+    import math
+
+    if not math.isfinite(value):
+        rendered = "NaN" if math.isnan(value) else (
+            "+Inf" if value > 0 else "-Inf"
+        )
+    elif value == int(value) and abs(value) < 2**63:
+        rendered = str(int(value))
+    else:
+        rendered = repr(float(value))
+    return f"{name}{labels} {rendered}"
+
+
+def to_prometheus_text(snapshot: Dict[str, float]) -> str:
+    """Render a snapshot (:func:`global_snapshot` /
+    :meth:`MetricsRegistry.snapshot`) as Prometheus text exposition
+    format — a plain function, no server: dump it next to the Chrome
+    trace, serve it from your own handler, or pipe it to a pushgateway.
+    Samples are sorted for a stable, diffable artifact; metrics are
+    emitted untyped (counters vs gauges are a consumer-side concern
+    here)."""
+    lines = [
+        "# Prometheus text format; generated by "
+        "ray_shuffling_data_loader_tpu.telemetry.metrics"
+    ]
+    for key in sorted(snapshot):
+        lines.append(_prom_sample(key, float(snapshot[key])))
+    return "\n".join(lines) + "\n"
+
+
 def reset() -> None:
     """Clear instruments, sources, and the timeline (tests only)."""
     registry.clear()

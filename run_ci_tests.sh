@@ -22,6 +22,15 @@ if [ "$tier" != "slow" ]; then
       tests/test_batch_queue.py tests/test_dataset.py \
       tests/test_jax_dataset.py tests/test_stats.py \
       -m "not slow" -q -x
+  # Audit lane (ISSUE 2): the data-correctness digests on — the shuffle,
+  # queue, dataset, and device-staging suites must pass with every stage
+  # folding exactly-once digests, and the audit suite itself verifies the
+  # verdicts (incl. the injected-fault and determinism checks).
+  RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
+    python -m pytest tests/test_audit.py tests/test_shuffle.py \
+      tests/test_batch_queue.py tests/test_dataset.py \
+      tests/test_jax_dataset.py tests/test_audit_report.py \
+      -m "not slow" -q -x
 fi
 if [ "$tier" != "fast" ]; then
   python -m pytest tests/ -m slow -v --durations=10 || rc=$?

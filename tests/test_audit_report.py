@@ -1,0 +1,207 @@
+"""tools/audit_report.py smoke tests against synthetic CSV/JSON artifacts
+(the CI-lane guard for the report CLI: parse, join, render, exit code)."""
+
+import csv
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def audit_report():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+        "audit_report.py",
+    )
+    spec = importlib.util.spec_from_file_location("audit_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_artifacts(tmp_path, ok=True):
+    epochs = [
+        {
+            "epoch": 0,
+            "ok": True,
+            "mismatch": [],
+            "rows_mapped": 2000,
+            "rows_reduced": 2000,
+            "rows_delivered": 2000,
+            "rows_consumed": 2000,
+            "map_digest": "aa:bb",
+            "reduce_digest": "aa:bb",
+            "delivered_digest": "aa:bb",
+            "delivered_seq": "cafe",
+            "adjacent_pair_retention": None,
+            "mean_normalized_displacement": None,
+            "source_entropy_mean": 0.99,
+        },
+        {
+            "epoch": 1,
+            "ok": ok,
+            "mismatch": [] if ok else ["delivered"],
+            "rows_mapped": 2000,
+            "rows_reduced": 2000,
+            "rows_delivered": 2000 if ok else 1999,
+            "rows_consumed": 2000 if ok else 1999,
+            "map_digest": "aa:bb",
+            "reduce_digest": "aa:bb",
+            "delivered_digest": "aa:bb" if ok else "dd:ee",
+            "delivered_seq": "beef",
+            "adjacent_pair_retention": 0.001,
+            "mean_normalized_displacement": 0.34,
+            "source_entropy_mean": 0.98,
+        },
+    ]
+    bench = {
+        "metric": "m",
+        "value": 1.5,
+        "unit": "GB/s/chip",
+        "vs_baseline": 0.9,
+        "stall_pct": 3.2,
+        "backend": "cpu",
+        "loader": "mapreduce",
+        "audit": {
+            "ok": ok,
+            "mismatch_epochs": [] if ok else [1],
+            "epochs": epochs,
+        },
+    }
+    bench_path = str(tmp_path / "bench.json")
+    with open(bench_path, "w") as f:
+        # Log noise around the JSON line exercises the tolerant parser.
+        f.write("[bench] some log line\n")
+        f.write(json.dumps(bench) + "\n")
+    metrics_payload = {
+        "samples": [],
+        "final": {
+            "audit.rows_mapped": 4000.0,
+            "audit.rows_delivered": 4000.0 if ok else 3999.0,
+            "audit.digest_mismatch": 0.0 if ok else 1.0,
+            "audit.epoch_ok{epoch=0}": 1.0,
+            "audit.epoch_ok{epoch=1}": 1.0 if ok else 0.0,
+            "audit.adjacent_pair_retention{epoch=1}": 0.001,
+        },
+    }
+    metrics_path = str(tmp_path / "run.metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(metrics_payload, f)
+    trial_path = str(tmp_path / "trial_stats.csv")
+    with open(trial_path, "w", newline="") as f:
+        w = csv.DictWriter(
+            f,
+            fieldnames=[
+                "trial", "duration", "num_rows", "num_epochs",
+                "row_throughput", "audit_epochs_ok",
+                "audit_mismatch_epochs",
+            ],
+        )
+        w.writeheader()
+        w.writerow(
+            {
+                "trial": 0,
+                "duration": 12.5,
+                "num_rows": 2000,
+                "num_epochs": 2,
+                "row_throughput": 320.0,
+                "audit_epochs_ok": 2 if ok else 1,
+                "audit_mismatch_epochs": "" if ok else "1",
+            }
+        )
+    epoch_path = str(tmp_path / "epoch_stats.csv")
+    with open(epoch_path, "w", newline="") as f:
+        w = csv.DictWriter(
+            f,
+            fieldnames=[
+                "trial", "epoch", "duration", "map_stage_duration",
+                "reduce_stage_duration", "throttle_duration",
+            ],
+        )
+        w.writeheader()
+        for e in (0, 1):
+            w.writerow(
+                {
+                    "trial": 0,
+                    "epoch": e,
+                    "duration": 5.0 + e,
+                    "map_stage_duration": 2.0,
+                    "reduce_stage_duration": 1.5,
+                    "throttle_duration": 0.1,
+                }
+            )
+    return bench_path, metrics_path, trial_path, epoch_path
+
+
+def test_full_join_renders_table(audit_report, tmp_path, capsys):
+    bench, metrics, trial, epoch = _write_artifacts(tmp_path, ok=True)
+    rc = audit_report.main(
+        [
+            "--bench", bench, "--metrics", metrics,
+            "--trial-csv", trial, "--epoch-csv", epoch,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    # Header joins bench + trial CSV + metrics counters.
+    assert "value: 1.5" in out
+    assert "row_throughput: 320.0" in out
+    assert "audit.rows_mapped: 4000" in out
+    # Per-epoch rows join verdicts with epoch-CSV timings.
+    assert "rows_delivered" in out and "epoch_s" in out
+    lines = [ln for ln in out.splitlines() if ln.strip().startswith(("0 ", "0  "))]
+    assert any("2000" in ln and "5" in ln for ln in lines), out
+
+
+def test_mismatch_sets_exit_code(audit_report, tmp_path, capsys):
+    bench, metrics, trial, epoch = _write_artifacts(tmp_path, ok=False)
+    rc = audit_report.main(["--bench", bench])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "MISMATCH" in out
+    assert "mismatch_epochs: [1]" in out
+
+
+def test_metrics_only_fallback(audit_report, tmp_path, capsys):
+    _, metrics, _, _ = _write_artifacts(tmp_path, ok=True)
+    rc = audit_report.main(["--metrics", metrics, "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    # Verdict rows reconstructed from the audit.* gauge vocabulary.
+    assert [e["epoch"] for e in report["epochs"]] == [0, 1]
+    assert report["epochs"][1]["adjacent_pair_retention"] == 0.001
+    assert report["header"]["audit_ok"] is True
+
+
+def test_zero_coverage_is_not_a_pass(audit_report, tmp_path, capsys):
+    """Verdicts present but none reconciled (ok=null everywhere — wrong
+    key column / unshared spool) must NOT exit 0: a CI gate would go
+    green with zero rows audited."""
+    bench = {
+        "metric": "m",
+        "value": 1.0,
+        "audit": {
+            "ok": None,
+            "mismatch_epochs": [],
+            "epochs": [
+                {"epoch": 0, "ok": None, "detail": "no records"},
+                {"epoch": 1, "ok": None, "detail": "no records"},
+            ],
+        },
+    }
+    path = str(tmp_path / "bench.json")
+    with open(path, "w") as f:
+        json.dump(bench, f)
+    rc = audit_report.main(["--bench", path])
+    captured = capsys.readouterr()
+    assert rc == 3
+    assert "zero coverage" in captured.err
+
+
+def test_usage_error_exit_code(audit_report, capsys):
+    rc = audit_report.main([])
+    assert rc == 2
+    assert "need at least one" in capsys.readouterr().err

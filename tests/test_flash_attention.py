@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from jax_compat import needs_kernel_partitioning_apis
+
 from ray_shuffling_data_loader_tpu.ops import attention_reference
 from ray_shuffling_data_loader_tpu.ops.flash_attention import flash_attention
 
@@ -28,6 +30,7 @@ def _qkv(shape, seed=0, dtype=jnp.float32):
         ((2, 8, 1, 4), (128, 128)),  # seq smaller than the block
     ],
 )
+@needs_kernel_partitioning_apis
 def test_matches_dense_reference(causal, shape, blocks):
     q, k, v = _qkv(shape, seed=1)
     got = flash_attention(
@@ -46,6 +49,7 @@ def test_matches_dense_reference(causal, shape, blocks):
     )
 
 
+@needs_kernel_partitioning_apis
 def test_bfloat16(seed=3):
     q, k, v = _qkv((2, 32, 2, 8), seed=seed, dtype=jnp.bfloat16)
     got = flash_attention(
@@ -61,6 +65,7 @@ def test_bfloat16(seed=3):
     )
 
 
+@needs_kernel_partitioning_apis
 def test_gradients_exact():
     """The custom VJP is the dense reference's gradient — exact."""
     q, k, v = _qkv((1, 32, 2, 8), seed=4)
@@ -85,6 +90,7 @@ def test_gradients_exact():
         )
 
 
+@needs_kernel_partitioning_apis
 @pytest.mark.parametrize("causal", [False, True])
 def test_gradients_multi_chunk_ragged(causal):
     """Backward with several KV chunks and a ragged tail (T=300 over
@@ -111,6 +117,7 @@ def test_gradients_multi_chunk_ragged(causal):
         )
 
 
+@needs_kernel_partitioning_apis
 def test_gradients_sharded_mesh():
     """Forward AND fused backward under a multi-device pjit: the
     custom_partitioning wrappers split both pallas calls batch-wise on
@@ -143,6 +150,7 @@ def test_gradients_sharded_mesh():
         )
 
 
+@needs_kernel_partitioning_apis
 def test_flash_backward_xla_escape_hatch(monkeypatch):
     """RSDL_FLASH_BWD=xla routes the VJP through the chunked-XLA
     backward; gradients stay exact."""

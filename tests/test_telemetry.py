@@ -256,6 +256,84 @@ def test_type_conflict_rejected(telemetry_on):
         metrics.registry.gauge("x.bytes")
 
 
+def test_histogram_zero_observations(telemetry_on):
+    """A registered-but-never-observed histogram snapshots count/sum only
+    — no _min/_max keys (their inf sentinels must never leak into
+    artifacts or the Prometheus export)."""
+    metrics.registry.histogram("empty.hist")
+    snap = metrics.registry.snapshot()
+    assert snap["empty.hist_count"] == 0.0
+    assert snap["empty.hist_sum"] == 0.0
+    assert "empty.hist_min" not in snap
+    assert "empty.hist_max" not in snap
+    # And the export renders it without inf/nan.
+    text = metrics.to_prometheus_text(snap)
+    assert "inf" not in text and "nan" not in text
+
+
+def test_register_source_name_collision_replaces(telemetry_on):
+    """Re-using a source name replaces the previous callable (the
+    documented semantics) — and resets its failure count, so a re-pointed
+    source isn't dropped for its predecessor's sins."""
+    metrics.register_source("s", lambda: {"v": 1.0})
+    assert metrics.global_snapshot()["v"] == 1.0
+
+    def dying():
+        raise RuntimeError("old actor died")
+
+    metrics.register_source("s", dying)
+    metrics.global_snapshot()
+    metrics.global_snapshot()  # two failures accrued on the replacement
+    metrics.register_source("s", lambda: {"v": 3.0})
+    # Fresh failure budget: polls keep succeeding well past the old limit.
+    for _ in range(5):
+        assert metrics.global_snapshot()["v"] == 3.0
+
+
+def test_refresh_from_env_toggles_midrun(telemetry_on):
+    """refresh_from_env re-reads RSDL_METRICS: flipping the env mid-run
+    takes effect at the next enabled() check (the cached-boolean gate)."""
+    assert metrics.enabled()
+    os.environ.pop("RSDL_METRICS", None)
+    metrics.refresh_from_env()
+    assert not metrics.enabled()
+    os.environ["RSDL_METRICS"] = "1"
+    # Stale cache until refreshed — that IS the zero-overhead contract.
+    assert not metrics.enabled()
+    metrics.refresh_from_env()
+    assert metrics.enabled()
+
+
+def test_to_prometheus_text_format(telemetry_on):
+    reg = metrics.registry
+    reg.counter("h2d.bytes").inc(128)
+    reg.counter("big.rows").inc(1_234_567)
+    reg.gauge("queue.depth", epoch=0, rank=1).set(4)
+    reg.histogram("h2d.dispatch_seconds").observe(0.5)
+    reg.histogram("queue.wait", epoch=2).observe(1.0)
+    text = metrics.to_prometheus_text(metrics.global_snapshot())
+    lines = text.splitlines()
+    assert lines[0].startswith("#")
+    # Names sanitized to the Prometheus charset; labels quoted; our
+    # key syntax maps 1:1.
+    assert "h2d_bytes 128" in text
+    assert 'queue_depth{epoch="0",rank="1"} 4' in text
+    assert "h2d_dispatch_seconds_count 1" in text
+    assert "h2d_dispatch_seconds_sum 0.5" in text
+    # Counters render exactly (%g would truncate to 6 significant digits).
+    assert "big_rows 1234567\n" in text
+    # A labeled histogram's "_count" suffix belongs to the NAME, with the
+    # labels preserved — not mangled into the sanitized name.
+    assert 'queue_wait_count{epoch="2"} 1' in text
+    # Non-finite values render as Prometheus literals, not a crash.
+    assert metrics.to_prometheus_text(
+        {"weird": float("nan"), "hot": float("inf")}
+    ).count("NaN") == 1
+    # Deterministic output: samples sorted by key.
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    assert samples == sorted(samples)
+
+
 # ---------------------------------------------------------------------------
 # End-to-end acceptance: CPU-backend shuffle -> trace + metrics artifacts
 # ---------------------------------------------------------------------------
