@@ -1305,6 +1305,20 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
         result = _error_result(platform, f"{type(exc).__name__}: {exc}")
+        result["error_type"] = type(exc).__name__
+        # Structured stage-failure fields (shuffle.StageFailedError, or
+        # batch_queue.ProducerDiedError's epoch/rank): a poison task that
+        # exhausted its retry budget names its stage and epoch in the
+        # artifact instead of burying them in the message.
+        for attr, key in (
+            ("stage", "failed_stage"),
+            ("epoch", "failed_epoch"),
+            ("attempts", "failed_attempts"),
+            ("rank", "failed_rank"),
+        ):
+            value = getattr(exc, attr, None)
+            if value is not None:
+                result[key] = value
         if tpu_error is not None:
             result["tpu_error"] = str(tpu_error)[:300]
     # Stop any sampler threads run_bench left running (it only reaches its

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
 from collections.abc import Iterable
 from typing import Any, Dict, List, Optional
 
@@ -34,6 +35,38 @@ class Empty(Exception):
 
 class Full(Exception):
     pass
+
+
+class ProducerDiedError(Exception):
+    """A blocking consumer ``get``/``get_batch`` found the queue empty
+    and the registered producer process dead — the epoch can never
+    complete, so the consumer unblocks with a structured error instead
+    of hanging forever (the pre-PR-3 behavior). Carries ``(epoch,
+    rank)`` so a trainer can decide to resume the epoch with a fresh
+    driver (the shuffle is deterministic per ``(seed, epoch)``)."""
+
+    def __init__(self, epoch: int, rank: int):
+        super().__init__(
+            f"batch-queue producer died before finishing epoch {epoch} "
+            f"(consumer rank {rank}); the epoch cannot complete"
+        )
+        self.epoch = epoch
+        self.rank = rank
+
+    def __reduce__(self):
+        return (ProducerDiedError, (self.epoch, self.rank))
+
+
+def _liveness_interval_s() -> float:
+    """How long a blocking consumer waits between producer-liveness
+    checks — the detection bound for :class:`ProducerDiedError`.
+    Clamped to >= 50 ms: a zero/negative setting would turn every
+    blocking get into a tight RPC spin against the queue actor."""
+    try:
+        value = float(os.environ.get("RSDL_PRODUCER_LIVENESS_S", "2.0"))
+    except ValueError:
+        return 2.0
+    return max(0.05, value)
 
 
 DEFAULT_QUEUE_NAME = "BatchQueue"
@@ -67,6 +100,34 @@ class _QueueActor:
             [asyncio.Event() for _ in range(num_trainers)]
             for _ in range(num_epochs)
         ]
+        # Producer-liveness supervision (PR 3): the shuffle driver
+        # registers its pid; a blocking consumer whose queue stays empty
+        # asks producer_alive() and unblocks with ProducerDiedError when
+        # the producer died mid-epoch. The queue actor always runs on
+        # the producer's host (rank 0 spawns it), so a pid probe is a
+        # valid liveness check.
+        self._producer_pid: Optional[int] = None
+
+    def register_producer(self, pid: int) -> None:
+        self._producer_pid = int(pid)
+
+    def producer_alive(self, epoch: int) -> bool:
+        """Can epoch ``epoch`` still make progress? True when the
+        producer already signalled done for every rank (sentinels are in
+        band — consumers will drain them), when no producer registered
+        (bare queue uses keep the old block-forever semantics), or when
+        the registered producer pid is alive."""
+        if all(e.is_set() for e in self.producer_done_events[epoch]):
+            return True
+        if self._producer_pid is None:
+            return True
+        try:
+            os.kill(self._producer_pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+        return True
 
     async def new_epoch(self, epoch: int):
         # Admission control: with max_epochs epochs in flight, wait for the
@@ -167,11 +228,17 @@ class _QueueActor:
         self.space_events[epoch][rank].set()
         return item
 
-    async def get_batch(self, rank, epoch):
+    async def get_batch(self, rank, epoch, timeout=None):
         # Block for one item, then opportunistically drain whatever else has
-        # already arrived (reference batch_queue.py:468-475).
+        # already arrived (reference batch_queue.py:468-475). ``timeout``
+        # bounds the initial blocking get (Empty on expiry) so the client
+        # can interleave producer-liveness checks.
         queue = self.queues[epoch][rank]
-        batch = [await queue.get()]
+        try:
+            first = await asyncio.wait_for(queue.get(), timeout)
+        except asyncio.TimeoutError:
+            raise Empty from None
+        batch = [first]
         while True:
             try:
                 batch.append(queue.get_nowait())
@@ -270,6 +337,11 @@ class BatchQueue:
                 maxsize,
                 name=name,
             )
+            # The creating process IS the producer (rank 0 drives the
+            # shuffle); registering its pid arms the consumer-side
+            # liveness supervision (ProducerDiedError instead of an
+            # unbounded hang when this process dies mid-epoch).
+            self.actor.call("register_producer", os.getpid())
             if _metrics.enabled():
                 # Cross-process metrics source: the sampler thread pulls
                 # the actor's live per-(epoch, rank) depths into every
@@ -367,7 +439,20 @@ class BatchQueue:
                 raise Empty from None
         if timeout is not None and timeout < 0:
             raise ValueError("'timeout' must be a non-negative number")
-        return self.actor.call("get", rank, epoch, timeout)
+        if timeout is not None:
+            # Caller-bounded wait keeps its exact pre-PR-3 semantics
+            # (Empty on expiry).
+            return self.actor.call("get", rank, epoch, timeout)
+        # Unbounded wait becomes a supervised wait: block in liveness-
+        # interval slices; a dead producer with an empty queue raises
+        # ProducerDiedError instead of hanging forever.
+        interval = _liveness_interval_s()
+        while True:
+            try:
+                return self.actor.call("get", rank, epoch, interval)
+            except Empty:
+                if not self.actor.call("producer_alive", epoch):
+                    raise ProducerDiedError(epoch, rank) from None
 
     async def get_async(self, rank, epoch, block=True, timeout=None) -> Any:
         if not block:
@@ -380,7 +465,17 @@ class BatchQueue:
         return await self.actor.call_async("get", rank, epoch, timeout)
 
     def get_batch(self, rank: int, epoch: int) -> List[Any]:
-        return self.actor.call("get_batch", rank, epoch)
+        # Supervised like get(): the batch wait blocks in bounded slices
+        # and surfaces ProducerDiedError when the producer died with the
+        # queue drained (this is the trainer-side ShufflingDataset path,
+        # so a killed driver can no longer wedge every rank forever).
+        interval = _liveness_interval_s()
+        while True:
+            try:
+                return self.actor.call("get_batch", rank, epoch, interval)
+            except Empty:
+                if not self.actor.call("producer_alive", epoch):
+                    raise ProducerDiedError(epoch, rank) from None
 
     def put_nowait(self, rank, epoch, item) -> None:
         return self.put(rank, epoch, item, block=False)

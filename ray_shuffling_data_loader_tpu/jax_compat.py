@@ -1,0 +1,38 @@
+"""JAX surface compatibility shims for the pinned 0.4.37 toolchain.
+
+The kernels were written against the newer top-level ``jax.shard_map``
+(keyword ``check_vma=``); 0.4.37 only ships
+``jax.experimental.shard_map.shard_map`` (keyword ``check_rep=``), which
+is otherwise the same transform — the top-level export is a rename with
+``check_rep`` re-spelled ``check_vma`` (varying-manual-axes). This module
+presents the NEW calling convention on either toolchain, so kernel code
+has exactly one import and the version gates in ``tests/jax_compat.py``
+lift themselves on old and new pins alike (ROADMAP open item).
+
+``custom_partitioning.def_partition(sharding_rule=...)`` (jax >= 0.4.38)
+has no 0.4.37 equivalent and stays feature-gated — only the pure-alias
+``shard_map`` surface is bridged here.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map``-compatible wrapper that falls back to
+    ``jax.experimental.shard_map`` (mapping ``check_vma`` to its older
+    spelling ``check_rep``) when the top-level export is absent."""
+    try:
+        from jax import shard_map as _shard_map  # jax >= 0.5 surface
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    if check_vma is not None:
+        kwargs["check_vma"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

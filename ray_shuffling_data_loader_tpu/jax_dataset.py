@@ -392,7 +392,7 @@ class JaxShufflingDataset:
                 return feats, lab
 
             if jax.process_count() > 1:
-                from jax import shard_map
+                from ray_shuffling_data_loader_tpu.jax_compat import shard_map
 
                 row_spec = P(self.batch_axis)
                 fn = jax.jit(

@@ -458,7 +458,7 @@ def _seq_parallel_jit(
     sequence dimension (and optionally the batch dimension along
     ``batch_axis`` — composes with data parallelism), run the per-device
     ``body`` under ``shard_map``, jit with matching in/out shardings."""
-    from jax import shard_map
+    from ray_shuffling_data_loader_tpu.jax_compat import shard_map
 
     spec = P(batch_axis, axis_name, None, None)
     fn = shard_map(

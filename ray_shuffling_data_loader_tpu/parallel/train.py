@@ -246,7 +246,7 @@ def make_psum_train_step(
     optimizer state; pass ``False`` to keep reusing the input state
     object after the call.
     """
-    from jax import shard_map
+    from ray_shuffling_data_loader_tpu.jax_compat import shard_map
 
     if grad_reduce not in ("mean", "adasum"):
         raise ValueError(

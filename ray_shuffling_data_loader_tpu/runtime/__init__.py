@@ -29,6 +29,7 @@ import tempfile
 import threading
 from typing import Callable, Optional
 
+from . import faults  # noqa: F401  (the fault-injection plane)
 from .actor import (  # noqa: F401
     ActorDiedError,
     ActorHandle,
@@ -37,7 +38,15 @@ from .actor import (  # noqa: F401
     resolve_actor as _resolve_actor,
     spawn_actor as _spawn_actor,
 )
-from .store import ColumnBatch, ObjectRef, ObjectStore, StoreStats  # noqa: F401
+from .retry import RetryPolicy  # noqa: F401
+from .store import (  # noqa: F401
+    ColumnBatch,
+    ObjectCorruptError,
+    ObjectLostError,
+    ObjectRef,
+    ObjectStore,
+    StoreStats,
+)
 from .tasks import TaskError, TaskFuture, WorkerPool, wait  # noqa: F401
 
 _ENV_DIR = "RSDL_RUNTIME_DIR"

@@ -39,7 +39,8 @@ from typing import Any, Dict, Optional
 
 from ray_shuffling_data_loader_tpu import telemetry
 
-from . import transport
+from . import faults, transport
+from .retry import call_policy, connect_policy
 from .transport import Address
 
 
@@ -108,6 +109,7 @@ class _ActorHost:
         self.instance = instance
         self.address = address
         self._shutdown = None  # asyncio.Event, created on the loop
+        self._inflight = 0  # dispatches in flight (loop-thread only)
 
     async def _handle_client(self, reader, writer):
         try:
@@ -137,6 +139,7 @@ class _ActorHost:
 
     async def _dispatch(self, writer, req_id, method, args, kwargs, oneway,
                         trace_ctx=None):
+        self._inflight += 1
         try:
             if method == "__ping__":
                 result = "pong"
@@ -144,6 +147,13 @@ class _ActorHost:
                 result = None
                 self._shutdown.set()
             else:
+                if faults.enabled():
+                    # Liveness faults: `kill` exits the process abruptly
+                    # (no teardown — supervision must cope with SIGKILL
+                    # semantics); `wedge` blocks the EVENT LOOP (a
+                    # time.sleep on the loop thread), so the actor stops
+                    # answering pings — the alive-but-unresponsive case.
+                    faults.fire(f"actor.{type(self.instance).__name__}")
                 # With a propagated trace context, re-enter it and span
                 # the whole dispatch, awaits included — for the queue
                 # actor that IS the interesting number (e.g. how long
@@ -184,6 +194,17 @@ class _ActorHost:
                         await writer.drain()
                     except Exception:
                         pass
+        finally:
+            # Quiescence flush: when the last in-flight dispatch ends,
+            # drain buffered spans to the spool. Async actors can run for
+            # whole epochs without a depth-0 moment on the loop thread,
+            # so relying on the span-close heuristic alone leaves their
+            # spans invisible to a concurrent trace_export until process
+            # exit. Event-driven and cheap: no-ops when telemetry is off
+            # or the buffer is empty.
+            self._inflight -= 1
+            if self._inflight == 0:
+                telemetry.safe_flush()
 
     async def start(self):
         """Bind the server socket; returns once the actor is reachable.
@@ -235,6 +256,7 @@ def _actor_main(
                     os._exit(0)
 
         threading.Thread(target=_watch, daemon=True).start()
+    faults.set_role("actor")  # fault rules with an /actor filter fire here
     if telemetry.enabled():
         telemetry.set_process_name(f"actor:{cls.__name__}-{os.getpid()}")
     try:
@@ -324,11 +346,41 @@ class ActorHandle:
             self._req_counter += 1
             return self._req_counter
 
+    def _send_with_retry(self, req_id, method, args, kwargs, oneway):
+        """Connect + send one request frame, retrying transient
+        connection failures with bounded backoff (``call_policy``).
+
+        Only the PRE-response window retries: a connect refusal or a
+        send-time reset means the request never dispatched (a partial
+        frame is dropped by the server's framing loop without
+        executing), so a retry cannot double-execute. Failures after the
+        frame is fully sent — recv errors — are ambiguous (the method
+        may have run) and are NOT retried here; those stay
+        ``ActorDiedError`` for callers' existing death handling."""
+        policy = call_policy()
+        last: Optional[Exception] = None
+        for attempt, handle in policy.attempts(site="actor.send"):
+            try:
+                conn = self._conn()
+                conn.send(
+                    (req_id, method, args, kwargs, oneway, _trace_ctx())
+                )
+                return conn
+            except (ActorDiedError, ConnectionError, OSError) as e:
+                self._local.conn = None
+                last = e
+                if attempt >= policy.max_attempts:
+                    break
+                handle.backoff(str(e))
+        raise ActorDiedError(
+            f"cannot reach actor {self.name or self.address} "
+            f"after {policy.max_attempts} attempts: {last}"
+        ) from last
+
     def call(self, method: str, *args, **kwargs):
-        conn = self._conn()
         req_id = self._next_id()
+        conn = self._send_with_retry(req_id, method, args, kwargs, False)
         try:
-            conn.send((req_id, method, args, kwargs, False, _trace_ctx()))
             while True:
                 resp_id, status, payload = conn.recv()
                 if resp_id == req_id:
@@ -346,16 +398,9 @@ class ActorHandle:
         raise RemoteError(f"remote call {method} failed:\n{tb}")
 
     def call_oneway(self, method: str, *args, **kwargs) -> None:
-        conn = self._conn()
-        try:
-            conn.send(
-                (self._next_id(), method, args, kwargs, True, _trace_ctx())
-            )
-        except (ConnectionError, OSError) as e:
-            self._local.conn = None
-            raise ActorDiedError(
-                f"actor {self.name or self.address} died: {e}"
-            ) from e
+        self._send_with_retry(
+            self._next_id(), method, args, kwargs, True
+        )
 
     async def call_async(self, method: str, *args, **kwargs):
         loop = asyncio.get_running_loop()
@@ -553,7 +598,30 @@ def spawn_actor(
         _registry_path(runtime_dir, name) if name is not None else None
     )
     if registry_path is not None and os.path.exists(registry_path):
-        raise ValueError(f"actor name {name!r} already registered")
+        # A SIGKILLed actor never unlinks its record; a live holder is a
+        # real conflict, a dead one is evicted and the name reclaimed
+        # (same policy as the cluster registry's register_named_actor).
+        # Liveness is judged by the record's PID first — local records
+        # always carry one, and a pid probe cannot false-negative on a
+        # loaded host the way a short ping can (evicting a live-but-busy
+        # actor would spawn a same-name duplicate: split-brain). Only a
+        # pid-less record falls back to pings, escalating like the
+        # cluster scheduler's ladder before concluding death.
+        stale = resolve_actor(name, runtime_dir)
+        holder_alive = False
+        if stale is not None:
+            if stale.pid is not None:
+                holder_alive = _pid_alive(stale.pid)
+            else:
+                holder_alive = any(
+                    stale.ping(timeout=t) for t in (2.0, 5.0, 10.0)
+                )
+        if holder_alive:
+            raise ValueError(f"actor name {name!r} already registered")
+        try:
+            os.unlink(registry_path)
+        except FileNotFoundError:
+            pass
 
     ctx = mp.get_context("spawn")
     ready_q = ctx.Queue()
@@ -631,26 +699,27 @@ def connect_actor(
     num_retries: int = 5,
     fallback_resolver=None,
 ) -> ActorHandle:
-    """Discover a named actor, retrying with exponential backoff (parity with
-    reference ``connect_queue_actor``, ``batch_queue.py:358-380``).
+    """Discover a named actor, retrying with capped, jittered
+    exponential backoff via the shared :class:`~.retry.RetryPolicy`
+    (parity with reference ``connect_queue_actor``,
+    ``batch_queue.py:358-380``; the old loop doubled its sleep without a
+    cap or jitter, so N trainers reconnecting after a queue-actor
+    restart thundering-herded in lockstep).
 
     ``fallback_resolver(name) -> Optional[ActorHandle]`` is consulted when
     the local session registry misses (cluster mode: the head's registry).
     """
-    retries = 0
-    sleep_dur = 1.0
+    policy = connect_policy(num_retries)
     last_exc: Optional[Exception] = None
-    while retries < num_retries:
+    for attempt, backoff in policy.attempts(site="connect_actor"):
         handle = resolve_actor(name, runtime_dir)
         if handle is None and fallback_resolver is not None:
             handle = fallback_resolver(name)
         if handle is not None and handle.ping():
             return handle
-        retries += 1
         last_exc = ActorDiedError(f"no live actor registered as {name!r}")
-        if retries < num_retries:
-            time.sleep(sleep_dur)
-            sleep_dur *= 2
+        if attempt < policy.max_attempts:
+            backoff.backoff(str(last_exc))
     raise ValueError(
         f"Unable to connect to actor {name} after {num_retries} retries. "
         f"Last error: {last_exc!s}"

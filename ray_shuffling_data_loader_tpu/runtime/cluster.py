@@ -441,9 +441,18 @@ class ClusterScheduler:
 
     def _drop_agent(self, agent: ActorHandle) -> None:
         with self._lock:
+            before = len(self._agents)
             self._agents = [
                 a for a in self._agents if a.address != agent.address
             ]
+            removed = len(self._agents) != before
+        if not removed:
+            # Concurrent submits can race to drop the same dead agent;
+            # only the actual removal counts an eviction and fires the
+            # membership callback (an alert on recovery.agent_evictions
+            # must read one per dead host, not one per racing task).
+            return
+        telemetry.metrics.safe_inc("recovery.agent_evictions")
         if self.on_agent_dead is not None:
             try:
                 self.on_agent_dead(agent)
@@ -474,6 +483,9 @@ class ClusterScheduler:
                         # drop. Task bodies are idempotent over the
                         # store, so a retry after an ambiguous failure
                         # is safe.
+                        telemetry.metrics.safe_inc(
+                            "recovery.retries", site="agent.submit"
+                        )
                         return True, agent.call("submit", fn, args, kwargs)
                     except ActorDiedError:
                         pass
@@ -495,6 +507,10 @@ class ClusterScheduler:
                 ok, result = self._submit_once(agent, fn, args, kwargs)
                 if ok:
                     return result
+                # The agent died: the task fails over to the next host in
+                # the rotation (bounded — every failure evicts an agent,
+                # and an empty rotation raises ActorDiedError above).
+                telemetry.metrics.safe_inc("recovery.task_failover")
 
     def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
         inner = self._executor.submit(

@@ -1,0 +1,359 @@
+"""Deterministic fault-injection plane for the runtime (chaos testing).
+
+Production data loaders are only trustworthy when their failure handling
+is *exercised*, not just written: tf.data-service-style disaggregated
+input pipelines treat injectable, recoverable failures as part of the
+service contract, and the PR-2 audit digests give this repo an oracle
+that can prove recovery preserved exactly-once delivery. This module is
+the injection half of that story: named fault sites threaded through the
+runtime (transport send/recv, store get/put, task stage entry/exit,
+actor dispatch, queue producer) fire scripted faults *deterministically*
+so a chaos run is reproducible bit-for-bit.
+
+Env contract (same zero-overhead-off pattern as ``telemetry/_env.py``):
+
+* ``RSDL_FAULTS`` — comma-separated rules, each
+  ``site[/role]:kind:prob[@epoch][xN]``:
+
+  - ``site``: the injection-site name (``transport.send``, ``store.get``,
+    ``task.map``, ``task.reduce``, ``actor.<Class>``, ``queue.producer``).
+  - ``/role`` (optional): only fire in processes with that role —
+    ``driver`` (default for any process), ``task`` (pool workers),
+    ``actor`` (actor hosts). Without it the rule fires everywhere the
+    site exists.
+  - ``kind``: what happens — ``crash`` / ``crash-entry`` / ``crash-exit``
+    (raise :class:`FaultInjected`), ``reset`` (ConnectionResetError),
+    ``delay`` / ``stall`` (sleep ``RSDL_FAULTS_DELAY_S``), ``lost`` /
+    ``corrupt`` (store sites raise Object{Lost,Corrupt}Error), ``fail``
+    (OSError), ``kill`` (``os._exit``), ``wedge`` (sleep
+    ``RSDL_FAULTS_WEDGE_S``).
+  - ``prob``: per-invocation firing probability in (0, 1].
+  - ``@epoch`` (optional): only fire for that epoch (sites that know it).
+  - ``xN`` (optional): fire at most N times *per process*.
+
+* ``RSDL_FAULTS_SEED`` — the determinism anchor: the fire/no-fire
+  decision for invocation *i* of a site is a pure function of
+  ``(seed, site, kind, i)`` (splitmix64), so a fixed seed replays the
+  same schedule. Per-process invocation counters make the schedule
+  deterministic per process; pipeline-level determinism follows when the
+  task placement is (as in the tests' fixed-size pools).
+
+With ``RSDL_FAULTS`` unset every site costs one cached boolean check —
+the same no-op constant the telemetry gates pay.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_FAULTS = "RSDL_FAULTS"
+ENV_SEED = "RSDL_FAULTS_SEED"
+ENV_DELAY_S = "RSDL_FAULTS_DELAY_S"
+ENV_WEDGE_S = "RSDL_FAULTS_WEDGE_S"
+
+_KINDS = {
+    "crash",
+    "crash-entry",
+    "crash-exit",
+    "reset",
+    "delay",
+    "stall",
+    "lost",
+    "corrupt",
+    "fail",
+    "kill",
+    "wedge",
+}
+
+_enabled: Optional[bool] = None  # tri-state: None = env not read yet
+_lock = threading.Lock()
+_rules: Optional[List["Rule"]] = None
+_invocations: Dict[str, int] = {}  # site -> per-process invocation count
+_fired: Dict[Tuple[str, str], int] = {}  # (site, kind) -> fire count
+_role = "driver"
+
+
+class FaultInjected(RuntimeError):
+    """An injected crash fault — deliberately NOT a subclass of any
+    domain error, so recovery paths that catch it are proving they
+    tolerate arbitrary task/stage crashes."""
+
+    def __init__(self, site: str, kind: str):
+        super().__init__(f"injected fault at {site} ({kind})")
+        self.site = site
+        self.kind = kind
+
+
+@dataclass
+class Rule:
+    site: str
+    kind: str
+    prob: float
+    role: Optional[str] = None
+    epoch: Optional[int] = None
+    max_fires: Optional[int] = None
+    fired: int = field(default=0)
+
+
+def enabled() -> bool:
+    """Is fault injection armed in this process? Cached after the first
+    env read — the faults-off hot path pays one boolean check."""
+    global _enabled
+    if _enabled is None:
+        _enabled = bool(os.environ.get(ENV_FAULTS, "").strip())
+    return _enabled
+
+
+def refresh_from_env() -> None:
+    """Forget cached state; the next check re-reads the env (test hook)."""
+    global _enabled, _rules
+    with _lock:
+        _enabled = None
+        _rules = None
+        _invocations.clear()
+        _fired.clear()
+
+
+def reset() -> None:
+    """Disarm completely: drop the env spec and all cached state."""
+    os.environ.pop(ENV_FAULTS, None)
+    refresh_from_env()
+
+
+def configure(spec: str, seed: Optional[int] = None) -> None:
+    """Arm a fault schedule for this process AND (via the environment)
+    every process spawned after this call — like ``telemetry.enable``,
+    call before the worker pool first spawns. Parses eagerly so a typo'd
+    schedule fails at the call site, not silently mid-run."""
+    parse_spec(spec)  # validate
+    os.environ[ENV_FAULTS] = spec
+    if seed is not None:
+        os.environ[ENV_SEED] = str(int(seed))
+    refresh_from_env()
+
+
+def set_role(role: str) -> None:
+    """Tag this process's role (``driver``/``task``/``actor``) for rule
+    ``/role`` filters. Called by the task-worker and actor entrypoints."""
+    global _role
+    _role = role
+
+
+def role() -> str:
+    return _role
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    """``site[/role]:kind:prob[@epoch][xN],...`` -> rules (raises
+    ValueError on malformed entries)."""
+    rules: List[Rule] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"bad fault rule {entry!r}: want site[/role]:kind:prob"
+                "[@epoch][xN]"
+            )
+        site, kind, tail = parts
+        rule_role = None
+        if "/" in site:
+            site, rule_role = site.split("/", 1)
+        if kind not in _KINDS:
+            raise ValueError(
+                f"bad fault kind {kind!r} in {entry!r}; known: "
+                f"{sorted(_KINDS)}"
+            )
+        epoch = None
+        max_fires = None
+        if "x" in tail:
+            tail, max_part = tail.rsplit("x", 1)
+            max_fires = int(max_part)
+        if "@" in tail:
+            tail, epoch_part = tail.split("@", 1)
+            epoch = int(epoch_part)
+        prob = float(tail)
+        if not (0.0 < prob <= 1.0):
+            raise ValueError(f"bad fault prob {prob!r} in {entry!r}")
+        rules.append(
+            Rule(
+                site=site,
+                kind=kind,
+                prob=prob,
+                role=rule_role,
+                epoch=epoch,
+                max_fires=max_fires,
+            )
+        )
+    return rules
+
+
+def _get_rules() -> List[Rule]:
+    global _rules
+    with _lock:
+        if _rules is None:
+            spec = os.environ.get(ENV_FAULTS, "")
+            try:
+                _rules = parse_spec(spec)
+            except ValueError:
+                # A malformed schedule in a spawned worker must not sink
+                # the data path; the driver's configure() already raised.
+                logger.error("faults: unparseable %s=%r; injection off",
+                             ENV_FAULTS, spec)
+                _rules = []
+        return _rules
+
+
+def _seed() -> int:
+    try:
+        return int(os.environ.get(ENV_SEED, "0"))
+    except ValueError:
+        return 0
+
+
+_MASK = (1 << 64) - 1
+
+
+def _splitmix64(z: int) -> int:
+    z = (z + 0x9E3779B97F4A7C15) & _MASK
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+    return (z ^ (z >> 31)) & _MASK
+
+
+def _decision(site: str, kind: str, invocation: int) -> float:
+    """Uniform [0, 1) drawn deterministically from (seed, site, kind,
+    invocation) — the reproducibility contract of the whole plane."""
+    h = _seed() & _MASK
+    for token in (site, kind):
+        for ch in token.encode():
+            h = _splitmix64(h ^ ch)
+    return _splitmix64(h ^ invocation) / float(1 << 64)
+
+
+def _base_kind(kind: str) -> str:
+    return kind.split("-", 1)[0]
+
+
+def should_fire(
+    site: str, epoch: Optional[int] = None, point: Optional[str] = None
+) -> Optional[str]:
+    """Decide whether a fault fires at this site invocation; returns the
+    BASE kind to act on (``crash``, ``lost``, ...) or None. Sites with
+    bespoke actions (the store's lost/corrupt) call this and act
+    themselves; everything else goes through :func:`fire`."""
+    if not enabled():
+        return None
+    rules = _get_rules()
+    if not rules:
+        return None
+    with _lock:
+        inv = _invocations.get(site, 0)
+        _invocations[site] = inv + 1
+    for rule in rules:
+        if rule.site != site:
+            continue
+        if rule.role is not None and rule.role != _role:
+            continue
+        if rule.epoch is not None and rule.epoch != epoch:
+            continue
+        # entry/exit-suffixed kinds only fire at their matching point;
+        # unsuffixed kinds fire at any point.
+        suffix = (
+            rule.kind.split("-", 1)[1] if "-" in rule.kind else None
+        )
+        if suffix is not None and suffix != point:
+            continue
+        if rule.max_fires is not None and rule.fired >= rule.max_fires:
+            continue  # unlocked fast path; re-checked under the lock
+        if rule.prob < 1.0 and _decision(
+            site, rule.kind, inv
+        ) >= rule.prob:
+            continue
+        with _lock:
+            # Check-and-act atomically: concurrent threads racing an
+            # unlocked cap check could both fire, overshooting xN — and
+            # the CI chaos lane's no-flake argument depends on the caps
+            # being exact.
+            if rule.max_fires is not None and rule.fired >= rule.max_fires:
+                continue
+            rule.fired += 1
+            key = (site, _base_kind(rule.kind))
+            _fired[key] = _fired.get(key, 0) + 1
+        _note_fired(site, _base_kind(rule.kind), epoch)
+        return _base_kind(rule.kind)
+    return None
+
+
+def _note_fired(site: str, kind: str, epoch: Optional[int]) -> None:
+    logger.warning(
+        "faults: injecting %s at %s (epoch=%s, pid=%d, role=%s)",
+        kind, site, epoch, os.getpid(), _role,
+    )
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
+
+        _m.safe_inc("faults.injected", site=site, kind=kind)
+    except Exception:
+        pass
+
+
+def _delay_s() -> float:
+    try:
+        return float(os.environ.get(ENV_DELAY_S, "0.05"))
+    except ValueError:
+        return 0.05
+
+
+def _wedge_s() -> float:
+    try:
+        return float(os.environ.get(ENV_WEDGE_S, "30"))
+    except ValueError:
+        return 30.0
+
+
+def fire(
+    site: str, epoch: Optional[int] = None, point: Optional[str] = None
+) -> None:
+    """Decide AND act: raise/sleep/kill per the armed rule's kind.
+    Call sites guard with ``if faults.enabled():`` so the disabled path
+    never enters here."""
+    kind = should_fire(site, epoch=epoch, point=point)
+    if kind is None:
+        return
+    if kind == "crash":
+        raise FaultInjected(site, kind)
+    if kind == "reset":
+        raise ConnectionResetError(f"injected connection reset at {site}")
+    if kind == "fail":
+        raise OSError(f"injected failure at {site}")
+    if kind in ("delay", "stall"):
+        time.sleep(_delay_s())
+        return
+    if kind == "wedge":
+        time.sleep(_wedge_s())
+        return
+    if kind == "kill":
+        # SIGKILL-equivalent: no atexit, no teardown — the supervision
+        # paths must cope with an abrupt death, not a graceful exit.
+        os._exit(17)
+    if kind in ("lost", "corrupt"):
+        # Store-specific kinds reaching the generic path (mis-sited
+        # rule): treat as a crash so the mistake is loud.
+        raise FaultInjected(site, kind)
+
+
+def fired_counts() -> Dict[Tuple[str, str], int]:
+    """Per-(site, kind) fire counts in THIS process (tests assert the
+    schedule actually fired, not just that the run survived)."""
+    with _lock:
+        return dict(_fired)

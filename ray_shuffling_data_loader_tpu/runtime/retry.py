@@ -1,0 +1,181 @@
+"""One retry policy for the whole runtime.
+
+Before this module the runtime had four hand-rolled retry loops
+(``connect_actor``'s uncapped exponential sleep, ``wait_ready``'s ping
+loop, the cluster scheduler's ping ladder, the shuffle driver's none at
+all) that disagreed about backoff, caps, and jitter — and the uncapped
+one thundering-herded N trainers in lockstep after a queue-actor
+restart. :class:`RetryPolicy` is the single definition: bounded
+attempts, exponential backoff with a cap, decorrelating jitter, and an
+optional overall deadline. Every retry increments the
+``recovery.retries{site=...}`` counter (metrics registry, when enabled)
+and drops a ``recovery:retry`` instant on the trace timeline, so a chaos
+run's recovery work is observable with the same tooling as its schedule.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+
+def _observe_retry(site: str, attempt: int, error: str) -> None:
+    """Recovery observability: counter + trace instant, both no-ops when
+    the respective telemetry half is off. Never raises into the retry
+    loop (a broken metrics source must not break recovery itself)."""
+    try:
+        from ray_shuffling_data_loader_tpu import telemetry
+
+        telemetry.metrics.safe_inc("recovery.retries", site=site)
+        if telemetry.enabled():
+            telemetry.instant(
+                "recovery:retry", cat="recovery", site=site,
+                attempt=attempt, error=error[:200],
+            )
+    except Exception:
+        pass
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter.
+
+    ``max_attempts`` counts the first try: 3 means one try plus two
+    retries. ``jitter`` is the randomized fraction of each delay —
+    ``delay * (1 - jitter) + U[0, jitter) * delay`` — so N clients
+    retrying after one shared event (a queue-actor restart) decorrelate
+    instead of stampeding in lockstep. ``deadline_s`` bounds the total
+    time across attempts (sleeps are clipped to it)."""
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    deadline_s: Optional[float] = None
+
+    def delay(self, attempt: int) -> float:
+        """The backoff before retry ``attempt`` (1-based: the delay
+        after the ``attempt``-th failure)."""
+        d = min(
+            self.max_delay_s,
+            self.base_delay_s * (self.multiplier ** max(0, attempt - 1)),
+        )
+        if self.jitter > 0:
+            d = d * (1.0 - self.jitter) + random.random() * self.jitter * d
+        return d
+
+    def attempts(self, site: str = "") -> Iterator[Tuple[int, "_Attempt"]]:
+        """Iterate ``(attempt_number, handle)``; call
+        ``handle.backoff(error)`` after a failure to sleep (and record
+        the retry) before the next attempt. Stops after ``max_attempts``
+        or when the deadline would be exceeded."""
+        deadline = (
+            None
+            if self.deadline_s is None
+            else time.monotonic() + self.deadline_s
+        )
+        for attempt in range(1, self.max_attempts + 1):
+            yield attempt, _Attempt(self, site, attempt, deadline)
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+
+class _Attempt:
+    __slots__ = ("_policy", "_site", "_attempt", "_deadline")
+
+    def __init__(self, policy, site, attempt, deadline):
+        self._policy = policy
+        self._site = site
+        self._attempt = attempt
+        self._deadline = deadline
+
+    def backoff(self, error: str = "") -> None:
+        _observe_retry(self._site, self._attempt, error)
+        d = self._policy.delay(self._attempt)
+        if self._deadline is not None:
+            d = min(d, max(0.0, self._deadline - time.monotonic()))
+        if d > 0:
+            time.sleep(d)
+
+
+# Shared default policies, overridable via env for operators tuning a
+# deployment (and for tests that want fast failure).
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def connect_policy(num_retries: int) -> RetryPolicy:
+    """Discovery backoff (``connect_actor``): capped at
+    ``RSDL_CONNECT_MAX_BACKOFF_S`` (default 5 s) with 50% jitter — N
+    trainers reconnecting after a queue-actor restart spread out instead
+    of re-dialing in lockstep (the old loop doubled 1 s unbounded with
+    zero jitter)."""
+    try:
+        cap = float(os.environ.get("RSDL_CONNECT_MAX_BACKOFF_S", "5"))
+    except ValueError:
+        cap = 5.0
+    return RetryPolicy(
+        max_attempts=max(1, num_retries),
+        base_delay_s=0.5,
+        max_delay_s=cap,
+        multiplier=2.0,
+        jitter=0.5,
+    )
+
+
+_call_policy_cache: Optional[RetryPolicy] = None
+
+
+def call_policy() -> RetryPolicy:
+    """Pre-send transport retry (``ActorHandle.call``): small and fast —
+    its job is riding out one connection reset, not masking a dead
+    actor (death still surfaces as ``ActorDiedError`` within ~0.3 s).
+    The deadline bounds the TOTAL pre-send window even when the OS-level
+    connect timeouts are long (a wedged-but-listening peer).
+
+    Cached: this sits on the hottest control-plane path (every queue
+    ack, every stats oneway), so the env reads happen once per process
+    — :func:`refresh_policies` forgets the cache (test hook)."""
+    global _call_policy_cache
+    if _call_policy_cache is None:
+        _call_policy_cache = RetryPolicy(
+            max_attempts=_env_int("RSDL_CALL_RETRIES", 3),
+            base_delay_s=0.05,
+            max_delay_s=0.5,
+            multiplier=2.0,
+            jitter=0.5,
+            deadline_s=_env_float("RSDL_CALL_DEADLINE_S", 10.0),
+        )
+    return _call_policy_cache
+
+
+def refresh_policies() -> None:
+    """Forget cached policies; the next use re-reads the env."""
+    global _call_policy_cache
+    _call_policy_cache = None
+
+
+def stage_policy() -> RetryPolicy:
+    """Shuffle stage (map/reduce task) re-execution budget: a poison
+    task exhausts this and fails the epoch with ``StageFailedError``
+    instead of retrying forever across hosts."""
+    return RetryPolicy(
+        max_attempts=_env_int("RSDL_STAGE_MAX_ATTEMPTS", 3),
+        base_delay_s=0.05,
+        max_delay_s=1.0,
+        multiplier=2.0,
+        jitter=0.5,
+    )

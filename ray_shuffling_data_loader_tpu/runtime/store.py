@@ -40,6 +40,8 @@ from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import faults
+
 _MAGIC = b"RSDL1\x00"
 _ALIGN = 64
 _HEADER = struct.Struct("<6sI")  # magic, json length
@@ -81,6 +83,37 @@ def _default_capacity_bytes(shm_dir: str) -> Optional[int]:
         return int(st.f_blocks * st.f_frsize * frac)
     except OSError:
         return None
+
+
+class ObjectLostError(FileNotFoundError):
+    """A store object's segment is gone (freed early, host died holding
+    the only copy, or an injected ``store.get:lost`` fault). Carries the
+    object id so the shuffle driver's lineage recovery can re-execute
+    the producing task instead of failing the epoch. Subclasses
+    ``FileNotFoundError`` so pre-existing ``except OSError`` paths keep
+    working."""
+
+    def __init__(self, object_id: str, detail: str = ""):
+        msg = f"store object {object_id!r} lost"
+        if detail:
+            msg = f"{msg} ({detail})"
+        super().__init__(2, msg)
+        self.object_id = object_id
+        self._detail = detail
+
+    def __reduce__(self):
+        # OSError's default reduce would replay (2, msg) into our
+        # (object_id, detail) signature; preserve the real fields.
+        return (type(self), (self.object_id, self._detail))
+
+
+class ObjectCorruptError(ObjectLostError):
+    """A segment exists but its payload failed validation (bad magic, or
+    an injected ``store.get:corrupt`` fault). Recovery is identical to a
+    lost object: re-materialize from lineage."""
+
+    def __init__(self, object_id: str, detail: str = "corrupt payload"):
+        super().__init__(object_id, detail)
 
 
 def _align(n: int) -> int:
@@ -508,6 +541,8 @@ class ObjectStore:
         ``seal()`` (one ref) or ``publish_slices()`` (hardlinked row-window
         refs).
         """
+        if faults.enabled():
+            faults.fire("store.put")
         meta, meta_blob, payload_start, total = _plan_layout(spec)
 
         object_id = self._new_object_id()
@@ -558,7 +593,17 @@ class ObjectStore:
         segment is not on this host and the ref names a remote owner, just
         the ref's window is pulled over DCN once and cached as a local
         standalone segment; subsequent gets map the cache (the plasma
-        cross-node transfer analog, SURVEY §2b)."""
+        cross-node transfer analog, SURVEY §2b).
+
+        A missing or unreadable segment raises :class:`ObjectLostError`
+        (carrying the object id) so callers with lineage — the shuffle
+        driver — can re-materialize instead of failing the run."""
+        if faults.enabled():
+            kind = faults.should_fire("store.get")
+            if kind == "lost":
+                raise ObjectLostError(ref.object_id, "injected fault")
+            if kind == "corrupt":
+                raise ObjectCorruptError(ref.object_id, "injected fault")
         path = self._find_segment(ref.object_id)
         rows = ref.rows
         if path is None and self._is_foreign(ref):
@@ -572,8 +617,16 @@ class ObjectStore:
             path = cache_path
             rows = None
         elif path is None:
-            path = os.path.join(self.shm_dir, ref.object_id)  # -> ENOENT
-        batch = self._map_segment(path, ref.object_id)
+            raise ObjectLostError(ref.object_id, "no local segment")
+        try:
+            batch = self._map_segment(path, ref.object_id)
+        except FileNotFoundError:
+            # Unlinked between the existence check and the mmap.
+            raise ObjectLostError(
+                ref.object_id, "segment unlinked mid-read"
+            ) from None
+        except ValueError as exc:
+            raise ObjectCorruptError(ref.object_id, str(exc)) from exc
         if rows is not None:
             batch = batch.slice(rows[0], rows[1])
         return batch

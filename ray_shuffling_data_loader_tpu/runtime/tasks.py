@@ -21,9 +21,36 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_shuffling_data_loader_tpu import telemetry
 
+from . import faults
+
 
 class TaskError(Exception):
-    """A task raised; carries the remote traceback."""
+    """A task raised; carries the remote traceback plus structured
+    fields the recovery layer keys on: ``error_type`` (the remote
+    exception class name) and ``lost_object_id`` (set when the task died
+    on an :class:`~.store.ObjectLostError`, so the shuffle driver can
+    re-materialize that exact object from lineage instead of guessing
+    from traceback text)."""
+
+    def __init__(
+        self,
+        message: str,
+        error_type: Optional[str] = None,
+        lost_object_id: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.error_type = error_type
+        self.lost_object_id = lost_object_id
+
+    def __reduce__(self):
+        # Crosses the actor wire (HostAgent.submit re-raises it to the
+        # remote driver); the default reduce would drop the structured
+        # fields.
+        return (
+            TaskError,
+            (self.args[0] if self.args else "", self.error_type,
+             self.lost_object_id),
+        )
 
 
 class TaskFuture:
@@ -42,6 +69,15 @@ class TaskFuture:
         if not self._event.wait(timeout):
             raise TimeoutError(f"task {self.task_id} not done after {timeout}s")
         if self._error is not None:
+            # Workers report structured {"tb", "type", "lost"} errors;
+            # pool-level failures (worker died, pool shut down) remain
+            # plain strings.
+            if isinstance(self._error, dict):
+                raise TaskError(
+                    self._error.get("tb", ""),
+                    error_type=self._error.get("type"),
+                    lost_object_id=self._error.get("lost"),
+                )
             raise TaskError(self._error)
         return self._result
 
@@ -127,6 +163,7 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
 
     os.environ.update(env)
     pid = os.getpid()
+    faults.set_role("task")  # fault rules with a /task filter fire here
     if telemetry.enabled():
         telemetry.set_process_name(f"task-worker-{pid}")
     # Orphan self-destruct: if the pool owner dies without shutdown (e.g.
@@ -167,10 +204,24 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
             telemetry.safe_flush()
             telemetry.audit.safe_flush()
             result_q.put(("done", task_id, result, None))
-        except Exception:
+        except Exception as exc:
             telemetry.safe_flush()
             telemetry.audit.safe_flush()
-            result_q.put(("done", task_id, None, traceback.format_exc()))
+            result_q.put(
+                (
+                    "done",
+                    task_id,
+                    None,
+                    {
+                        "tb": traceback.format_exc(),
+                        "type": type(exc).__name__,
+                        # ObjectLostError carries the id of the missing
+                        # segment; the driver's lineage recovery needs it
+                        # structured, not buried in traceback text.
+                        "lost": getattr(exc, "object_id", None),
+                    },
+                )
+            )
 
 
 class WorkerPool:

@@ -28,6 +28,8 @@ import socket
 import struct
 from typing import Any, Optional, Tuple
 
+from . import faults
+
 _LEN = struct.Struct("<Q")
 _AUTH_MAGIC = b"RSDLAUTH"
 _NONCE_LEN = 16
@@ -121,10 +123,17 @@ class Connection:
             self.sock.settimeout(timeout)
 
     def send(self, obj: Any) -> None:
+        if faults.enabled():
+            # PRE-send: a fault here models a reset before any bytes hit
+            # the wire, which is the retry-safe class (the peer never saw
+            # the frame) — the ActorHandle retry layer leans on that.
+            faults.fire("transport.send")
         payload = dumps(obj)
         self.sock.sendall(_LEN.pack(len(payload)) + payload)
 
     def recv(self) -> Any:
+        if faults.enabled():
+            faults.fire("transport.recv")
         header = self._recv_exact(_LEN.size)
         (length,) = _LEN.unpack(header)
         return loads(self._recv_exact(length))

@@ -44,9 +44,44 @@ import numpy as np
 
 from ray_shuffling_data_loader_tpu import runtime, telemetry
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
-from ray_shuffling_data_loader_tpu.runtime.tasks import TaskFuture, wait
+from ray_shuffling_data_loader_tpu.runtime import faults as _faults
+from ray_shuffling_data_loader_tpu.runtime.retry import stage_policy
+from ray_shuffling_data_loader_tpu.runtime.tasks import (
+    TaskError,
+    TaskFuture,
+    wait,
+)
 from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
+
+
+class StageFailedError(TaskError):
+    """A shuffle stage task exhausted its bounded re-execution budget
+    (``RSDL_STAGE_MAX_ATTEMPTS``, default 3) — the structured terminal
+    error a poison task produces instead of retrying forever across
+    hosts. Subclasses :class:`TaskError` so pre-existing ``except
+    TaskError`` callers (and tests) keep working; ``bench.py``'s error
+    JSON picks up the stage/epoch fields."""
+
+    def __init__(self, stage: str, epoch: int, attempts: int, message: str):
+        super().__init__(message, error_type="StageFailedError")
+        self.stage = stage
+        self.epoch = epoch
+        self.attempts = attempts
+
+    def __reduce__(self):
+        return (
+            StageFailedError,
+            (self.stage, self.epoch, self.attempts,
+             self.args[0] if self.args else ""),
+        )
+
+
+def _count_recovery(name: str, **labels) -> None:
+    """``recovery.*`` counter increment, metrics-gated and never raising
+    into the data path."""
+    _metrics.safe_inc(name, **labels)
 
 
 class BatchConsumer:
@@ -188,6 +223,8 @@ def shuffle_map(
     later epochs pass it back as ``cache_ref`` and partition straight
     from the mmapped segment, skipping Parquet decode entirely.
     """
+    if _faults.enabled():
+        _faults.fire("task.map", epoch=epoch, point="entry")
     if stats_collector is not None:
         stats_collector.call_oneway("map_start", epoch)
     start = timeit.default_timer()
@@ -281,6 +318,11 @@ def shuffle_map(
         stats_collector.call_oneway(
             "map_done", epoch, duration, end_read - start
         )
+    if _faults.enabled():
+        # Exit-point crash: the partitions are already published (and the
+        # audit digest recorded) — the retry's duplicate records are the
+        # case the audit reconciler's dedup exists for.
+        _faults.fire("task.map", epoch=epoch, point="exit")
     if publish_cache:
         return refs, new_cache_ref
     return refs
@@ -304,6 +346,8 @@ def shuffle_plan(
     windows are each reducer's within-file row indices in file order,
     exactly the rows (and order) the materialized map's partitions hold.
     """
+    if _faults.enabled():
+        _faults.fire("task.map", epoch=epoch, point="entry")
     if stats_collector is not None:
         stats_collector.call_oneway("map_start", epoch)
     start = timeit.default_timer()
@@ -353,6 +397,8 @@ def shuffle_plan(
         stats_collector.call_oneway(
             "map_done", epoch, duration, end_read - start
         )
+    if _faults.enabled():
+        _faults.fire("task.map", epoch=epoch, point="exit")
     return refs
 
 
@@ -373,6 +419,8 @@ def shuffle_gather_reduce(
     file caches in a single fused multi-source take — output is
     bit-identical to the materialized reducer's segment.
     """
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="entry")
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_start", epoch)
     start = timeit.default_timer()
@@ -439,6 +487,8 @@ def shuffle_gather_reduce(
     )
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="exit")
     return out_ref
 
 
@@ -455,6 +505,8 @@ def shuffle_reduce(
     Frees the consumed mapper partitions (the Ray build gets this from
     distributed ref-counting GC).
     """
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="entry")
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_start", epoch)
     start = timeit.default_timer()
@@ -501,12 +553,27 @@ def shuffle_reduce(
     )
     if stats_collector is not None:
         stats_collector.call_oneway("reduce_done", epoch, duration)
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="exit")
     return out_ref
 
 
 # ---------------------------------------------------------------------------
 # Drivers
 # ---------------------------------------------------------------------------
+
+
+class _ResolvedMapResult:
+    """A pre-resolved stand-in for a publishing map's TaskFuture, used
+    when lineage recovery regenerates a decode-cache segment
+    synchronously: registered into :class:`_DecodeCache` so later
+    epochs' ``claim_or_wait``/``hot_refs`` resolve to the NEW ref."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
 
 
 class _DecodeCache:
@@ -988,6 +1055,131 @@ def shuffle_epoch(
         ]
     )
 
+    # -- stage recovery (PR 3) ----------------------------------------------
+    # Every stage task gets a bounded re-execution budget; a lost input
+    # object is re-materialized from lineage (the driver knows which map
+    # produced every partition ref) instead of failing the epoch. A task
+    # that keeps failing — a poison task — exhausts the budget and
+    # surfaces StageFailedError through shuffle().
+    policy = stage_policy()
+
+    def _resubmit_map(i, publish=False):
+        """A fresh map attempt for file ``i``, always decoding from the
+        Parquet source rather than a decode-cache ref (the cache segment
+        may itself be the lost/corrupt object). ``publish`` re-publishes
+        a fresh cache segment when the failed attempt was the file's
+        cache publisher — a recovered crash must not silently disable
+        the cross-epoch cache."""
+        if schedule == "index":
+            return pool.submit_local_to(
+                [cache_refs[i]],
+                shuffle_plan,
+                i,
+                num_reducers,
+                epoch,
+                seed,
+                cache_refs[i],
+                stats_collector,
+            )
+        return pool.submit(
+            shuffle_map,
+            filenames[i],
+            i,
+            num_reducers,
+            epoch,
+            seed,
+            stats_collector,
+            narrow_to_32,
+            None,
+            publish,
+            len(filenames),
+        )
+
+    def _regenerate_cache(j):
+        """Index schedule: the decoded-columns cache segment for file
+        ``j`` is lost — re-decode from Parquet and republish, swapping
+        the new ref into this epoch's cache list (shared with every
+        pending resubmission closure) and the cross-epoch registry, so
+        both this epoch's retries and later epochs read the regenerated
+        segment."""
+        _count_recovery("recovery.rematerialized", stage="decode-cache")
+        telemetry.instant(
+            "recovery:rematerialize", cat="recovery", file=j, cache=True
+        )
+        fut = pool.submit(
+            shuffle_map,
+            filenames[j],
+            j,
+            num_reducers,
+            epoch,
+            seed,
+            stats_collector,
+            narrow_to_32,
+            None,
+            True,
+            len(filenames),
+        )
+        try:
+            part_refs, new_cache = fut.result()
+        except TaskError as exc:
+            raise StageFailedError(
+                "map-rematerialize", epoch, 1,
+                f"decode-cache regeneration for file {j} failed:\n{exc}",
+            ) from exc
+        if new_cache is None:
+            raise StageFailedError(
+                "map-rematerialize", epoch, 1,
+                f"decode-cache regeneration for file {j} republished "
+                "nothing (store full?)",
+            )
+        store = runtime.get_context().store
+        try:
+            # The fresh partitions are unused by the index schedule, and
+            # free() no-ops on whatever is left of the lost segment.
+            store.free(list(part_refs) + [cache_refs[j]])
+        except Exception:
+            pass
+        cache_refs[j] = new_cache
+        decode_cache.register(j, _ResolvedMapResult((None, new_cache)))
+
+    def _recover_lost_cache(lost):
+        """If ``lost`` names one of this epoch's decode-cache segments
+        (index schedule), regenerate it and return True."""
+        if lost is None or schedule != "index" or not cache_refs:
+            return False
+        for cj, cache_ref in enumerate(cache_refs):
+            if cache_ref.object_id == lost:
+                _regenerate_cache(cj)
+                return True
+        return False
+
+    def _await_map(i, fut, published):
+        """Resolve one map future, re-executing on failure up to the
+        stage budget. Returns the partition refs (publish tuples
+        unwrapped). A lost decode-cache segment (index schedule) is
+        regenerated before the plan resubmits against it."""
+        for attempt, backoff in policy.attempts(site="stage.map"):
+            try:
+                res = fut.result()
+                return res[0] if published else res
+            except TaskError as exc:
+                if attempt >= policy.max_attempts:
+                    raise StageFailedError(
+                        "map", epoch, attempt,
+                        f"map task for file {i} failed after "
+                        f"{attempt} attempts:\n{exc}",
+                    ) from exc
+                _count_recovery("recovery.stage_retries", stage="map")
+                backoff.backoff(str(exc))
+                _recover_lost_cache(exc.lost_object_id)
+                fut = _resubmit_map(i, publish=published)
+                if published:
+                    # Later epochs block on the NEW publishing attempt
+                    # instead of degrading to per-epoch decode for the
+                    # rest of the run.
+                    decode_cache.register(i, fut)
+        raise AssertionError("unreachable: retry budget mis-sized")
+
     def deliver():
         done_ranks = set()
         audit_offsets: Dict[int, int] = {}  # rank -> delivered-row offset
@@ -1001,9 +1193,20 @@ def shuffle_epoch(
                 # Publishing maps return (refs, cache_ref); unwrap those.
                 with telemetry.trace_span("deliver:wait-maps", cat="shuffle"):
                     per_file_refs = [
-                        f.result()[0] if pub else f.result()
-                        for f, pub in zip(map_futs, map_published)
+                        _await_map(i, f, pub)
+                        for i, (f, pub) in enumerate(
+                            zip(map_futs, map_published)
+                        )
                     ]
+                # Lineage: which map produced every partition ref. When a
+                # reduce dies on ObjectLostError, the driver re-executes
+                # exactly that producing map (bounded by the stage budget)
+                # instead of failing the epoch — the Ray-lineage analog
+                # the runtime lost when it replaced Ray.
+                lineage: Dict[str, int] = {}
+                for i, refs in enumerate(per_file_refs):
+                    for ref in refs:
+                        lineage[ref.object_id] = i
                 # Locality: each reduce runs on the host already holding the
                 # most of its input-partition rows (cluster mode; the local
                 # pool ignores the hint). Ray gets this from its scheduler;
@@ -1014,25 +1217,39 @@ def shuffle_epoch(
                     if schedule == "index"
                     else (shuffle_reduce, ())
                 )
-                reduce_futs = [
-                    pool.submit_local_to(
-                        [refs[r] for refs in per_file_refs],
+
+                def _submit_reduce(r, refs_r):
+                    return pool.submit_local_to(
+                        refs_r,
                         reduce_fn,
                         r,
                         epoch,
                         seed,
-                        [refs[r] for refs in per_file_refs],
+                        refs_r,
                         *extra,
                         stats_collector,
                     )
+
+                reduce_futs = [
+                    _submit_reduce(r, [refs[r] for refs in per_file_refs])
                     for r in range(num_reducers)
                 ]
+
+                def _failed(f):
+                    try:
+                        f.result(timeout=0)
+                        return False
+                    except Exception:
+                        return True
+
                 # Free each reducer's input partitions from the driver — not
                 # inside the task (keeps reduce retryable for cluster
                 # failover) — and in COMPLETION order on a side thread, not
                 # delivery order: the delivery loop below can block on
                 # consumer backpressure while later reducers finished long
                 # ago, and holding their inputs would double peak /dev/shm.
+                # FAILED futures are skipped: the delivery retry path owns
+                # (and frees) a retried reducer's inputs.
                 def free_inputs():
                     store = runtime.get_context().store
                     index_of = {id(f): r for r, f in enumerate(reduce_futs)}
@@ -1040,6 +1257,8 @@ def shuffle_epoch(
                     while remaining:
                         finished, remaining = wait(remaining, num_returns=1)
                         for f in finished:
+                            if _failed(f):
+                                continue
                             try:
                                 store.free(
                                     [
@@ -1056,12 +1275,100 @@ def shuffle_epoch(
                     daemon=True,
                 ).start()
 
+                def _rematerialize(j, r, old_ref):
+                    """Lineage re-execution: re-run map ``j``, keep its
+                    window for reducer ``r``, free the rest (they pin the
+                    regenerated segment; the surviving reducers still hold
+                    the original, intact partitions)."""
+                    _count_recovery("recovery.rematerialized", stage="map")
+                    telemetry.instant(
+                        "recovery:rematerialize", cat="recovery",
+                        file=j, reducer=r,
+                    )
+                    try:
+                        newrefs = _resubmit_map(j).result()
+                    except TaskError as exc:
+                        raise StageFailedError(
+                            "map-rematerialize", epoch, 1,
+                            f"lineage re-execution of file {j} failed:\n"
+                            f"{exc}",
+                        ) from exc
+                    store = runtime.get_context().store
+                    try:
+                        # The unused regenerated windows, plus whatever is
+                        # left of the lost original (free is a no-op on a
+                        # truly missing segment).
+                        store.free(
+                            [nr for k, nr in enumerate(newrefs) if k != r]
+                            + [old_ref]
+                        )
+                    except Exception:
+                        pass
+                    lineage[newrefs[r].object_id] = j
+                    return newrefs[r]
+
+                def _await_reduce(r, fut):
+                    """Resolve one reduce future with re-execution: lost
+                    inputs are re-materialized from lineage before the
+                    resubmit; anything else is retried as-is (transient),
+                    all bounded by the stage budget."""
+                    refs_r = [refs[r] for refs in per_file_refs]
+                    retried = False
+                    for attempt, backoff in policy.attempts(
+                        site="stage.reduce"
+                    ):
+                        try:
+                            out = fut.result()
+                            if retried:
+                                # First-attempt successes are freed by the
+                                # completion-order thread; a retried
+                                # reducer's (possibly regenerated) inputs
+                                # are freed here.
+                                try:
+                                    runtime.get_context().store.free(refs_r)
+                                except Exception:
+                                    pass
+                            return out
+                        except TaskError as exc:
+                            if attempt >= policy.max_attempts:
+                                raise StageFailedError(
+                                    "reduce", epoch, attempt,
+                                    f"reduce task {r} failed after "
+                                    f"{attempt} attempts:\n{exc}",
+                                ) from exc
+                            _count_recovery(
+                                "recovery.stage_retries", stage="reduce"
+                            )
+                            backoff.backoff(str(exc))
+                            lost = exc.lost_object_id
+                            if lost is not None and lost in lineage:
+                                j = lineage[lost]
+                                refs_r[j] = _rematerialize(
+                                    j, r, refs_r[j]
+                                )
+                            else:
+                                # Index schedule: the lost object may be
+                                # a decode-cache segment (never in the
+                                # partition lineage) — regenerate it so
+                                # the resubmitted gather reads a live
+                                # segment instead of burning its budget
+                                # on identical doomed attempts.
+                                _recover_lost_cache(lost)
+                            retried = True
+                            fut = _submit_reduce(r, refs_r)
+                    raise AssertionError("unreachable: retry budget mis-sized")
+
                 # Stream each reducer's output to its rank as soon as it
                 # completes, preserving reducer order within a rank for
                 # determinism.
                 for r, fut in enumerate(reduce_futs):
-                    out_ref = fut.result()
+                    out_ref = _await_reduce(r, fut)
                     rank = int(rank_of[r])
+                    if _faults.enabled():
+                        # The scripted producer-stall (or kill: a dead
+                        # delivery thread is what ProducerDiedError
+                        # supervision detects on the consumer side).
+                        _faults.fire("queue.producer", epoch=epoch)
                     if _audit.enabled():
                         out_ref = _audit_deliver(
                             runtime.get_context().store,

@@ -215,6 +215,19 @@ class MetricsRegistry:
 
 registry = MetricsRegistry()
 
+
+def safe_inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    """Increment a counter iff metrics are enabled, never raising into
+    the caller — the ONE definition of the guarded-increment pattern the
+    recovery/fault layers use from failure paths (where a telemetry
+    error must not break recovery itself)."""
+    try:
+        if enabled():
+            registry.counter(name, **labels).inc(value)
+    except Exception:
+        pass
+
+
 # -- cross-process sources ---------------------------------------------------
 
 _sources: Dict[str, Callable[[], Dict[str, float]]] = {}

@@ -352,8 +352,15 @@ class Span:
             }
         )
         _tls.depth = max(0, getattr(_tls, "depth", 1) - 1)
-        if _tls.depth == 0:
-            _maybe_flush()
+        # Flush on ANY close (rate-limited inside _maybe_flush), not only
+        # at depth 0: an async actor serving interleaved dispatches —
+        # e.g. the batch queue under the PR-3 supervised consumer, which
+        # keeps a get_batch dispatch span open almost continuously — may
+        # never reach depth 0 mid-run, and gating on quiescence starved
+        # its spool flushes until process exit (trace_export would miss
+        # every span since the last lull). Events are only appended at
+        # span close, so flushing mid-stack is always safe.
+        _maybe_flush()
         return False
 
 

@@ -1,0 +1,546 @@
+"""Chaos harness: end-to-end shuffles under scripted fault schedules.
+
+The PR-3 contract: every injected failure class is *recovered*, and the
+recovery is *proven correct* — each run ends with the PR-2 audit layer's
+epoch digests reconciling map == reduce == delivered (``RSDL_AUDIT=1``,
+strict mode, so a mismatch raises instead of logging). Fault schedules
+ride ``RSDL_FAULTS`` with a fixed ``RSDL_FAULTS_SEED``, so every run
+here replays the same deterministic schedule (``runtime/faults.py``).
+
+Covered failure classes (ISSUE 3 acceptance):
+
+* crashed map task (entry-point crash; re-executed within budget),
+* crashed reduce task (exit-point crash; re-executed, audit dedup
+  absorbs the duplicate digest records),
+* lost store object (reduce input vanishes; lineage re-executes the
+  producing map and retries the reduce),
+* transport reset (pre-send connection reset; the actor client's
+  bounded reconnect-retry rides it out),
+* killed host agent (scheduler failover onto the surviving agent),
+* dead queue producer (consumer unblocks with ``ProducerDiedError``
+  and a fresh driver re-runs the epoch deterministically),
+
+plus the negative case: a poison task (crashes on *every* attempt)
+exhausts its budget and fails the epoch with a structured
+``StageFailedError`` instead of retrying forever.
+"""
+
+import collections
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.batch_queue import (
+    BatchQueue,
+    ProducerDiedError,
+)
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.runtime import faults
+from ray_shuffling_data_loader_tpu.shuffle import (
+    BatchConsumer,
+    StageFailedError,
+    shuffle,
+)
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUM_FILES = 4
+ROWS_PER_FILE = 400
+TOTAL_ROWS = NUM_FILES * ROWS_PER_FILE
+
+
+@pytest.fixture(scope="module")
+def chaos_files(tmp_path_factory):
+    """Small Parquet dataset written IN-PROCESS (no worker pool): the
+    per-test runtimes below must spawn their pools *after* the fault
+    schedule is armed, so nothing here may touch the runtime."""
+    data_dir = tmp_path_factory.mktemp("chaos-data")
+    files = []
+    for i in range(NUM_FILES):
+        fname, _ = generate_file(
+            i, i * ROWS_PER_FILE, ROWS_PER_FILE, 1, str(data_dir)
+        )
+        files.append(fname)
+    return files
+
+
+@pytest.fixture
+def chaos_env(monkeypatch, tmp_path):
+    """Arm audit (strict) + metrics + a fault schedule, then bring up a
+    fresh runtime whose spawned workers inherit all three via the
+    environment. Function-scoped on purpose: fault schedules are parsed
+    once per process, so every test needs its own worker pool."""
+    started = []
+
+    def arm(spec: str, seed: int = 0, extra_env=None):
+        spool = tmp_path / "audit-spool"
+        spool.mkdir(exist_ok=True)
+        monkeypatch.setenv("RSDL_AUDIT", "1")
+        monkeypatch.setenv("RSDL_AUDIT_STRICT", "1")
+        monkeypatch.setenv("RSDL_AUDIT_DIR", str(spool))
+        monkeypatch.setenv("RSDL_METRICS", "1")
+        if spec:
+            monkeypatch.setenv("RSDL_FAULTS", spec)
+        else:
+            monkeypatch.delenv("RSDL_FAULTS", raising=False)
+        monkeypatch.setenv("RSDL_FAULTS_SEED", str(seed))
+        for k, v in (extra_env or {}).items():
+            monkeypatch.setenv(k, v)
+        _audit.refresh_from_env()
+        _metrics.refresh_from_env()
+        _metrics.registry.clear()
+        faults.refresh_from_env()
+        ctx = runtime.init(num_workers=2)
+        started.append(ctx)
+        return ctx
+
+    yield arm
+    runtime.shutdown()
+    monkeypatch.undo()
+    _audit.reset()
+    _audit.refresh_from_env()
+    _metrics.refresh_from_env()
+    faults.refresh_from_env()
+
+
+class CollectingConsumer(BatchConsumer):
+    def __init__(self):
+        self.keys = collections.defaultdict(list)
+        self.done = collections.defaultdict(bool)
+
+    def consume(self, rank, epoch, batches):
+        store = runtime.get_context().store
+        for ref in batches:
+            cb = store.get_columns(ref)
+            self.keys[(epoch, rank)].extend(cb["key"].tolist())
+            store.free(ref)
+
+    def producer_done(self, rank, epoch):
+        self.done[(epoch, rank)] = True
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+def _run_audited_shuffle(files, **kw):
+    consumer = CollectingConsumer()
+    shuffle(files, consumer, **kw)
+    return consumer
+
+
+def _assert_exactly_once(consumer, epoch, num_trainers=1):
+    keys = []
+    for rank in range(num_trainers):
+        assert consumer.done[(epoch, rank)]
+        keys.extend(consumer.keys[(epoch, rank)])
+    assert sorted(keys) == list(range(TOTAL_ROWS))
+
+
+def _counter(name_prefix: str) -> float:
+    snap = _metrics.registry.snapshot()
+    return sum(v for k, v in snap.items() if k.startswith(name_prefix))
+
+
+# ---------------------------------------------------------------------------
+# Fault-plane unit behavior (determinism, filters, zero overhead)
+# ---------------------------------------------------------------------------
+
+
+def test_faults_off_is_noop(monkeypatch):
+    monkeypatch.delenv("RSDL_FAULTS", raising=False)
+    faults.refresh_from_env()
+    assert not faults.enabled()
+    assert faults.should_fire("any.site") is None
+    assert faults.fired_counts() == {}
+
+
+def test_fault_schedule_is_deterministic(monkeypatch):
+    monkeypatch.setenv("RSDL_FAULTS", "x.y:crash:0.3")
+    monkeypatch.setenv("RSDL_FAULTS_SEED", "42")
+    faults.refresh_from_env()
+    seq1 = [faults.should_fire("x.y") for _ in range(64)]
+    faults.refresh_from_env()  # same env -> same schedule
+    seq2 = [faults.should_fire("x.y") for _ in range(64)]
+    assert seq1 == seq2
+    assert "crash" in seq1 and None in seq1  # ~30% firing rate
+    monkeypatch.setenv("RSDL_FAULTS_SEED", "43")
+    faults.refresh_from_env()
+    seq3 = [faults.should_fire("x.y") for _ in range(64)]
+    assert seq3 != seq1  # different seed, different schedule
+    faults.refresh_from_env()
+
+
+def test_fault_filters(monkeypatch):
+    monkeypatch.setenv(
+        "RSDL_FAULTS", "a.b/task:crash:1.0,c.d:crash:1.0@2,e.f:crash:1x1"
+    )
+    faults.refresh_from_env()
+    # role filter: this process is role "driver".
+    assert faults.should_fire("a.b") is None
+    faults.set_role("task")
+    try:
+        assert faults.should_fire("a.b") == "crash"
+    finally:
+        faults.set_role("driver")
+    # epoch filter
+    assert faults.should_fire("c.d", epoch=1) is None
+    assert faults.should_fire("c.d", epoch=2) == "crash"
+    # max-fires cap
+    assert faults.should_fire("e.f") == "crash"
+    assert faults.should_fire("e.f") is None
+    assert faults.fired_counts()[("e.f", "crash")] == 1
+    faults.refresh_from_env()
+
+
+def test_fault_entry_exit_points(monkeypatch):
+    monkeypatch.setenv("RSDL_FAULTS", "t.s:crash-exit:1.0")
+    faults.refresh_from_env()
+    assert faults.should_fire("t.s", point="entry") is None
+    assert faults.should_fire("t.s", point="exit") == "crash"
+    faults.refresh_from_env()
+
+
+def test_retry_policy_deadline_bounds_total_time():
+    from ray_shuffling_data_loader_tpu.runtime.retry import RetryPolicy
+
+    policy = RetryPolicy(
+        max_attempts=50, base_delay_s=0.05, max_delay_s=0.05,
+        multiplier=1.0, jitter=0.0, deadline_s=0.2,
+    )
+    start = time.monotonic()
+    last = 0
+    for attempt, backoff in policy.attempts("t"):
+        last = attempt
+        backoff.backoff("still failing")
+    # The deadline, not the attempt budget, ended the loop — and well
+    # before 50 x 50 ms of sleeping.
+    assert last < 50
+    assert time.monotonic() - start < 2.0
+
+
+def test_producer_liveness_interval_clamped(monkeypatch):
+    from ray_shuffling_data_loader_tpu import batch_queue as bq
+
+    monkeypatch.setenv("RSDL_PRODUCER_LIVENESS_S", "0")
+    assert bq._liveness_interval_s() == 0.05  # no busy-spin
+    monkeypatch.setenv("RSDL_PRODUCER_LIVENESS_S", "-3")
+    assert bq._liveness_interval_s() == 0.05
+    monkeypatch.setenv("RSDL_PRODUCER_LIVENESS_S", "1.5")
+    assert bq._liveness_interval_s() == 1.5
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.parse_spec("nonsense")
+    with pytest.raises(ValueError):
+        faults.parse_spec("a.b:frobnicate:0.5")
+    with pytest.raises(ValueError):
+        faults.parse_spec("a.b:crash:1.5")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end recovery, proven by audit digests
+# ---------------------------------------------------------------------------
+
+
+def test_recovers_crashed_map_task(chaos_files, chaos_env):
+    chaos_env("task.map:crash-entry:1x1", seed=11)
+    consumer = _run_audited_shuffle(
+        chaos_files, num_epochs=1, num_reducers=4, num_trainers=1, seed=5
+    )
+    _assert_exactly_once(consumer, 0)
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+    assert _counter("recovery.stage_retries") >= 1
+
+
+def test_recovers_crashed_reduce_task(chaos_files, chaos_env):
+    # Exit-point crash: the reducer output and its audit digest are
+    # already published when the task dies — the retry's duplicate
+    # records are exactly what the reconciler's dedup exists for.
+    chaos_env("task.reduce:crash-exit:1x1", seed=13)
+    consumer = _run_audited_shuffle(
+        chaos_files, num_epochs=1, num_reducers=4, num_trainers=1, seed=5
+    )
+    _assert_exactly_once(consumer, 0)
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+    assert _counter("recovery.stage_retries") >= 1
+
+
+def test_recovers_lost_store_object(chaos_files, chaos_env):
+    # The first store.get in each pool worker reports its object lost
+    # (the reduce's first input partition). The driver must re-execute
+    # the producing map from lineage and retry the reduce.
+    chaos_env("store.get/task:lost:1x1", seed=17)
+    consumer = _run_audited_shuffle(
+        chaos_files, num_epochs=1, num_reducers=4, num_trainers=1, seed=5
+    )
+    _assert_exactly_once(consumer, 0)
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+    assert _counter("recovery.rematerialized") >= 1
+
+
+def test_recovers_lost_decode_cache_index_schedule(chaos_files, chaos_env):
+    """Index schedule: a lost decode-cache segment is never in the
+    partition lineage, so its recovery path is cache *regeneration* —
+    re-decode the file from Parquet, republish, and swap the new ref
+    into the epoch's cache list and the cross-epoch registry. A lost
+    cache must cost one re-decode, not the epoch."""
+    # The package re-exports the shuffle FUNCTION under the module's
+    # name, so plain import forms bind the function; go via sys.modules.
+    import importlib
+
+    shuffle_mod = importlib.import_module(
+        "ray_shuffling_data_loader_tpu.shuffle"
+    )
+
+    ctx = chaos_env("", seed=0, extra_env={"RSDL_INDEX_SHUFFLE": "on"})
+    _audit.begin_run()
+    cache = shuffle_mod._DecodeCache(enabled=True)
+    cache_refs = []
+    for i, fname in enumerate(chaos_files):
+        refs, cref = shuffle_mod.shuffle_map(
+            fname, i, 4, epoch=0, seed=5, publish_cache=True
+        )
+        ctx.store.free(refs)  # partitions unused; only the cache matters
+        assert cref is not None
+        cache.register(i, shuffle_mod._ResolvedMapResult((None, cref)))
+        cache_refs.append(cref)
+    # Lose one cache segment outright (as if the host holding the only
+    # copy died) — the plan stage reading it must hit ObjectLostError.
+    lost = cache_refs[1]
+    path = ctx.store._find_segment(lost.object_id)
+    assert path is not None
+    os.unlink(path)
+
+    consumer = CollectingConsumer()
+    schedule_log = []
+    thread = shuffle_mod.shuffle_epoch(
+        0,
+        chaos_files,
+        consumer,
+        num_reducers=4,
+        num_trainers=1,
+        seed=5,
+        decode_cache=cache,
+        schedule_log=schedule_log,
+    )
+    thread.join()
+    assert thread.error is None, thread.error
+    assert schedule_log == [(0, "index")]
+    _assert_exactly_once(consumer, 0)
+    assert _counter("recovery.rematerialized") >= 1
+    verdicts = _audit.reconcile([0])
+    assert verdicts and verdicts[0]["ok"] is True, verdicts
+    cache.free_all()
+
+
+def test_recovers_transport_reset(chaos_files, chaos_env):
+    # Driver-side pre-send connection reset on the control plane (queue
+    # actor RPC): the actor client reconnects and retries; the epoch
+    # must complete with exactly-once delivery.
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    chaos_env("transport.send/driver:reset:1x1", seed=19)
+    ds = ShufflingDataset(
+        chaos_files,
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=200,
+        rank=0,
+        num_reducers=4,
+        seed=5,
+        queue_name="chaos-reset-q",
+    )
+    ds.set_epoch(0)
+    keys = sorted(k for b in ds for k in b["key"].tolist())
+    assert keys == list(range(TOTAL_ROWS))
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+    assert _counter("recovery.retries") >= 1
+
+
+def test_killed_host_agent_fails_over(chaos_files, chaos_env):
+    """Two in-process host agents behind a ClusterScheduler; one is
+    SIGKILLed (dead-but-listed, like a preempted TPU host). Every task
+    that lands on it must fail over to the survivor, with the dead agent
+    evicted — and the epoch's digests must still reconcile."""
+    from ray_shuffling_data_loader_tpu.runtime import actor as actor_mod
+    from ray_shuffling_data_loader_tpu.runtime.cluster import (
+        ClusterScheduler,
+        HostAgent,
+    )
+
+    ctx = chaos_env("", seed=0)
+    agents = [
+        actor_mod.spawn_actor(
+            HostAgent,
+            ctx.runtime_dir,
+            1,
+            None,
+            runtime_dir=ctx.runtime_dir,
+            daemon=False,
+        )
+        for _ in range(2)
+    ]
+    victim, survivor = agents
+    os.kill(victim.pid, signal.SIGKILL)
+    sched = ClusterScheduler(agents, width=2)
+
+    class _FakeCluster:
+        def scheduler(self):
+            return sched
+
+    ctx.cluster = _FakeCluster()
+    try:
+        consumer = _run_audited_shuffle(
+            chaos_files, num_epochs=1, num_reducers=4, num_trainers=1,
+            seed=5,
+        )
+        _assert_exactly_once(consumer, 0)
+        summary = _audit.summary()
+        assert summary["ok"] is True, summary
+        assert sched.agent_addresses == {survivor.address}
+    finally:
+        ctx.cluster = None
+        sched.shutdown()
+        survivor.terminate(grace_period_s=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Dead producer: bounded detection + deterministic epoch re-run
+# ---------------------------------------------------------------------------
+
+
+def test_dead_producer_raises_within_deadline(chaos_env):
+    chaos_env("", seed=0, extra_env={"RSDL_PRODUCER_LIVENESS_S": "0.5"})
+    stand_in = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"]
+    )
+    q = BatchQueue(
+        num_epochs=1, num_trainers=1, max_concurrent_epochs=1,
+        name="chaos-dead-prod",
+    )
+    try:
+        q.ready()
+        q.actor.call("register_producer", stand_in.pid)
+        stand_in.kill()
+        stand_in.wait()
+        start = time.monotonic()
+        with pytest.raises(ProducerDiedError) as excinfo:
+            q.get_batch(0, 0)
+        assert time.monotonic() - start < 30  # bounded, not a hang
+        assert excinfo.value.epoch == 0 and excinfo.value.rank == 0
+        # get() is supervised the same way.
+        with pytest.raises(ProducerDiedError):
+            q.get(0, 0)
+    finally:
+        stand_in.kill()
+        q.shutdown()
+
+
+PRODUCER_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.batch_queue import BatchQueue
+
+runtime.init(address=os.environ["RSDL_RUNTIME_DIR"])
+q = BatchQueue(
+    num_epochs=1, num_trainers=1, max_concurrent_epochs=1,
+    name="chaos-prod-q",
+)
+q.ready()
+q.new_epoch(0)
+print("READY", flush=True)
+time.sleep(300)  # wedge mid-epoch until the test kills us
+"""
+
+
+def test_dead_producer_epoch_rerun_recovers(chaos_files, chaos_env):
+    """End-to-end producer death: a separate driver process creates the
+    delivery queue, admits epoch 0, and dies without producing. The
+    consumer unblocks with ProducerDiedError (not a hang), and because
+    the shuffle is deterministic per (seed, epoch), a fresh driver
+    re-runs the epoch and delivers exactly-once — digests reconciled."""
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    ctx = chaos_env("", seed=0, extra_env={"RSDL_PRODUCER_LIVENESS_S": "0.5"})
+    env = dict(os.environ, RSDL_RUNTIME_DIR=ctx.runtime_dir)
+    producer = subprocess.Popen(
+        [sys.executable, "-c", PRODUCER_SCRIPT.format(repo=_REPO)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=env,
+        text=True,
+    )
+    try:
+        assert producer.stdout.readline().strip() == "READY", (
+            "producer failed to start"
+        )
+        consumer_q = BatchQueue(
+            num_epochs=1, num_trainers=1, max_concurrent_epochs=1,
+            name="chaos-prod-q", connect=True,
+        )
+        producer.kill()
+        producer.wait()
+        with pytest.raises(ProducerDiedError):
+            consumer_q.get_batch(0, 0)
+    finally:
+        producer.kill()
+        producer.wait()
+
+    # Recovery: a fresh driver re-runs the epoch (same seed => same
+    # permutation) and the consumer reads it to completion.
+    ds = ShufflingDataset(
+        chaos_files,
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=200,
+        rank=0,
+        num_reducers=4,
+        seed=5,
+        queue_name="chaos-prod-q2",
+    )
+    ds.set_epoch(0)
+    keys = sorted(k for b in ds for k in b["key"].tolist())
+    assert keys == list(range(TOTAL_ROWS))
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+
+
+# ---------------------------------------------------------------------------
+# Poison task: bounded budget, structured failure
+# ---------------------------------------------------------------------------
+
+
+def test_poison_task_surfaces_stage_failed_error(chaos_files, chaos_env):
+    chaos_env("task.map:crash-entry:1.0", seed=3)  # every attempt dies
+    consumer = CollectingConsumer()
+    with pytest.raises(StageFailedError) as excinfo:
+        shuffle(
+            chaos_files,
+            consumer,
+            num_epochs=1,
+            num_reducers=2,
+            num_trainers=1,
+            seed=5,
+        )
+    assert excinfo.value.stage == "map"
+    assert excinfo.value.epoch == 0
+    assert excinfo.value.attempts >= 2
+    assert "FaultInjected" in str(excinfo.value)
+    # No hang: every rank still got its producer-done sentinel.
+    assert consumer.done[(0, 0)]

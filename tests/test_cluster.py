@@ -21,7 +21,10 @@ import time
 
 import pytest
 
-pytestmark = pytest.mark.slow
+# Subprocess-heavy cluster tests stay in the slow tier; the scheduler
+# unit tests below (fake in-process agents, no subprocesses) run in
+# tier-1.
+slow = pytest.mark.slow
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -108,6 +111,7 @@ runtime.shutdown()
 """
 
 
+@slow
 def test_tcp_actor_requires_cluster_token(tmp_path, monkeypatch):
     """TCP endpoints speak pickle, so unauthenticated peers must be dropped
     before their first frame is deserialized. Auth is an HMAC
@@ -204,6 +208,7 @@ runtime.shutdown()
 """
 
 
+@slow
 def test_dead_host_failover(tmp_path):
     """A worker host that joined and then died (SIGKILL — no unregister)
     must not break the run: the scheduler drops the dead agent, evicts the
@@ -352,6 +357,113 @@ def test_scheduler_confirms_death_before_evicting():
         sched.shutdown()
 
 
+def test_ping_ladder_escalates_before_evicting():
+    """A loaded-but-alive host can miss the short pings and only answer a
+    long one — the ladder must keep escalating (5 s -> 10 s -> 20 s)
+    instead of evicting on the first miss (ISSUE 3 satellite: ladder
+    false-eviction avoidance, fake in-process agents)."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorDiedError
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterScheduler
+
+    class LoadedAgent:
+        """Submit hits a transient reset; pings shorter than 10 s go
+        unanswered (host saturated), longer ones succeed."""
+
+        address = ("tcp", "loaded", 1)
+
+        def __init__(self):
+            self.calls = 0
+            self.ping_timeouts = []
+
+        def call(self, method, *args):
+            self.calls += 1
+            if self.calls == 1:
+                raise ActorDiedError("transient reset")
+            return "ok"
+
+        def ping(self, timeout=None):
+            self.ping_timeouts.append(timeout)
+            return timeout is not None and timeout >= 10.0
+
+    agent = LoadedAgent()
+    sched = ClusterScheduler([agent])
+    try:
+        ok, result = sched._submit_once(agent, None, (), {})
+        assert ok and result == "ok"
+        # The ladder escalated past the first (missed) rung before the
+        # retry — and the host was NOT evicted.
+        assert agent.ping_timeouts[:2] == [5.0, 10.0]
+        assert sched.agent_addresses == {agent.address}
+    finally:
+        sched.shutdown()
+
+
+def test_drop_agent_updates_membership_and_fires_callback():
+    """``_drop_agent``: the agent leaves the rotation exactly once, the
+    ``on_agent_dead`` callback (the membership-table eviction hook) fires
+    with the dead handle, and a raising callback never breaks the
+    scheduler."""
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterScheduler
+
+    class FakeAgent:
+        def __init__(self, name):
+            self.address = ("tcp", name, 1)
+
+    a, b = FakeAgent("a"), FakeAgent("b")
+    sched = ClusterScheduler([a, b])
+    try:
+        evicted = []
+        sched.on_agent_dead = evicted.append
+        sched._drop_agent(a)
+        assert evicted == [a]
+        assert sched.agent_addresses == {b.address}
+        # Idempotent: a racing re-drop neither corrupts the rotation nor
+        # double-fires the eviction callback (one eviction per dead
+        # host, not one per racing task).
+        sched._drop_agent(a)
+        assert sched.agent_addresses == {b.address}
+        assert evicted == [a]
+
+        # A callback that raises must be swallowed (eviction is
+        # best-effort bookkeeping; the failover itself already happened).
+        def boom(agent):
+            raise RuntimeError("registry unreachable")
+
+        sched.on_agent_dead = boom
+        sched._drop_agent(b)
+        assert sched.agent_addresses == set()
+    finally:
+        sched.shutdown()
+
+
+def test_all_agents_dead_raises_actor_died():
+    """When every host agent has died, a submit must surface
+    ``ActorDiedError`` (bounded failure) — never spin or hang looking
+    for a host that will not come back."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorDiedError
+    from ray_shuffling_data_loader_tpu.runtime.cluster import ClusterScheduler
+
+    class DeadAgent:
+        def __init__(self, name):
+            self.address = ("tcp", name, 1)
+
+        def call(self, method, *args):
+            raise ActorDiedError("down")
+
+        def ping(self, timeout=None):
+            return False
+
+    agents = [DeadAgent("d1"), DeadAgent("d2")]
+    sched = ClusterScheduler(agents)
+    try:
+        fut = sched.submit(lambda: None)
+        with pytest.raises(ActorDiedError, match="every cluster host"):
+            fut.result(timeout=60)
+        assert sched.agent_addresses == set()
+    finally:
+        sched.shutdown()
+
+
 LOCALITY_HEAD_SCRIPT = r"""
 import os, sys, time
 sys.path.insert(0, {repo!r})
@@ -453,6 +565,7 @@ def _run_locality_cluster(tmp_path, tag: str, extra_env: dict) -> int:
     raise AssertionError(f"no CROSS_BYTES in head output:\n{out}")
 
 
+@slow
 def test_locality_scheduling_cuts_cross_host_bytes(tmp_path):
     """Two-host cluster, skewed input ownership: locality-aware reduce
     placement must move materially fewer bytes across the DCN than pure
@@ -486,6 +599,7 @@ def test_locality_scheduling_cuts_cross_host_bytes(tmp_path):
     )
 
 
+@slow
 def test_two_host_cluster_shuffle(tmp_path):
     addr_file = str(tmp_path / "head_address")
     data_dir = str(tmp_path / "data")
@@ -573,6 +687,7 @@ runtime.shutdown()
 """
 
 
+@slow
 def test_cluster_decode_cache_exactly_once(tmp_path):
     """Two-host cluster with 32-bit narrowing AND the cross-epoch decode
     cache: later-epoch maps are locality-steered to the cache's owner and
@@ -673,6 +788,7 @@ runtime.shutdown()
 """
 
 
+@slow
 def test_actor_placement_on_host(tmp_path):
     """``spawn_actor(host_id=...)`` lands the actor in the target host's
     session via that host's agent — the SPREAD placement-group analog
@@ -802,6 +918,7 @@ runtime.shutdown()
 """
 
 
+@slow
 def test_host_rejoin_reworks(tmp_path):
     """A host that dies mid-trial and is replaced by a rejoining one must
     be evicted, then re-admitted via the membership heartbeat, and must

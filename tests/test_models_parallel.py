@@ -215,7 +215,7 @@ def test_adasum_reduce_orthogonal_adds_parallel_averages():
     ``hvd.Adasum``, ``ray_torch_shuffle.py:192``): mutually orthogonal
     gradients ADD (independent directions preserved), identical gradients
     return themselves (average-like, no magnitude blowup with DP width)."""
-    from jax import shard_map
+    from ray_shuffling_data_loader_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
 

@@ -11,7 +11,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from jax_compat import needs_toplevel_shard_map
+from jax_compat import (
+    needs_kernel_partitioning_apis,
+    needs_toplevel_shard_map,
+)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_shuffling_data_loader_tpu.ops import (
@@ -89,7 +92,7 @@ def test_gradients_match_dense_noncausal(seq_mesh):
         )
 
 
-@needs_toplevel_shard_map
+@needs_kernel_partitioning_apis
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_hops_match(seq_mesh, causal):
     """Ring with per-hop compute forced through the flash kernel
@@ -120,7 +123,7 @@ def test_ring_flash_hops_match(seq_mesh, causal):
         )
 
 
-@needs_toplevel_shard_map
+@needs_kernel_partitioning_apis
 def test_ulysses_flash_local_matches(seq_mesh):
     """Ulysses with the local body forced through the flash kernel
     (interpret mode on CPU) — the TPU lowering's exactness, fwd + grad."""
