@@ -164,10 +164,18 @@ def _error_result(platform, msg: str) -> dict:
     if QUICK:
         result["quick"] = True
     try:
+        from ray_shuffling_data_loader_tpu.telemetry import export as _e
         from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
 
         if _m.enabled():
-            result["telemetry_final"] = _m.registry.snapshot()
+            # The CLUSTER view, not the driver-local one: worker/actor
+            # registries already spooled at task-done/quiescence, and
+            # aggregate() is a pure file read plus the local registry —
+            # no RPCs, so a wedged actor cannot hang this error path.
+            try:
+                result["telemetry_final"] = _e.aggregate()
+            except Exception:
+                result["telemetry_final"] = _m.registry.snapshot()
     except Exception:
         pass
     try:
@@ -1352,6 +1360,19 @@ def main() -> None:
             )
         except Exception as exc:
             result["metrics_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    if _metrics.enabled() and "telemetry_final" not in result:
+        # Success path: embed the CLUSTER-aggregated final counters (the
+        # error path embeds them via _error_result) — worker map/reduce
+        # counters spooled at task-done fold in here; the driver-local
+        # snapshot alone would silently drop everything worker-side.
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import (
+                export as _metrics_export,
+            )
+
+            result["telemetry_final"] = _metrics_export.aggregate()
+        except Exception as exc:
+            result["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:200]
     print(json.dumps(result), flush=True)
 
 

@@ -286,6 +286,33 @@ class _QueueActor:
         # harmless (waiters re-check) and covers consumers that ack late.
         self.space_events[epoch][rank].set()
 
+    def status_snapshot(self) -> Dict[str, Any]:
+        """Live window state for the obs plane's /status page: the
+        admission window (in-flight epochs), per-``(epoch, rank)`` queue
+        depths for those epochs, and producer liveness — one cheap
+        synchronous read on the actor loop."""
+        alive = True
+        if self._producer_pid is not None:
+            try:
+                os.kill(self._producer_pid, 0)
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                pass
+        return {
+            "in_flight_epochs": list(self.curr_epochs),
+            "num_epochs": self.num_epochs,
+            "num_trainers": self.num_trainers,
+            "producer_pid": self._producer_pid,
+            "producer_alive": alive,
+            "depth_total": self.size(),
+            "depths": {
+                f"{epoch}/{rank}": q.qsize()
+                for epoch in self.curr_epochs
+                for rank, q in enumerate(self.queues[epoch])
+            },
+        }
+
     def metrics_snapshot(self) -> Dict[str, float]:
         """Live per-``(epoch, rank)`` queue depths in the metrics-registry
         key vocabulary — polled by the driver's metrics sampler through a
@@ -355,6 +382,28 @@ class BatchQueue:
                     self._metrics_source,
                     lambda: actor.call("metrics_snapshot"),
                 )
+            if os.environ.get("RSDL_OBS_PORT"):
+                # Obs-plane status provider: the /status page asks the
+                # queue actor for its admission-window snapshot on a
+                # short-timeout one-shot connection (a wedged actor must
+                # slow one scrape, not hang the endpoint thread forever).
+                try:
+                    from ray_shuffling_data_loader_tpu.telemetry import (
+                        obs_server,
+                    )
+
+                    status_actor = self.actor
+
+                    def _queue_status() -> Dict[str, Any]:
+                        return status_actor.call_with_timeout(
+                            "status_snapshot", timeout=2.0
+                        )
+
+                    obs_server.register_status_provider(
+                        "batch_queue", _queue_status
+                    )
+                except Exception:
+                    pass
 
     def __getstate__(self):
         return {"actor": self.actor}
@@ -508,6 +557,15 @@ class BatchQueue:
         if self._metrics_source is not None:
             _metrics.unregister_source(self._metrics_source)
             self._metrics_source = None
+        if os.environ.get("RSDL_OBS_PORT"):
+            try:
+                from ray_shuffling_data_loader_tpu.telemetry import (
+                    obs_server,
+                )
+
+                obs_server.unregister_status_provider("batch_queue")
+            except Exception:
+                pass
         if self.actor:
             self.actor.terminate(force=force, grace_period_s=grace_period_s)
         self.actor = None

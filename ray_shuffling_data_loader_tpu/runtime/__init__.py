@@ -120,6 +120,42 @@ _context: Optional[RuntimeContext] = None
 _context_lock = threading.Lock()
 
 
+def _maybe_start_obs_server(ctx: RuntimeContext) -> None:
+    """Bring up the live observability endpoint (telemetry.obs_server)
+    iff ``RSDL_OBS_PORT`` is set — one env read at session bring-up,
+    nothing at all on the hot path. Only the session OWNER serves
+    (spawned workers and task processes join with ``owner=False`` and
+    inherit the same env; letting each of them bind the port would just
+    race). A bind failure is logged inside maybe_start, never fatal."""
+    if not ctx.owner or not os.environ.get("RSDL_OBS_PORT"):
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import obs_server
+
+        obs_server.maybe_start()
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "obs server bring-up failed", exc_info=True
+        )
+
+
+def _stop_obs_server() -> None:
+    """Stop the endpoint if (and only if) its module was ever loaded —
+    shutdown must not import http.server on runs that never served."""
+    import sys as _sys
+
+    mod = _sys.modules.get(
+        "ray_shuffling_data_loader_tpu.telemetry.obs_server"
+    )
+    if mod is not None:
+        try:
+            mod.stop()
+        except Exception:
+            pass
+
+
 def _new_session_dir() -> str:
     # Keep the path short: unix socket paths are capped at ~107 chars.
     base = tempfile.gettempdir()
@@ -252,6 +288,7 @@ def init(
                 except Exception:
                     pass
                 raise
+            _maybe_start_obs_server(ctx)
             return ctx
         if address:
             if not os.path.isdir(address):
@@ -269,6 +306,7 @@ def init(
             ctx = RuntimeContext(runtime_dir, owner=True, num_workers=num_workers)
         _context = ctx
         atexit.register(shutdown)
+        _maybe_start_obs_server(ctx)
         return ctx
 
 
@@ -313,6 +351,7 @@ def init_cluster(
         _bootstrap_cluster_host(
             ctx, registry, advertise, num_workers, is_head=True
         )
+        _maybe_start_obs_server(ctx)
     except BaseException:
         with _context_lock:
             _context = None
@@ -347,6 +386,25 @@ def shutdown() -> None:
         if _context is None:
             return
         ctx, _context = _context, None
+    if ctx.owner:
+        # The obs endpoint is session-scoped: release the port (and its
+        # daemon thread) with the session so a later init() can rebind.
+        _stop_obs_server()
+    # Spool one last registry snapshot while the runtime dir still
+    # exists — for every process, not just the owner: a JOINED process
+    # (a trainer rank with consume-side counters) leaving the session is
+    # exactly the exit this plane must not lose metrics at. (The owner's
+    # own file dies with its rmtree below, but with an RSDL_METRICS_DIR
+    # override the spool outlives the session, so flush unconditionally
+    # — it is cheap and metrics-gated inside.)
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import (
+            export as _metrics_export,
+        )
+
+        _metrics_export.safe_flush()
+    except Exception:
+        pass
     if os.environ.get(_ENV_DIR) == ctx.runtime_dir and ctx.owner:
         del os.environ[_ENV_DIR]
     ctx.shutdown()

@@ -201,10 +201,14 @@ class _ActorHost:
             # so relying on the span-close heuristic alone leaves their
             # spans invisible to a concurrent trace_export until process
             # exit. Event-driven and cheap: no-ops when telemetry is off
-            # or the buffer is empty.
+            # or the buffer is empty. The metrics-registry snapshot
+            # spools on the same trigger (rate-limited inside
+            # maybe_flush) so this actor's counters/gauges stay visible
+            # to the driver's live aggregation mid-run.
             self._inflight -= 1
             if self._inflight == 0:
                 telemetry.safe_flush()
+                telemetry.export.maybe_flush()
 
     async def start(self):
         """Bind the server socket; returns once the actor is reachable.
@@ -287,10 +291,12 @@ def _actor_main(
     except KeyboardInterrupt:
         pass
     finally:
-        # Graceful terminate reaches here; drain this actor's spans to
-        # the spool before the process exits (atexit also fires on clean
-        # exits, but not on the SIGKILL escalation path).
+        # Graceful terminate reaches here; drain this actor's spans and
+        # final metrics snapshot to their spools before the process
+        # exits (atexit also fires on clean exits, but not on the
+        # SIGKILL escalation path).
         telemetry.safe_flush()
+        telemetry.export.safe_flush()
         if registry_path is not None:
             try:
                 os.unlink(registry_path)

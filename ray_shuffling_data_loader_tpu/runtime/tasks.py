@@ -197,16 +197,20 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
             ):
                 result = fn(*args, **kwargs)
             # Flush BEFORE reporting done: by the time the caller can
-            # observe the result, this task's spans AND audit digest
-            # records are on their spools (the driver's reconciler relies
-            # on this ordering — all futures resolved implies all digest
-            # records visible).
+            # observe the result, this task's spans, audit digest
+            # records, AND metrics-registry snapshot are on their spools
+            # (the driver's reconciler and the cluster metrics
+            # aggregator both rely on this ordering — all futures
+            # resolved implies all worker-side records visible; without
+            # the metrics flush, worker counters died with the pool).
             telemetry.safe_flush()
             telemetry.audit.safe_flush()
+            telemetry.export.safe_flush()
             result_q.put(("done", task_id, result, None))
         except Exception as exc:
             telemetry.safe_flush()
             telemetry.audit.safe_flush()
+            telemetry.export.safe_flush()
             result_q.put(
                 (
                     "done",

@@ -84,6 +84,85 @@ def _count_recovery(name: str, **labels) -> None:
     _metrics.safe_inc(name, **labels)
 
 
+# ---------------------------------------------------------------------------
+# Live trial status (the obs plane's shuffle provider)
+# ---------------------------------------------------------------------------
+# A driver-side view of the running trial — which epochs are in flight,
+# what schedule each runs, how far delivery has progressed — published to
+# telemetry.obs_server's /status endpoint. The tracker itself is a plain
+# dict under a lock, updated a handful of times per epoch (admission,
+# schedule pick, one increment per delivered reducer, completion): noise
+# next to the per-reducer RPC + store traffic, so it stays on
+# unconditionally; the obs_server registration (the only part with an
+# import cost) happens only when RSDL_OBS_PORT is set.
+
+_live_lock = threading.Lock()
+_live_status: Dict[str, object] = {}
+
+
+def live_status() -> dict:
+    """JSON-safe snapshot of the current (or last) trial's live state —
+    the status provider ``shuffle()`` registers with
+    :mod:`~.telemetry.obs_server` when the obs endpoint is on."""
+    with _live_lock:
+        epochs = {
+            str(e): dict(st)
+            for e, st in (_live_status.get("epochs") or {}).items()
+        }
+        out = {k: v for k, v in _live_status.items() if k != "epochs"}
+    out["epochs"] = epochs
+    out["in_flight_epochs"] = sorted(
+        int(e)
+        for e, st in epochs.items()
+        if st.get("state") not in ("done", "failed")
+    )
+    return out
+
+
+def _status_begin_trial(
+    num_epochs: int,
+    num_files: int,
+    num_reducers: int,
+    num_trainers: int,
+    start_epoch: int,
+) -> None:
+    with _live_lock:
+        _live_status.clear()
+        _live_status.update(
+            {
+                "running": True,
+                "started_ts": time.time(),
+                "num_epochs": num_epochs,
+                "num_files": num_files,
+                "num_reducers": num_reducers,
+                "num_trainers": num_trainers,
+                "start_epoch": start_epoch,
+                "epochs": {},
+            }
+        )
+
+
+def _status_epoch(epoch: int, delivered_inc: int = 0, **kv) -> None:
+    with _live_lock:
+        epochs = _live_status.setdefault("epochs", {})
+        st = epochs.setdefault(
+            int(epoch), {"state": "pending", "delivered_reducers": 0}
+        )
+        if delivered_inc:
+            st["delivered_reducers"] = (
+                st.get("delivered_reducers", 0) + delivered_inc
+            )
+        st.update(kv)
+
+
+def _status_end_trial(error: Optional[str] = None) -> None:
+    with _live_lock:
+        _live_status["running"] = False
+        _live_status["ended_ts"] = time.time()
+        if error is not None:
+            _live_status["error"] = error[:300]
+
+
 class BatchConsumer:
     """Interface for consumers of shuffle outputs (reference
     ``shuffle.py:11-43``)."""
@@ -303,6 +382,11 @@ def shuffle_map(
             per_reducer=np.diff(offsets),
         )
     del batch  # drop (possibly mmapped-cache) views before returning
+    # Worker-sourced counters (obs plane): spooled at task-done by the
+    # pool worker, summed across processes by the driver's aggregation —
+    # one cached boolean each when metrics are off.
+    _metrics.safe_inc("shuffle.map_tasks")
+    _metrics.safe_inc("shuffle.map_rows", float(n))
     duration = timeit.default_timer() - start
     # Retroactive spans (record_span no-ops when tracing is off): the
     # whole map plus its decode sub-interval, on the worker's timeline.
@@ -388,6 +472,8 @@ def shuffle_plan(
     finally:
         pending.abort()
     del pending
+    _metrics.safe_inc("shuffle.map_tasks")
+    _metrics.safe_inc("shuffle.map_rows", float(n))
     duration = timeit.default_timer() - start
     telemetry.record_span(
         "map", wall0, duration, cat="shuffle",
@@ -480,6 +566,8 @@ def shuffle_gather_reduce(
         # shared across epochs and must survive.
         del caches, idx_parts
         ctx.store.drop_cache(list(idx_refs))
+    _metrics.safe_inc("shuffle.reduce_tasks")
+    _metrics.safe_inc("shuffle.reduce_rows", float(total))
     duration = timeit.default_timer() - start
     telemetry.record_span(
         "reduce", wall0, duration, cat="shuffle",
@@ -546,6 +634,8 @@ def shuffle_reduce(
         # so a failed reduce does not leak its fetched windows in /dev/shm.
         del parts  # drop mmap views before unlinking
         ctx.store.drop_cache(list(part_refs))
+    _metrics.safe_inc("shuffle.reduce_tasks")
+    _metrics.safe_inc("shuffle.reduce_rows", float(total_rows))
     duration = timeit.default_timer() - start
     telemetry.record_span(
         "reduce", wall0, duration, cat="shuffle",
@@ -992,6 +1082,7 @@ def shuffle_epoch(
     schedule = "index" if cache_refs is not None else "mapreduce"
     if schedule_log is not None:
         schedule_log.append((epoch, schedule))
+    _status_epoch(epoch, state="running", schedule=schedule)
     map_futs: List[TaskFuture] = []
     map_published: List[bool] = []
     # Trace context for everything this epoch submits from THIS thread:
@@ -1382,6 +1473,7 @@ def shuffle_epoch(
                         "deliver", cat="queue", rank=rank, reducer=r
                     ):
                         batch_consumer.consume(rank, epoch, [out_ref])
+                    _status_epoch(epoch, delivered_inc=1)
                     if stats_collector is not None:
                         stats_collector.call_oneway(
                             "consume", rank, epoch, out_ref.nbytes
@@ -1392,6 +1484,9 @@ def shuffle_epoch(
         except BaseException as exc:
             thread.error = exc
         finally:
+            _status_epoch(
+                epoch, state="failed" if thread.error is not None else "done"
+            )
             # Every rank gets its done sentinel even on failure (or when it
             # was assigned zero reducers): consumers must unblock; the
             # driver re-raises the stored error after joining.
@@ -1444,6 +1539,19 @@ def shuffle(
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
         raise ValueError("no input files to shuffle")
     runtime.ensure_initialized()
+    _status_begin_trial(
+        num_epochs, len(filenames), num_reducers, num_trainers, start_epoch
+    )
+    if os.environ.get("RSDL_OBS_PORT"):
+        # Publish the live trial view to the obs endpoint. Registration
+        # is one dict set; the import is the only cost and is gated on
+        # the endpoint actually being configured.
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import obs_server
+
+            obs_server.register_status_provider("shuffle", live_status)
+        except Exception:
+            pass
     if _audit.enabled():
         # Scope the digest records to THIS run: stale records (a previous
         # shuffle in the same process / spool dir) would fold into this
@@ -1456,53 +1564,63 @@ def shuffle(
     decode_cache = _DecodeCache(enabled=cache_decoded)
     start = timeit.default_timer()
     threads = []
-    for epoch in range(start_epoch, num_epochs):
-        throttle_start = timeit.default_timer()
-        # The admission span IS the window throttle: its duration is how
-        # long this epoch waited for the oldest in-flight epoch to drain
-        # (max_concurrent_epochs backpressure) — on the trace timeline it
-        # sits between consecutive epochs' map stages. The context block
-        # (not just a span arg) ships the epoch id with the queue-actor
-        # call, so the actor-side new_epoch span carries it too.
-        with telemetry.context(epoch=epoch):
-            with telemetry.trace_span("epoch:admission", cat="queue"):
-                batch_consumer.wait_until_ready(epoch)
-        if stats_collector is not None:
-            stats_collector.call_oneway(
-                "epoch_throttle",
-                epoch,
-                timeit.default_timer() - throttle_start,
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            throttle_start = timeit.default_timer()
+            _status_epoch(epoch, state="waiting-admission")
+            # The admission span IS the window throttle: its duration is
+            # how long this epoch waited for the oldest in-flight epoch to
+            # drain (max_concurrent_epochs backpressure) — on the trace
+            # timeline it sits between consecutive epochs' map stages. The
+            # context block (not just a span arg) ships the epoch id with
+            # the queue-actor call, so the actor-side new_epoch span
+            # carries it too.
+            with telemetry.context(epoch=epoch):
+                with telemetry.trace_span("epoch:admission", cat="queue"):
+                    batch_consumer.wait_until_ready(epoch)
+            _status_epoch(epoch, state="admitted")
+            if stats_collector is not None:
+                stats_collector.call_oneway(
+                    "epoch_throttle",
+                    epoch,
+                    timeit.default_timer() - throttle_start,
+                )
+            threads.append(
+                shuffle_epoch(
+                    epoch,
+                    filenames,
+                    batch_consumer,
+                    num_reducers,
+                    num_trainers,
+                    seed=seed,
+                    stats_collector=stats_collector,
+                    narrow_to_32=narrow_to_32,
+                    decode_cache=decode_cache,
+                    schedule_log=schedule_log,
+                )
             )
-        threads.append(
-            shuffle_epoch(
-                epoch,
-                filenames,
-                batch_consumer,
-                num_reducers,
-                num_trainers,
-                seed=seed,
+        for t in threads:
+            t.join()
+        decode_cache.free_all()
+        batch_consumer.wait_until_all_epochs_done()
+        for t in threads:
+            if t.error is not None:
+                raise t.error
+        if _audit.enabled():
+            # Epoch-end reconciliation: every map/reduce task has
+            # completed and flushed its digest records (flush-before-done
+            # ordering in runtime/tasks.py), and consumers have acked
+            # every batch — fold all sides, emit per-epoch verdicts +
+            # audit.* metrics, and (in RSDL_AUDIT_STRICT mode) raise on
+            # any mismatch.
+            _audit.reconcile(
+                range(start_epoch, num_epochs),
                 stats_collector=stats_collector,
-                narrow_to_32=narrow_to_32,
-                decode_cache=decode_cache,
-                schedule_log=schedule_log,
             )
-        )
-    for t in threads:
-        t.join()
-    decode_cache.free_all()
-    batch_consumer.wait_until_all_epochs_done()
-    for t in threads:
-        if t.error is not None:
-            raise t.error
-    if _audit.enabled():
-        # Epoch-end reconciliation: every map/reduce task has completed
-        # and flushed its digest records (flush-before-done ordering in
-        # runtime/tasks.py), and consumers have acked every batch — fold
-        # all sides, emit per-epoch verdicts + audit.* metrics, and (in
-        # RSDL_AUDIT_STRICT mode) raise on any mismatch.
-        _audit.reconcile(
-            range(start_epoch, num_epochs), stats_collector=stats_collector
-        )
+    except BaseException as exc:
+        _status_end_trial(error=f"{type(exc).__name__}: {exc}")
+        raise
+    _status_end_trial()
     duration = timeit.default_timer() - start
     if stats_collector is not None:
         stats_collector.call_oneway("trial_done", duration)

@@ -32,6 +32,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_shuffling_data_loader_tpu.telemetry import export as _export
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 
 logger = logging.getLogger(__name__)
@@ -560,6 +561,10 @@ class ObjectStoreStatsCollector:
                 )
             except Exception:
                 pass
+        # Spool the driver's own registry each period so cross-process
+        # aggregators (another process's /metrics endpoint, a post-crash
+        # report) see a fresh driver source without asking it anything.
+        _export.maybe_flush()
         logger.info(_metrics.progress_line(snap))
 
     def _loop(self):

@@ -21,6 +21,14 @@ map/reduce/delivery/consumption, per-epoch shuffle-quality metrics, and
 deterministic delivered-stream digests. See docs/observability.md and
 ``tools/audit_report.py``.
 
+The cluster-wide plane on top (ISSUE 4): :mod:`.export` spools every
+process's registry snapshot (role/host/pid-stamped) to the runtime dir
+and aggregates them with per-kind merge semantics, and
+:mod:`.obs_server` (env-gated ``RSDL_OBS_PORT``; lazily imported by
+``runtime.init()``) serves the aggregate live at ``/metrics`` plus
+``/healthz`` and ``/status``. ``tools/epoch_report.py`` turns the trace
++ stats artifacts into per-epoch critical-path reports.
+
 See docs/observability.md for the span/metric vocabulary and how to open
 a trace in Perfetto. ``bench.py --trace-out=trace.json`` emits both
 artifacts for a benchmark run.
@@ -53,6 +61,12 @@ from ray_shuffling_data_loader_tpu.telemetry.trace import (  # noqa: F401
 )
 from ray_shuffling_data_loader_tpu.telemetry import metrics  # noqa: F401
 from ray_shuffling_data_loader_tpu.telemetry import audit  # noqa: F401
+from ray_shuffling_data_loader_tpu.telemetry import export  # noqa: F401
+
+# NOTE: obs_server (the /metrics //healthz //status endpoint) is NOT
+# imported here — it is lazily imported by runtime.init() only when
+# RSDL_OBS_PORT is set, so the off-by-default path never even loads
+# http.server.
 
 metrics_snapshot = metrics.global_snapshot
 metrics_dump = metrics.dump_json

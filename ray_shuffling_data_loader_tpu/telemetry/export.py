@@ -1,0 +1,350 @@
+"""Cross-process metrics export: per-process spool + cluster aggregation.
+
+The metrics half (:mod:`.metrics`) keeps one registry *per process*, so
+before this module existed the map/reduce workers' counters, the actor
+hosts' gauges, and the recovery layer's retry counters all evaporated
+when their process exited — the driver's snapshot was a driver-local
+view. This module gives the registry the same cross-process transport
+the trace and audit spools already have:
+
+* **Spool.** Every process writes its registry's *typed* snapshot
+  (:meth:`~.metrics.MetricsRegistry.typed_snapshot` — kind-preserving,
+  because a flat float dict cannot be merged correctly) plus a source
+  identity (role, host, pid) and a timestamp to one JSON file under
+  ``$RSDL_RUNTIME_DIR/metrics`` (override: ``RSDL_METRICS_DIR``). The
+  file is *replaced* atomically each flush — instruments are cumulative
+  within a process lifetime, so the latest snapshot per process is the
+  whole truth and the spool stays one small file per process. Flush
+  points mirror the audit spool: task workers flush before reporting
+  each task done (``runtime/tasks.py`` — so by the time a result is
+  observable its counters are on disk), actor hosts flush at dispatch
+  quiescence and process exit (``runtime/actor.py``), and the driver's
+  store sampler flushes every period (``stats.py``).
+
+* **Aggregation.** :func:`aggregate` folds every spool record plus the
+  local live registry into one view with per-kind merge semantics:
+  counters **sum** across sources, gauges keep the **latest by record
+  timestamp**, histograms **merge** their components (count/sum add,
+  min/max widen). ``per_source=True`` additionally preserves each
+  source's values as ``source=<role>-<pid>``-labeled series;
+  ``max_age_s`` expires stale sources (a record older than the cutoff
+  — e.g. a wedged host that stopped flushing — is dropped entirely).
+
+Everything is env-gated off with the metrics half: when ``RSDL_METRICS``
+is unset, :func:`safe_flush` is one cached boolean check and no file is
+ever written. Aggregation is a pure filesystem read (plus the local
+registry) — **no actor RPCs** — so it is safe on error/watchdog paths
+where a wedged actor must not hang the process reporting the failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+ENV_METRICS_DIR = "RSDL_METRICS_DIR"
+_RUNTIME_DIR_ENV = "RSDL_RUNTIME_DIR"
+
+# Rate limit for maybe_flush (actor quiescence fires per dispatch lull;
+# a file replace per lull would be real I/O on chatty actors).
+_FLUSH_MIN_INTERVAL_S = 1.0
+
+_flush_lock = threading.Lock()
+_last_flush = 0.0
+
+
+def spool_dir() -> Optional[str]:
+    """Where this process spools its snapshots: ``RSDL_METRICS_DIR`` when
+    set, else ``$RSDL_RUNTIME_DIR/metrics`` (every process joined to a
+    runtime session carries that env var), else None (no spool — the
+    local registry is the only view, fine for single-process use)."""
+    explicit = os.environ.get(ENV_METRICS_DIR)
+    if explicit:
+        return explicit
+    runtime_dir = os.environ.get(_RUNTIME_DIR_ENV)
+    if runtime_dir:
+        return os.path.join(runtime_dir, "metrics")
+    return None
+
+
+def source_identity() -> Dict[str, Any]:
+    """This process's identity on its spool record: the fault plane's
+    process role (driver/task/actor — the same tag ``RSDL_FAULTS``
+    ``/role`` filters key on), hostname, and pid."""
+    try:
+        from ray_shuffling_data_loader_tpu.runtime import faults
+
+        role = faults.role()
+    except Exception:
+        role = "driver"
+    return {"role": role, "host": socket.gethostname(), "pid": os.getpid()}
+
+
+def _spool_path(directory: str, ident: Dict[str, Any]) -> str:
+    return os.path.join(
+        directory, f"metrics-{ident['role']}-{ident['pid']}.json"
+    )
+
+
+def flush() -> Optional[str]:
+    """Replace this process's spool file with the current typed registry
+    snapshot. No-op (returns None) when metrics are off, no spool dir is
+    configured, or the registry holds no instruments — so a metrics-on
+    process with nothing to say leaves no file. Never raises into the
+    caller's data path; returns the written path otherwise."""
+    global _last_flush
+    if not _metrics.enabled():
+        return None
+    directory = spool_dir()
+    if not directory:
+        return None
+    typed = _metrics.registry.typed_snapshot()
+    if not typed:
+        return None
+    ident = source_identity()
+    record = {"source": ident, "ts": time.time(), "metrics": typed}
+    path = _spool_path(directory, ident)
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)
+    except OSError:
+        # Telemetry must never sink the run (full disk, read-only spool).
+        return None
+    with _flush_lock:
+        _last_flush = time.monotonic()
+    return path
+
+
+def maybe_flush() -> None:
+    """Rate-limited :func:`flush` for chatty sites (actor dispatch
+    quiescence): at most one file replace per
+    ``_FLUSH_MIN_INTERVAL_S``."""
+    if not _metrics.enabled():
+        return
+    with _flush_lock:
+        if time.monotonic() - _last_flush < _FLUSH_MIN_INTERVAL_S:
+            return
+    try:
+        flush()
+    except Exception:
+        pass
+
+
+def safe_flush() -> None:
+    """Guarded flush for process-teardown paths (task done, actor exit):
+    no-op when metrics are off, never raises."""
+    if not _metrics.enabled():
+        return
+    try:
+        flush()
+    except Exception:
+        pass
+
+
+def clear_spool() -> None:
+    """Unlink every spool file (tests and explicit run boundaries; the
+    spool is normally scoped by the per-session runtime dir, which the
+    session owner removes on shutdown)."""
+    directory = spool_dir()
+    if not directory or not os.path.isdir(directory):
+        return
+    for fname in os.listdir(directory):
+        if fname.startswith("metrics-") and fname.endswith(".json"):
+            try:
+                os.unlink(os.path.join(directory, fname))
+            except OSError:
+                pass
+
+
+def load_records(max_age_s: Optional[float] = None) -> List[dict]:
+    """Every parseable spool record, oldest-file-name first. With
+    ``max_age_s``, records whose ``ts`` is older than ``now - max_age_s``
+    are dropped (stale-source expiry: a process that stopped flushing —
+    wedged, or from an abandoned run sharing the spool — no longer
+    contributes)."""
+    out: List[dict] = []
+    directory = spool_dir()
+    if not directory or not os.path.isdir(directory):
+        return out
+    now = time.time()
+    for fname in sorted(os.listdir(directory)):
+        if not (fname.startswith("metrics-") and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, fname)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue  # torn replace or foreign file; skip
+        if not isinstance(rec, dict) or "metrics" not in rec:
+            continue
+        if (
+            max_age_s is not None
+            and now - float(rec.get("ts", 0.0)) > max_age_s
+        ):
+            continue
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Merging
+# ---------------------------------------------------------------------------
+
+
+def _merge_entry(cur: Dict[str, Any], new: Dict[str, Any], ts: float) -> None:
+    """Fold one typed metric entry into the accumulator, per-kind:
+    counter sum, gauge latest-by-timestamp, histogram component merge.
+    A kind conflict (one process registered ``x`` as a counter, another
+    as a gauge) resolves latest-wins rather than corrupting either."""
+    kind = new.get("kind")
+    if kind != cur.get("kind"):
+        if ts >= cur.get("_ts", 0.0):
+            cur.clear()
+            cur.update(new)
+            cur["_ts"] = ts
+        return
+    if kind == "counter":
+        cur["value"] = float(cur.get("value", 0.0)) + float(
+            new.get("value", 0.0)
+        )
+    elif kind == "gauge":
+        if ts >= cur.get("_ts", 0.0):
+            cur["value"] = new.get("value", 0.0)
+            cur["_ts"] = ts
+    elif kind == "histogram":
+        cur["count"] = int(cur.get("count", 0)) + int(new.get("count", 0))
+        cur["sum"] = float(cur.get("sum", 0.0)) + float(new.get("sum", 0.0))
+        for field, pick in (("min", min), ("max", max)):
+            if field in new:
+                cur[field] = (
+                    pick(cur[field], new[field])
+                    if field in cur
+                    else new[field]
+                )
+    else:  # unknown kind from a newer writer: latest-wins
+        if ts >= cur.get("_ts", 0.0):
+            cur.clear()
+            cur.update(new)
+            cur["_ts"] = ts
+
+
+def _with_source_label(key: str, source: str) -> str:
+    """Inject ``source=<source>`` into a canonical snapshot key, keeping
+    label order sorted (so the result matches :func:`.metrics.format_key`
+    output) and any labeled-histogram name suffix in place."""
+    brace, close = key.find("{"), key.rfind("}")
+    if 0 <= brace < close:
+        name, suffix = key[:brace], key[close + 1:]
+        pairs = [
+            tuple(part.partition("=")[::2])
+            for part in key[brace + 1:close].split(",")
+        ]
+        pairs.append(("source", source))
+        inner = ",".join(f"{k}={v}" for k, v in sorted(pairs))
+        return f"{name}{{{inner}}}{suffix}"
+    return f"{key}{{source={source}}}"
+
+
+def aggregate_typed(
+    max_age_s: Optional[float] = None,
+    include_local: bool = True,
+    per_source: bool = False,
+) -> Dict[str, Dict[str, Any]]:
+    """Fold every spool record (plus the live local registry) into one
+    kind-preserving view — the merge core behind :func:`aggregate`.
+    Spool records written by THIS process are skipped when the live
+    registry is included (the registry is the same data, fresher).
+    Returns ``{key: {"kind": ..., ...}}``; per-source breakdown rides as
+    ``source=<role>-<pid>`` labeled keys when requested."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    me = source_identity()
+
+    def fold(
+        typed: Dict[str, Dict[str, Any]], ts: float, source: Optional[str]
+    ) -> None:
+        for key, entry in typed.items():
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = {**entry, "_ts": ts}
+            else:
+                _merge_entry(cur, entry, ts)
+            if per_source and source is not None:
+                skey = _with_source_label(key, source)
+                merged[skey] = {**entry, "_ts": ts}
+
+    for rec in load_records(max_age_s=max_age_s):
+        src = rec.get("source") or {}
+        if (
+            include_local
+            and _metrics.enabled()
+            and src.get("pid") == me["pid"]
+            and src.get("host") == me["host"]
+        ):
+            continue  # the live registry below supersedes our own file
+        label = f"{src.get('role', 'unknown')}-{src.get('pid', '0')}"
+        fold(rec.get("metrics", {}), float(rec.get("ts", 0.0)), label)
+    if include_local and _metrics.enabled():
+        local = _metrics.registry.typed_snapshot()
+        if local:
+            fold(local, time.time(), f"{me['role']}-{me['pid']}")
+    return merged
+
+
+def flatten(typed: Dict[str, Dict[str, Any]]) -> Dict[str, float]:
+    """A typed view flattened to the plain snapshot vocabulary
+    (histograms expand to ``_count/_sum/_min/_max``, matching
+    :meth:`~.metrics.Histogram.snapshot_into`)."""
+    out: Dict[str, float] = {}
+    for key, entry in typed.items():
+        if entry.get("kind") == "histogram":
+            out[f"{key}_count"] = float(entry.get("count", 0))
+            out[f"{key}_sum"] = float(entry.get("sum", 0.0))
+            if entry.get("count"):
+                if "min" in entry:
+                    out[f"{key}_min"] = float(entry["min"])
+                if "max" in entry:
+                    out[f"{key}_max"] = float(entry["max"])
+        else:
+            out[key] = float(entry.get("value", 0.0))
+    return out
+
+
+def kinds_of(typed: Dict[str, Dict[str, Any]]) -> Dict[str, str]:
+    """The ``{key: kind}`` map of a typed view — feeds
+    :func:`.metrics.to_prometheus_text`'s ``# TYPE`` lines."""
+    return {key: entry.get("kind", "untyped") for key, entry in typed.items()}
+
+
+def aggregate(
+    max_age_s: Optional[float] = None,
+    include_local: bool = True,
+    per_source: bool = False,
+) -> Dict[str, float]:
+    """The cluster-aggregated flat snapshot: every process's spooled
+    registry plus the local live one, merged with correct per-kind
+    semantics. This is what ``bench.py`` embeds as ``telemetry_final``
+    and what the ``/metrics`` endpoint serves — a pure file read, no
+    RPCs, safe on error paths."""
+    return flatten(
+        aggregate_typed(
+            max_age_s=max_age_s,
+            include_local=include_local,
+            per_source=per_source,
+        )
+    )
+
+
+def prometheus_text(max_age_s: Optional[float] = None) -> str:
+    """The aggregated view rendered as Prometheus exposition text with
+    per-source breakdown and ``# TYPE`` lines — the ``/metrics`` body."""
+    typed = aggregate_typed(max_age_s=max_age_s, per_source=True)
+    return _metrics.to_prometheus_text(flatten(typed), kinds=kinds_of(typed))

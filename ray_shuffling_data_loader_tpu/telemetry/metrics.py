@@ -43,7 +43,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_shuffling_data_loader_tpu.telemetry import _env
 
@@ -208,10 +208,51 @@ class MetricsRegistry:
             inst.snapshot_into(out)
         return out
 
+    def kinds(self) -> Dict[str, str]:
+        """``{instrument key: "counter"|"gauge"|"histogram"}`` — the
+        metric-kind map the Prometheus exporter's ``# TYPE`` lines and
+        the cross-process aggregator's merge semantics key on."""
+        with self._lock:
+            return {
+                key: _KIND_NAME[type(inst)]
+                for key, inst in self._instruments.items()
+            }
+
+    def typed_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Kind-preserving snapshot: ``{key: {"kind": ..., ...}}`` with
+        counters/gauges carrying ``value`` and histograms their full
+        ``count/sum/min/max`` state — the spool record format
+        :mod:`.export` ships across processes (a flat float snapshot
+        cannot be merged correctly: counters must sum, gauges must
+        latest-win, histogram components must each merge their own
+        way)."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+        out: Dict[str, Dict[str, Any]] = {}
+        for inst in instruments:
+            if isinstance(inst, Counter):
+                out[inst.key] = {"kind": "counter", "value": inst.value}
+            elif isinstance(inst, Gauge):
+                out[inst.key] = {"kind": "gauge", "value": inst.value}
+            else:
+                with inst._lock:  # consistent component tuple
+                    rec: Dict[str, Any] = {
+                        "kind": "histogram",
+                        "count": inst.count,
+                        "sum": inst.sum,
+                    }
+                    if inst.count:
+                        rec["min"] = inst.min
+                        rec["max"] = inst.max
+                out[inst.key] = rec
+        return out
+
     def clear(self) -> None:
         with self._lock:
             self._instruments.clear()
 
+
+_KIND_NAME = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
 
 registry = MetricsRegistry()
 
@@ -326,11 +367,13 @@ def dump_json(path: str, include_sources: bool = True) -> str:
 _PROM_NAME_SAN = None  # compiled lazily; regex import stays off hot paths
 
 
-def _prom_sample(key: str, value: float) -> str:
-    """One Prometheus text-format sample line from a snapshot key. Our
+def _prom_parts(key: str, value: float) -> Tuple[str, str, str]:
+    """``(name, labels, rendered_value)`` for one snapshot key. Our
     canonical key syntax (``name{k1=v1,k2=v2}``, :func:`format_key`) maps
     1:1 onto the exposition format — names sanitized to the Prometheus
-    charset, label values quoted and escaped."""
+    charset and prefixed ``rsdl_`` (so a stock Prometheus scrapes them
+    into their own namespace without relabeling), label values quoted
+    and escaped."""
     global _PROM_NAME_SAN
     if _PROM_NAME_SAN is None:
         import re
@@ -354,8 +397,8 @@ def _prom_sample(key: str, value: float) -> str:
             pairs.append(f'{_PROM_NAME_SAN.sub("_", k)}="{v}"')
         labels = "{" + ",".join(pairs) + "}"
     name = _PROM_NAME_SAN.sub("_", name)
-    if name and name[0].isdigit():
-        name = "_" + name
+    if not name.startswith("rsdl_"):
+        name = "rsdl_" + name
     # Exact rendering: %g would truncate counters to 6 significant digits
     # (1_234_567 -> "1.23457e+06"), corrupting exact row/byte counts in
     # the export. Integral values render as integers; the rest use
@@ -372,23 +415,67 @@ def _prom_sample(key: str, value: float) -> str:
         rendered = str(int(value))
     else:
         rendered = repr(float(value))
-    return f"{name}{labels} {rendered}"
+    return name, labels, rendered
 
 
-def to_prometheus_text(snapshot: Dict[str, float]) -> str:
+# Flat histogram-component suffixes and the Prometheus type each one
+# scrapes correctly as (count/sum accumulate, min/max are levels).
+_HIST_SUFFIX_TYPE = (
+    ("_count", "counter"),
+    ("_sum", "counter"),
+    ("_min", "gauge"),
+    ("_max", "gauge"),
+)
+
+
+def _prom_kind(key: str, kinds: Dict[str, str]) -> str:
+    """The ``# TYPE`` keyword for one snapshot key given the instrument
+    kind map (:meth:`MetricsRegistry.kinds` / the aggregator's merged
+    kinds). Keys of unknown provenance (cross-process source values)
+    stay ``untyped``."""
+    kind = kinds.get(key)
+    if kind in ("counter", "gauge"):
+        return kind
+    for suffix, mapped in _HIST_SUFFIX_TYPE:
+        if key.endswith(suffix) and (
+            kinds.get(key[: -len(suffix)]) == "histogram"
+        ):
+            return mapped
+    return "untyped"
+
+
+def to_prometheus_text(
+    snapshot: Dict[str, float], kinds: Optional[Dict[str, str]] = None
+) -> str:
     """Render a snapshot (:func:`global_snapshot` /
-    :meth:`MetricsRegistry.snapshot`) as Prometheus text exposition
-    format — a plain function, no server: dump it next to the Chrome
-    trace, serve it from your own handler, or pipe it to a pushgateway.
-    Samples are sorted for a stable, diffable artifact; metrics are
-    emitted untyped (counters vs gauges are a consumer-side concern
-    here)."""
+    :meth:`MetricsRegistry.snapshot` / :func:`.export.aggregate`) as
+    Prometheus text exposition format — a plain function, no server:
+    dump it next to the Chrome trace, serve it from the ``/metrics``
+    endpoint (:mod:`.obs_server`), or pipe it to a pushgateway. Samples
+    are grouped per metric name under ``# HELP``/``# TYPE`` headers and
+    sorted, so the artifact is stable, diffable, and scrapeable by a
+    stock Prometheus without relabeling. ``kinds`` maps instrument keys
+    to their kind (defaults to this process's registry); keys it cannot
+    resolve are emitted ``untyped``."""
+    if kinds is None:
+        kinds = registry.kinds()
+    groups: Dict[str, List[Tuple[str, str, str]]] = {}
+    for key in snapshot:
+        name, labels, rendered = _prom_parts(key, float(snapshot[key]))
+        groups.setdefault(name, []).append((labels, rendered, key))
     lines = [
         "# Prometheus text format; generated by "
         "ray_shuffling_data_loader_tpu.telemetry.metrics"
     ]
-    for key in sorted(snapshot):
-        lines.append(_prom_sample(key, float(snapshot[key])))
+    for name in sorted(groups):
+        entries = sorted(groups[name])
+        lines.append(
+            f"# HELP {name} ray_shuffling_data_loader_tpu metric "
+            f"{entries[0][2].split('{', 1)[0]}"
+        )
+        lines.append(f"# TYPE {name} {_prom_kind(entries[0][2], kinds)}")
+        for labels, rendered, _key in entries:
+            lines.append(f"{name}{labels} {rendered}")
     return "\n".join(lines) + "\n"
 
 

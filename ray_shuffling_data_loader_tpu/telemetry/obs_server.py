@@ -1,0 +1,366 @@
+"""Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``.
+
+One stdlib ``http.server`` on a daemon thread inside the driver process,
+env-gated by ``RSDL_OBS_PORT`` — so a running shuffle can be *watched*
+instead of autopsied from CSVs after the fact. Zero overhead when off:
+this module is only imported (and the env var only read) from
+``runtime.init()``'s one-time bring-up; no thread or socket exists
+unless the port is set.
+
+Endpoints:
+
+* ``GET /metrics`` — the cluster-aggregated registry (every process's
+  spooled snapshot + the driver's live registry, merged per-kind by
+  :mod:`.export`) rendered as Prometheus exposition text with
+  ``# TYPE`` lines and per-source (``source=<role>-<pid>``) breakdown.
+  Point a stock Prometheus at it.
+* ``GET /healthz`` — liveness JSON: the server itself, the spool's
+  producer sources (age + staleness per process), and the epoch-window
+  state from the registered status providers.
+* ``GET /status`` — the operator view: in-flight epochs, per-epoch
+  delivery progress (``shuffle.py``'s provider), per-``(epoch, rank)``
+  queue depths (batch-queue provider + ``queue.depth`` gauges), store
+  bytes/spill, ``recovery.*`` counters, and the latest audit verdicts.
+
+**Status providers** are how subsystems publish live state without this
+module knowing about them: ``register_status_provider(name, fn)`` where
+``fn() -> dict`` (called per request, guarded — a raising provider
+reports its error string instead of breaking the page). ``shuffle()``
+registers one when a trial starts; ``BatchQueue`` registers the queue
+actor's window snapshot.
+
+Config: ``RSDL_OBS_PORT`` (no server when unset/empty/0),
+``RSDL_OBS_HOST`` (bind host, default ``127.0.0.1`` — set ``0.0.0.0``
+to scrape from off-host), ``RSDL_OBS_STALE_S`` (drop spool sources
+older than this many seconds from /metrics aggregation; default: keep
+all, since exited workers' counters are exactly what the aggregation
+exists to preserve).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_shuffling_data_loader_tpu.telemetry import export as _export
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+ENV_OBS_PORT = "RSDL_OBS_PORT"
+ENV_OBS_HOST = "RSDL_OBS_HOST"
+ENV_OBS_STALE_S = "RSDL_OBS_STALE_S"
+
+# A source that has not flushed for this long is *flagged* stale on
+# /healthz (flagged, not dropped: an idle-but-alive worker flushes only
+# at task boundaries).
+_STALE_FLAG_S = 60.0
+
+_lock = threading.Lock()
+_server = None
+_thread: Optional[threading.Thread] = None
+_port: Optional[int] = None
+_started_ts: Optional[float] = None
+
+_providers: Dict[str, Callable[[], dict]] = {}
+_providers_lock = threading.Lock()
+
+
+def register_status_provider(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) a ``fn() -> dict`` merged into ``/status``
+    under ``providers.<name>``. Cheap dict set — safe to call whether or
+    not a server is running."""
+    with _providers_lock:
+        _providers[name] = fn
+
+
+def unregister_status_provider(name: str) -> None:
+    with _providers_lock:
+        _providers.pop(name, None)
+
+
+def _provider_snapshots() -> Dict[str, dict]:
+    with _providers_lock:
+        providers = list(_providers.items())
+    out: Dict[str, dict] = {}
+    for name, fn in providers:
+        try:
+            out[name] = fn()
+        except Exception as exc:  # a broken provider must not 500 the page
+            out[name] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return out
+
+
+def configured_port() -> Optional[int]:
+    """The env-configured port, or None when the endpoint is off
+    (unset, empty, unparseable, or <= 0)."""
+    raw = os.environ.get(ENV_OBS_PORT, "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    return port if port > 0 else None
+
+
+def running() -> bool:
+    return _server is not None
+
+
+def port() -> Optional[int]:
+    """The bound port while running (useful with ``start(0)``)."""
+    return _port
+
+
+def _stale_cutoff() -> Optional[float]:
+    raw = os.environ.get(ENV_OBS_STALE_S, "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# Page bodies
+# ---------------------------------------------------------------------------
+
+
+def _metrics_text() -> str:
+    return _export.prometheus_text(max_age_s=_stale_cutoff())
+
+
+def _source_health() -> list:
+    now = time.time()
+    out = []
+    for rec in _export.load_records():
+        src = rec.get("source") or {}
+        age = now - float(rec.get("ts", 0.0))
+        out.append(
+            {
+                "role": src.get("role"),
+                "host": src.get("host"),
+                "pid": src.get("pid"),
+                "age_s": round(age, 1),
+                "stale": age > _STALE_FLAG_S,
+            }
+        )
+    return out
+
+
+def _in_flight_epochs(providers: Dict[str, dict]) -> list:
+    """Union of the epoch windows the providers report (shuffle's
+    driver-side view and the queue actor's admission window)."""
+    epochs = set()
+    for snap in providers.values():
+        for e in snap.get("in_flight_epochs") or []:
+            try:
+                epochs.add(int(e))
+            except (TypeError, ValueError):
+                pass
+    return sorted(epochs)
+
+
+def _healthz_body() -> dict:
+    providers = _provider_snapshots()
+    shuffle_snap = providers.get("shuffle") or {}
+    queue_snap = providers.get("batch_queue") or {}
+    return {
+        "ok": True,
+        "pid": os.getpid(),
+        "uptime_s": round(time.time() - (_started_ts or time.time()), 1),
+        "metrics_enabled": _metrics.enabled(),
+        "sources": _source_health(),
+        "providers": sorted(providers),
+        "epoch_window": {
+            "in_flight_epochs": _in_flight_epochs(providers),
+            "trial_running": shuffle_snap.get("running"),
+        },
+        "producer_alive": queue_snap.get("producer_alive"),
+    }
+
+
+def _status_body() -> dict:
+    providers = _provider_snapshots()
+    flat = _export.aggregate(max_age_s=_stale_cutoff())
+    status: Dict[str, Any] = {
+        "ts": time.time(),
+        "in_flight_epochs": _in_flight_epochs(providers),
+        "providers": providers,
+        "queue_depths": {
+            k: v for k, v in flat.items() if k.startswith("queue.depth")
+        },
+        "recovery": {
+            k: v for k, v in flat.items() if k.startswith("recovery.")
+        },
+    }
+    # Store residency: live local numbers when a runtime session exists
+    # here, else whatever the sampler's gauges last said.
+    try:
+        from ray_shuffling_data_loader_tpu import runtime
+
+        if runtime.is_initialized():
+            s = runtime.store_stats()
+            status["store"] = {
+                "objects": s.num_objects,
+                "total_bytes": s.total_bytes,
+                "spill_bytes": s.spill_bytes,
+            }
+    except Exception:
+        pass
+    if "store" not in status:
+        status["store"] = {
+            "shm_bytes": flat.get("store.shm_bytes"),
+            "spill_bytes": flat.get("store.spill_bytes"),
+            "objects": flat.get("store.objects"),
+        }
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+
+        verdicts = _audit.verdicts()
+        if verdicts:
+            status["audit"] = {
+                "ok": all(
+                    v["ok"] for v in verdicts if v.get("ok") is not None
+                )
+                if any(v.get("ok") is not None for v in verdicts)
+                else None,
+                "verdicts": verdicts[-8:],  # the latest epochs
+            }
+    except Exception:
+        pass
+    return status
+
+
+# ---------------------------------------------------------------------------
+# Server lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class _Handler(BaseHTTPRequestHandler):
+        # No per-request stderr spam from the stdlib handler.
+        def log_message(self, *args):  # noqa: D102
+            pass
+
+        def _send(self, code: int, content_type: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — stdlib handler contract
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    self._send(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        _metrics_text().encode(),
+                    )
+                elif path == "/healthz":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(_healthz_body(), default=str).encode(),
+                    )
+                elif path in ("/", "/status"):
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(_status_body(), default=str).encode(),
+                    )
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+            except BrokenPipeError:
+                pass
+            except Exception as exc:  # page build failed; report, not die
+                try:
+                    self._send(
+                        500,
+                        "text/plain",
+                        f"{type(exc).__name__}: {exc}\n".encode(),
+                    )
+                except Exception:
+                    pass
+
+    return _Handler
+
+
+def start(port_num: Optional[int] = None) -> int:
+    """Bind and serve on a daemon thread; returns the bound port
+    (``port_num=0`` binds an OS-chosen port — tests). Idempotent: a
+    second start while running returns the existing port."""
+    global _server, _thread, _port, _started_ts
+    from http.server import ThreadingHTTPServer
+
+    with _lock:
+        if _server is not None:
+            return _port  # type: ignore[return-value]
+        if port_num is None:
+            port_num = configured_port()
+        if port_num is None:
+            raise ValueError(f"no port given and {ENV_OBS_PORT} not set")
+        host = os.environ.get(ENV_OBS_HOST, "127.0.0.1")
+        server = ThreadingHTTPServer((host, port_num), _make_handler())
+        server.daemon_threads = True
+        _server = server
+        _port = server.server_address[1]
+        _started_ts = time.time()
+        _thread = threading.Thread(
+            target=server.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="rsdl-obs-server",
+            daemon=True,
+        )
+        _thread.start()
+        return _port
+
+
+def maybe_start() -> Optional[int]:
+    """Start iff ``RSDL_OBS_PORT`` names a positive port and no server is
+    running yet. A bind failure (port taken — e.g. two same-host session
+    owners under one env) logs one warning and returns None rather than
+    failing runtime bring-up."""
+    if running():
+        return _port
+    port_num = configured_port()
+    if port_num is None:
+        return None
+    try:
+        return start(port_num)
+    except OSError as exc:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "obs server: cannot bind %s=%s (%s); endpoint disabled for "
+            "this process", ENV_OBS_PORT, port_num, exc,
+        )
+        return None
+
+
+def stop() -> None:
+    """Shut the server down and join its thread (runtime shutdown and
+    tests). Providers stay registered — they are owned by their
+    subsystems."""
+    global _server, _thread, _port, _started_ts
+    with _lock:
+        server, _server = _server, None
+        thread, _thread = _thread, None
+        _port = None
+        _started_ts = None
+    if server is not None:
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:
+            pass
+    if thread is not None:
+        thread.join(timeout=5.0)

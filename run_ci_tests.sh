@@ -47,6 +47,30 @@ if [ "$tier" != "slow" ]; then
     python -m pytest tests/test_chaos.py tests/test_shuffle.py \
       tests/test_batch_queue.py tests/test_dataset.py \
       -m "not slow" -q -x
+  # Observability lane (ISSUE 4): the live obs plane on — metrics
+  # spool/aggregation + the RSDL_OBS_PORT scrape endpoint enabled for
+  # the telemetry/obs suites (core data-path suites ride along so the
+  # endpoint demonstrably doesn't perturb them; the smoke test binds
+  # its own free port, so a taken lane port only warns).
+  RSDL_METRICS=1 RSDL_OBS_PORT=18431 \
+    python -m pytest tests/test_obs.py tests/test_telemetry.py \
+      tests/test_epoch_report.py tests/test_shuffle.py \
+      -m "not slow" -q -x
+  # Epoch critical-path report, gated BOTH ways against the committed
+  # fixture pair: a clean run must exit 0 (and name the dominant
+  # stage), an injected regression must exit non-zero.
+  python tools/epoch_report.py \
+    --trace tests/fixtures/epoch_report/trace.json \
+    --epoch-csv tests/fixtures/epoch_report/epoch_stats.csv \
+    --bench tests/fixtures/epoch_report/bench_clean.json \
+    --baseline tests/fixtures/epoch_report/baseline.json
+  if python tools/epoch_report.py \
+    --trace tests/fixtures/epoch_report/trace.json \
+    --bench tests/fixtures/epoch_report/bench_regressed.json \
+    --baseline tests/fixtures/epoch_report/baseline.json > /dev/null; then
+    echo "epoch_report failed to flag the injected regression" >&2
+    exit 1
+  fi
 fi
 if [ "$tier" != "fast" ]; then
   python -m pytest tests/ -m slow -v --durations=10 || rc=$?

@@ -1,0 +1,161 @@
+"""tools/epoch_report.py tests: per-epoch stage breakdown from a trace,
+critical-path naming on a stage-dominant fixture, stats-CSV joins, and
+the baseline regression gate's exit codes both ways (the CI lane runs
+the same checks against the committed fixtures)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "fixtures", "epoch_report")
+
+
+@pytest.fixture(scope="module")
+def epoch_report():
+    path = os.path.join(_REPO, "tools", "epoch_report.py")
+    spec = importlib.util.spec_from_file_location("epoch_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _span(name, epoch, start_s, dur_s, **args):
+    return {
+        "name": name,
+        "cat": "x",
+        "ph": "X",
+        "ts": start_s * 1e6,
+        "dur": dur_s * 1e6,
+        "pid": 1,
+        "tid": 1,
+        "args": {"epoch": epoch, **args},
+    }
+
+
+def test_critical_path_names_dominant_stage(epoch_report):
+    """A fixture where reduce is artificially dominant must name reduce;
+    one where consume dominates must name consume."""
+    reduce_heavy = [
+        _span("map", 0, 0.0, 1.0),
+        _span("reduce", 0, 1.0, 8.0),
+        _span("deliver", 0, 9.0, 0.2),
+        _span("stage:h2d", 0, 9.1, 0.3),
+    ]
+    report = epoch_report.build_report(
+        reduce_heavy, [], [], None, None, 10.0, 10.0
+    )
+    (row,) = report["epochs"]
+    assert row["critical_path"] == "reduce"
+    assert report["header"]["critical_path"] == "reduce"
+    assert row["reduce_s"] == pytest.approx(8.0)
+    assert row["wall_s"] == pytest.approx(9.4)
+
+    consume_heavy = [
+        _span("map", 0, 0.0, 0.5),
+        _span("reduce", 0, 0.5, 0.5),
+        _span("deliver", 0, 1.0, 0.1),
+        _span("stage:h2d", 0, 1.0, 7.0),
+    ]
+    report = epoch_report.build_report(
+        consume_heavy, [], [], None, None, 10.0, 10.0
+    )
+    assert report["epochs"][0]["critical_path"] == "consume"
+
+
+def test_overlap_idle_and_union_semantics(epoch_report):
+    """Overlapping same-stage tasks count once (interval union); cross-
+    stage overlap and idle gaps are decomposed from the epoch window."""
+    events = [
+        _span("map", 2, 0.0, 2.0),
+        _span("map", 2, 1.0, 2.0),      # overlaps the first map task
+        _span("reduce", 2, 2.5, 1.0),   # 0.5s overlap with map
+        # 1.5s gap (idle), then delivery
+        _span("deliver", 2, 5.0, 1.0),
+    ]
+    report = epoch_report.build_report(events, [], [], None, None, 10, 10)
+    (row,) = report["epochs"]
+    assert row["map_s"] == pytest.approx(3.0)       # union, not 4.0
+    assert row["overlap_s"] == pytest.approx(0.5)
+    assert row["idle_s"] == pytest.approx(1.5)
+    assert row["wall_s"] == pytest.approx(6.0)
+
+
+def test_stall_attribution_and_csv_join(epoch_report, tmp_path):
+    events = [
+        _span("map", 0, 0.0, 1.0),
+        _span("stall", 0, 1.0, 0.25, cause="upstream"),
+        _span("stall", 0, 1.5, 0.75, cause="staging"),
+    ]
+    epoch_rows = [
+        {
+            "trial": "0",
+            "epoch": "0",
+            "duration": "4.5",
+            "throttle_duration": "0.5",
+            "map_stage_duration": "1.0",
+            "reduce_stage_duration": "2.0",
+        }
+    ]
+    report = epoch_report.build_report(
+        events, epoch_rows, [], None, None, 10, 10
+    )
+    (row,) = report["epochs"]
+    assert row["stall_upstream_s"] == pytest.approx(0.25)
+    assert row["stall_staging_s"] == pytest.approx(0.75)
+    assert row["epoch_s"] == pytest.approx(4.5)
+    assert row["throttle_s"] == pytest.approx(0.5)
+
+
+def test_baseline_gate_exit_codes(epoch_report, capsys):
+    """Clean run vs baseline: exit 0; injected regression: exit 1 with a
+    REGRESSION line naming the breach — the exact contract the CI lane
+    gates on (both directions)."""
+    trace = os.path.join(_FIXTURES, "trace.json")
+    baseline = os.path.join(_FIXTURES, "baseline.json")
+    rc = epoch_report.main(
+        [
+            "--trace", trace,
+            "--epoch-csv", os.path.join(_FIXTURES, "epoch_stats.csv"),
+            "--bench", os.path.join(_FIXTURES, "bench_clean.json"),
+            "--baseline", baseline,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "critical_path: reduce" in out
+
+    rc = epoch_report.main(
+        [
+            "--trace", trace,
+            "--bench", os.path.join(_FIXTURES, "bench_regressed.json"),
+            "--baseline", baseline,
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION" in out
+
+
+def test_baseline_accepts_round_capture_wrapper(epoch_report, tmp_path):
+    """BENCH_rXX.json wraps the bench line under "parsed" — the gate must
+    read both shapes."""
+    wrapped = tmp_path / "baseline_wrapped.json"
+    wrapped.write_text(
+        json.dumps({"n": 5, "parsed": {"value": 1.0, "stall_pct": 10.0}})
+    )
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps({"value": 0.2, "stall_pct": 12.0}))
+    rc = epoch_report.main(
+        ["--bench", str(bench), "--baseline", str(wrapped)]
+    )
+    assert rc == 1  # 80% throughput drop vs the wrapped baseline
+
+
+def test_empty_inputs_exit_3(epoch_report, tmp_path):
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    rc = epoch_report.main(["--trace", str(empty)])
+    assert rc == 3

@@ -1,0 +1,388 @@
+"""Observability-plane tests (ISSUE 4): metrics export spool +
+cluster aggregation merge semantics (counters sum, gauges latest-win,
+histograms merge, stale sources expire), the ``RSDL_OBS_PORT``
+endpoint's three pages, and the end-to-end smoke test — a live shuffle
+whose ``/status`` shows an in-flight epoch mid-flight and whose
+``/metrics`` serves worker-sourced counters aggregated across
+processes."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.telemetry import export, metrics
+from ray_shuffling_data_loader_tpu.telemetry import obs_server
+
+_ENV = (
+    "RSDL_METRICS",
+    "RSDL_METRICS_DIR",
+    "RSDL_OBS_PORT",
+)
+
+
+@pytest.fixture
+def metrics_spool(tmp_path):
+    """Metrics on, spooling to a per-test dir; fully unwound on teardown
+    (env popped, cached enabled-state and registry cleared) so the rest
+    of the suite keeps its telemetry-off default. Function-scoped per
+    tests/conftest.py conventions: spawned workers parse the env once
+    per pool."""
+    saved = {k: os.environ.get(k) for k in _ENV}
+    spool = str(tmp_path / "metrics-spool")
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = spool
+    os.environ.pop("RSDL_OBS_PORT", None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    yield spool
+    obs_server.stop()
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+
+
+def _write_record(spool, pid, role, ts, typed):
+    """A spool record as another process would have written it."""
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(spool, f"metrics-{role}-{pid}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "source": {
+                    "role": role,
+                    "host": socket.gethostname(),
+                    "pid": pid,
+                },
+                "ts": ts,
+                "metrics": typed,
+            },
+            f,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Merge semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_sums_across_sources(metrics_spool):
+    metrics.registry.counter("work.rows").inc(5)
+    now = time.time()
+    _write_record(
+        metrics_spool, 111111, "task", now,
+        {"work.rows": {"kind": "counter", "value": 3.0}},
+    )
+    _write_record(
+        metrics_spool, 222222, "task", now,
+        {"work.rows": {"kind": "counter", "value": 4.0}},
+    )
+    assert export.aggregate()["work.rows"] == 12.0
+
+
+def test_gauge_latest_by_timestamp_wins(metrics_spool):
+    now = time.time()
+    _write_record(
+        metrics_spool, 111111, "actor", now - 30,
+        {"q.depth": {"kind": "gauge", "value": 7.0}},
+    )
+    _write_record(
+        metrics_spool, 222222, "actor", now - 5,
+        {"q.depth": {"kind": "gauge", "value": 2.0}},
+    )
+    assert export.aggregate()["q.depth"] == 2.0
+    # A LIVE local gauge is the freshest source of all.
+    metrics.registry.gauge("q.depth").set(9.0)
+    assert export.aggregate()["q.depth"] == 9.0
+
+
+def test_histogram_components_merge(metrics_spool):
+    now = time.time()
+    _write_record(
+        metrics_spool, 111111, "task", now,
+        {"lat": {"kind": "histogram", "count": 2, "sum": 3.0,
+                 "min": 0.5, "max": 1.5}},
+    )
+    _write_record(
+        metrics_spool, 222222, "task", now,
+        {"lat": {"kind": "histogram", "count": 1, "sum": 9.0,
+                 "min": 9.0, "max": 9.0}},
+    )
+    flat = export.aggregate()
+    assert flat["lat_count"] == 3.0
+    assert flat["lat_sum"] == 12.0
+    assert flat["lat_min"] == 0.5
+    assert flat["lat_max"] == 9.0
+
+
+def test_stale_source_expiry(metrics_spool):
+    now = time.time()
+    _write_record(
+        metrics_spool, 111111, "task", now - 1000,
+        {"old.rows": {"kind": "counter", "value": 5.0}},
+    )
+    _write_record(
+        metrics_spool, 222222, "task", now,
+        {"new.rows": {"kind": "counter", "value": 1.0}},
+    )
+    fresh = export.aggregate(max_age_s=60)
+    assert "old.rows" not in fresh and fresh["new.rows"] == 1.0
+    # Without a cutoff, exited workers' counters persist — that is the
+    # point of the spool.
+    assert export.aggregate()["old.rows"] == 5.0
+
+
+def test_per_source_breakdown_labels(metrics_spool):
+    now = time.time()
+    _write_record(
+        metrics_spool, 111111, "task", now,
+        {
+            "work.rows": {"kind": "counter", "value": 3.0},
+            "q.depth{epoch=0,rank=1}": {"kind": "gauge", "value": 4.0},
+        },
+    )
+    flat = export.aggregate(per_source=True)
+    assert flat["work.rows{source=task-111111}"] == 3.0
+    # Labeled keys keep canonical sorted label order with source added.
+    assert flat["q.depth{epoch=0,rank=1,source=task-111111}"] == 4.0
+
+
+def test_flush_writes_identity_stamped_record(metrics_spool):
+    metrics.registry.counter("local.counter").inc(2)
+    metrics.registry.histogram("local.lat").observe(0.25)
+    path = export.flush()
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["source"]["pid"] == os.getpid()
+    assert rec["source"]["role"] == "driver"
+    assert rec["metrics"]["local.counter"] == {
+        "kind": "counter", "value": 2.0
+    }
+    assert rec["metrics"]["local.lat"]["kind"] == "histogram"
+    # Aggregation skips our own spool file in favor of the live
+    # registry: the counter must not double.
+    assert export.aggregate()["local.counter"] == 2.0
+
+
+def test_flush_noop_when_metrics_off(metrics_spool):
+    metrics.disable()
+    metrics.registry.counter("x").inc()
+    assert export.flush() is None
+    assert not os.path.isdir(metrics_spool) or not os.listdir(metrics_spool)
+
+
+# ---------------------------------------------------------------------------
+# Endpoint unit tests (no runtime session)
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_obs_server_pages(metrics_spool):
+    metrics.registry.counter("page.hits").inc(3)
+    port = obs_server.start(0)  # ephemeral bind for tests
+    obs_server.register_status_provider(
+        "probe", lambda: {"in_flight_epochs": [3], "hello": 1}
+    )
+    try:
+        base = f"http://127.0.0.1:{port}"
+        code, body = _get(base + "/healthz")
+        health = json.loads(body)
+        assert code == 200 and health["ok"] is True
+        assert health["epoch_window"]["in_flight_epochs"] == [3]
+        assert "probe" in health["providers"]
+
+        code, body = _get(base + "/status")
+        status = json.loads(body)
+        assert status["providers"]["probe"]["hello"] == 1
+        assert status["in_flight_epochs"] == [3]
+        assert "store" in status
+
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        assert body.startswith("#")
+        assert "rsdl_page_hits 3" in body
+        assert "# TYPE rsdl_page_hits counter" in body
+        # Every sample line is "name{labels} value" — parseable.
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                assert len(line.rsplit(" ", 1)) == 2
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/nope")
+        assert err.value.code == 404
+
+        # A raising provider degrades to an error entry, not a 500.
+        obs_server.register_status_provider(
+            "broken", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+        _, body = _get(base + "/status")
+        assert "boom" in json.loads(body)["providers"]["broken"]["error"]
+    finally:
+        obs_server.unregister_status_provider("probe")
+        obs_server.unregister_status_provider("broken")
+        obs_server.stop()
+    assert not obs_server.running()
+
+
+def test_no_server_without_env(metrics_spool):
+    ctx = runtime.init(num_workers=1)
+    try:
+        assert ctx is not None
+        assert not obs_server.running()
+    finally:
+        runtime.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end smoke: live shuffle, /status mid-flight, /metrics aggregated
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+NUM_FILES = 2
+ROWS_PER_FILE = 1024
+NUM_EPOCHS = 2
+
+
+def test_endpoint_smoke_mid_flight_shuffle(metrics_spool, tmp_path):
+    """ISSUE 4 acceptance: with RSDL_METRICS + RSDL_OBS_PORT set, a
+    running shuffle is visible live — /status reports an in-flight epoch
+    mid-flight, and after completion /metrics serves worker-sourced
+    map/reduce row counters aggregated across >= 2 processes (driver +
+    pool workers), parsing as Prometheus text."""
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+    port = _free_port()
+    os.environ["RSDL_OBS_PORT"] = str(port)
+    ctx = runtime.init(num_workers=2)
+    errors = []
+    try:
+        assert obs_server.running() and obs_server.port() == port
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        files = [
+            generate_file(
+                i, i * ROWS_PER_FILE, ROWS_PER_FILE, 1, str(data_dir)
+            )[0]
+            for i in range(NUM_FILES)
+        ]
+
+        class _SlowConsumer(BatchConsumer):
+            """Drains deliveries with a small per-batch delay so the
+            epochs stay observably in flight."""
+
+            def __init__(self):
+                self.done = {
+                    e: threading.Event() for e in range(NUM_EPOCHS)
+                }
+                self.refs = []
+
+            def consume(self, rank, epoch, batches):
+                self.refs.extend(batches)
+                time.sleep(0.15)
+
+            def producer_done(self, rank, epoch):
+                self.done[epoch].set()
+
+            def wait_until_ready(self, epoch):
+                pass
+
+            def wait_until_all_epochs_done(self):
+                for event in self.done.values():
+                    assert event.wait(timeout=120)
+
+        consumer = _SlowConsumer()
+
+        def _run():
+            try:
+                shuffle(
+                    files,
+                    consumer,
+                    num_epochs=NUM_EPOCHS,
+                    num_reducers=2,
+                    num_trainers=1,
+                    seed=1,
+                )
+            except BaseException as exc:  # surfaced after join
+                errors.append(exc)
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+
+        base = f"http://127.0.0.1:{port}"
+        mid_status = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            _, body = _get(base + "/status")
+            status = json.loads(body)
+            if status["in_flight_epochs"]:
+                mid_status = status
+                break
+            time.sleep(0.05)
+        assert mid_status is not None, "no in-flight epoch ever visible"
+        assert "shuffle" in mid_status["providers"]
+        assert mid_status["providers"]["shuffle"]["running"] is True
+
+        thread.join(timeout=180)
+        assert not thread.is_alive()
+        assert not errors, errors
+
+        # Driver spools its snapshot too (empty registries spool
+        # nothing, so give it one counter), and the healthz source list
+        # then shows the cluster: driver + the task workers.
+        metrics.registry.counter("driver.trials").inc()
+        export.flush()
+        _, body = _get(base + "/healthz")
+        sources = json.loads(body)["sources"]
+        roles = [s["role"] for s in sources]
+        assert "driver" in roles and "task" in roles
+        assert len({(s["role"], s["pid"]) for s in sources}) >= 2
+
+        _, text = _get(base + "/metrics")
+        merged = {}
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                name, _, value = line.rpartition(" ")
+                merged[name] = float(value)
+        total_rows = NUM_FILES * ROWS_PER_FILE * NUM_EPOCHS
+        # Worker-side counters survived worker idleness/exit and merged
+        # across processes into the exact global row count.
+        assert merged["rsdl_shuffle_map_rows"] == total_rows
+        assert merged["rsdl_shuffle_reduce_rows"] == total_rows
+        assert "# TYPE rsdl_shuffle_map_rows counter" in text
+        # Per-source breakdown preserved as labels.
+        assert any(
+            name.startswith("rsdl_shuffle_map_rows{source=")
+            for name in merged
+        )
+
+        # The trial completed: no epoch left in flight.
+        _, body = _get(base + "/status")
+        assert json.loads(body)["in_flight_epochs"] == []
+    finally:
+        obs_server.unregister_status_provider("shuffle")
+        runtime.shutdown()
+    assert not obs_server.running()

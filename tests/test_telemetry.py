@@ -314,24 +314,38 @@ def test_to_prometheus_text_format(telemetry_on):
     text = metrics.to_prometheus_text(metrics.global_snapshot())
     lines = text.splitlines()
     assert lines[0].startswith("#")
-    # Names sanitized to the Prometheus charset; labels quoted; our
-    # key syntax maps 1:1.
-    assert "h2d_bytes 128" in text
-    assert 'queue_depth{epoch="0",rank="1"} 4' in text
-    assert "h2d_dispatch_seconds_count 1" in text
-    assert "h2d_dispatch_seconds_sum 0.5" in text
+    # Names sanitized to the Prometheus charset and prefixed rsdl_ (own
+    # namespace, no relabeling needed); labels quoted; our key syntax
+    # maps 1:1.
+    assert "rsdl_h2d_bytes 128" in text
+    assert 'rsdl_queue_depth{epoch="0",rank="1"} 4' in text
+    assert "rsdl_h2d_dispatch_seconds_count 1" in text
+    assert "rsdl_h2d_dispatch_seconds_sum 0.5" in text
     # Counters render exactly (%g would truncate to 6 significant digits).
-    assert "big_rows 1234567\n" in text
+    assert "rsdl_big_rows 1234567\n" in text
     # A labeled histogram's "_count" suffix belongs to the NAME, with the
     # labels preserved — not mangled into the sanitized name.
-    assert 'queue_wait_count{epoch="2"} 1' in text
+    assert 'rsdl_queue_wait_count{epoch="2"} 1' in text
+    # HELP/TYPE headers per metric name, typed from the registry's kind
+    # map (histogram count/sum scrape as counters, min/max as gauges),
+    # each emitted immediately before its samples.
+    assert "# HELP rsdl_h2d_bytes " in text
+    assert "# TYPE rsdl_h2d_bytes counter" in text
+    assert "# TYPE rsdl_queue_depth gauge" in text
+    assert "# TYPE rsdl_h2d_dispatch_seconds_count counter" in text
+    assert "# TYPE rsdl_h2d_dispatch_seconds_min gauge" in text
+    assert 'rsdl_queue_wait_count{epoch="2"}' in text
+    idx = lines.index("# TYPE rsdl_h2d_bytes counter")
+    assert lines[idx + 1].startswith("rsdl_h2d_bytes ")
     # Non-finite values render as Prometheus literals, not a crash.
     assert metrics.to_prometheus_text(
         {"weird": float("nan"), "hot": float("inf")}
     ).count("NaN") == 1
-    # Deterministic output: samples sorted by key.
-    samples = [ln for ln in lines if not ln.startswith("#")]
-    assert samples == sorted(samples)
+    # Deterministic output: metric groups sorted by name, samples sorted
+    # within each group.
+    names = [ln.split(" ", 2)[2].split(" ")[0]
+             for ln in lines if ln.startswith("# TYPE ")]
+    assert names == sorted(names)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,432 @@
+#!/usr/bin/env python
+"""Epoch critical-path report: trace + stats CSVs -> per-epoch breakdown.
+
+Answers the operator question the raw artifacts only imply: **which
+stage was the bottleneck this epoch?** Ingests the merged Chrome-trace
+JSON (``telemetry.trace_export`` / ``bench.py --trace-out``) and
+optionally the ``stats.process_stats`` CSVs and the bench result JSON,
+then computes per epoch:
+
+* the wall-clock **busy time per pipeline stage** — ``map``, ``reduce``,
+  ``deliver`` (reducer-output handoff incl. queue backpressure), and
+  ``consume`` (trainer-side ``stage:h2d`` staging) — as merged interval
+  unions, so N overlapping map tasks count once;
+* the **overlap** structure: how much of the epoch window had >= 2
+  stages active (pipelining working) vs exactly one (that stage IS the
+  critical path there) vs none (idle: admission throttle, scheduling
+  gaps);
+* the **critical-path stage**: the stage carrying the largest
+  sole-active share of the epoch window (the time nothing else could
+  hide), tie-broken toward the later pipeline stage;
+* **stall attribution** from the trainer's ``stall`` spans
+  (``cause=upstream|staging``) and the epoch CSV's admission-throttle
+  column.
+
+With ``--baseline BENCH_rXX.json`` (either a raw ``bench.py`` JSON line
+or the round-capture wrapper with a ``"parsed"`` field) the current
+run's headline numbers (``--bench``, same shapes) gate a regression
+check: exit **1** when throughput drops more than ``--threshold-pct``
+(default 10) or stall% rises more than ``--stall-threshold-pts``
+(default 10) — so a CI lane can fail on a real slowdown. Exit 2 on
+usage errors, 3 when the inputs contain no per-epoch data (an empty
+report must not read as a pass).
+
+Pure stdlib, no server. Example::
+
+    python bench.py --trace-out=/tmp/run.json > /tmp/bench.json
+    python tools/epoch_report.py --trace /tmp/run.json \
+        --epoch-csv epoch_stats.csv --bench /tmp/bench.json \
+        --baseline BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Span-name -> pipeline-stage mapping (docs/observability.md vocabulary).
+# map:read is a sub-interval of map and deliver:wait-maps is bookkeeping,
+# so neither contributes its own stage.
+_SPAN_STAGE = {
+    "map": "map",
+    "reduce": "reduce",
+    "deliver": "deliver",
+    "stage:h2d": "consume",
+}
+STAGE_ORDER = ["map", "reduce", "deliver", "consume"]
+
+
+def _load_json(path: Optional[str]) -> Optional[dict]:
+    if not path:
+        return None
+    with open(path) as f:
+        text = f.read().strip()
+    # bench stdout may carry log lines around the one JSON line; take the
+    # last line that parses as a JSON object.
+    try:
+        return json.loads(text)
+    except ValueError:
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+    raise ValueError(f"{path}: no JSON object found")
+
+
+def _load_csv(path: Optional[str]) -> List[Dict[str, str]]:
+    if not path:
+        return []
+    with open(path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def _bench_fields(obj: Optional[dict]) -> Dict[str, Any]:
+    """Headline fields from a bench result JSON — accepts both the raw
+    one-line shape and the round-capture wrapper (``{"parsed": {...}}``,
+    the BENCH_rXX.json format)."""
+    if not obj:
+        return {}
+    if isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    return {
+        k: obj[k]
+        for k in (
+            "value", "stall_pct", "stall_upstream_pct", "stall_staging_pct",
+            "total_s", "map_stage_s", "reduce_stage_s", "throttle_s",
+            "backend", "error",
+        )
+        if k in obj
+    }
+
+
+# ---------------------------------------------------------------------------
+# Interval math (microsecond Chrome-trace timestamps)
+# ---------------------------------------------------------------------------
+
+
+def _merge(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for start, end in sorted(intervals):
+        if out and start <= out[-1][1]:
+            if end > out[-1][1]:
+                out[-1] = (out[-1][0], end)
+        else:
+            out.append((start, end))
+    return out
+
+
+def _total(merged: List[Tuple[float, float]]) -> float:
+    return sum(end - start for start, end in merged)
+
+
+def _active_profile(
+    by_stage: Dict[str, List[Tuple[float, float]]]
+) -> Dict[str, float]:
+    """Sweep the union of all stage boundaries and integrate: per-stage
+    sole-active time, total >= 2-stages-overlap time, and any-active
+    time — the decomposition the critical-path call keys on."""
+    points = sorted(
+        {t for ivs in by_stage.values() for iv in ivs for t in iv}
+    )
+    sole = {stage: 0.0 for stage in by_stage}
+    overlap = 0.0
+    any_active = 0.0
+    for lo, hi in zip(points, points[1:]):
+        if hi <= lo:
+            continue
+        active = [
+            stage
+            for stage, ivs in by_stage.items()
+            if any(s <= lo and hi <= e for s, e in ivs)
+        ]
+        span = hi - lo
+        if len(active) == 1:
+            sole[active[0]] += span
+        elif len(active) >= 2:
+            overlap += span
+        if active:
+            any_active += span
+    return {"sole": sole, "overlap": overlap, "any": any_active}
+
+
+def collect_epochs(events: List[dict]) -> Dict[int, Dict[str, Any]]:
+    """Per-epoch stage intervals + stall attribution from trace events."""
+    intervals: Dict[int, Dict[str, List[Tuple[float, float]]]] = {}
+    stalls: Dict[int, Dict[str, float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        epoch = args.get("epoch")
+        if epoch is None:
+            continue
+        try:
+            epoch = int(epoch)
+        except (TypeError, ValueError):
+            continue
+        name = e.get("name")
+        start = float(e.get("ts", 0.0))
+        end = start + max(0.0, float(e.get("dur", 0.0)))
+        stage = _SPAN_STAGE.get(name)
+        if stage is not None:
+            intervals.setdefault(epoch, {}).setdefault(stage, []).append(
+                (start, end)
+            )
+        elif name == "stall":
+            cause = str(args.get("cause", "unknown"))
+            per = stalls.setdefault(epoch, {})
+            per[cause] = per.get(cause, 0.0) + (end - start) / 1e6
+    out: Dict[int, Dict[str, Any]] = {}
+    for epoch, by_stage in intervals.items():
+        merged = {stage: _merge(ivs) for stage, ivs in by_stage.items()}
+        lo = min(s for ivs in merged.values() for s, _ in ivs)
+        hi = max(e for ivs in merged.values() for _, e in ivs)
+        profile = _active_profile(merged)
+        row: Dict[str, Any] = {
+            "epoch": epoch,
+            "wall_s": (hi - lo) / 1e6,
+            "idle_s": (hi - lo - profile["any"]) / 1e6,
+            "overlap_s": profile["overlap"] / 1e6,
+        }
+        for stage in STAGE_ORDER:
+            if stage in merged:
+                row[f"{stage}_s"] = _total(merged[stage]) / 1e6
+                row[f"{stage}_sole_s"] = profile["sole"][stage] / 1e6
+        # Critical path: the stage with the largest SOLE-active time —
+        # the part of the epoch it alone kept the clock running; a
+        # stage fully hidden under another's overlap cannot be the
+        # bottleneck no matter how busy it was. Ties (fully-pipelined
+        # epochs) break toward the later pipeline stage, which is the
+        # one backpressure propagates from.
+        present = [s for s in STAGE_ORDER if s in merged]
+        row["critical_path"] = max(
+            present,
+            key=lambda s: (profile["sole"][s], STAGE_ORDER.index(s)),
+        )
+        for cause, secs in (stalls.get(epoch) or {}).items():
+            row[f"stall_{cause}_s"] = secs
+        out[epoch] = row
+    return out
+
+
+def build_report(
+    events: List[dict],
+    epoch_rows: List[Dict[str, str]],
+    trial_rows: List[Dict[str, str]],
+    bench: Optional[dict],
+    baseline: Optional[dict],
+    threshold_pct: float,
+    stall_threshold_pts: float,
+) -> Dict[str, Any]:
+    epochs = collect_epochs(events)
+
+    # Join the stats-CSV timings by epoch id — first trial only (the CSV
+    # carries one row per (trial, epoch); later trials would overwrite).
+    first_trial = next(
+        (r.get("trial") for r in epoch_rows if r.get("epoch")), None
+    )
+    for r in epoch_rows:
+        if r.get("trial") != first_trial or not r.get("epoch"):
+            continue
+        try:
+            epoch = int(r["epoch"])
+        except ValueError:
+            continue
+        row = epochs.setdefault(epoch, {"epoch": epoch})
+        for src, dst in (
+            ("duration", "epoch_s"),
+            ("throttle_duration", "throttle_s"),
+            ("map_stage_duration", "csv_map_s"),
+            ("reduce_stage_duration", "csv_reduce_s"),
+        ):
+            try:
+                row[dst] = float(r[src])
+            except (KeyError, ValueError, TypeError):
+                pass
+
+    header: Dict[str, Any] = {}
+    cur = _bench_fields(bench)
+    base = _bench_fields(baseline)
+    if cur:
+        header.update(cur)
+    if trial_rows:
+        t = trial_rows[0]
+        for k in ("duration", "num_rows", "num_epochs", "row_throughput"):
+            if t.get(k):
+                header.setdefault(k, t[k])
+    rows = [epochs[e] for e in sorted(epochs)]
+    if rows:
+        totals = {
+            s: sum(r.get(f"{s}_s", 0.0) for r in rows) for s in STAGE_ORDER
+        }
+        header["stage_totals_s"] = {
+            s: round(v, 3) for s, v in totals.items() if v
+        }
+        crit = [r["critical_path"] for r in rows if "critical_path" in r]
+        if crit:
+            # The run-level call: the stage most often on the critical
+            # path across epochs (ties toward the later stage).
+            header["critical_path"] = max(
+                set(crit),
+                key=lambda s: (crit.count(s), STAGE_ORDER.index(s)),
+            )
+
+    regressions: List[str] = []
+    if base:
+        bval, cval = base.get("value"), cur.get("value")
+        if bval and cval is not None:
+            drop_pct = 100.0 * (float(bval) - float(cval)) / float(bval)
+            header["value_vs_baseline_pct"] = round(-drop_pct, 2)
+            if drop_pct > threshold_pct:
+                regressions.append(
+                    f"value {cval} is {drop_pct:.1f}% below baseline "
+                    f"{bval} (threshold {threshold_pct}%)"
+                )
+        bstall, cstall = base.get("stall_pct"), cur.get("stall_pct")
+        if bstall is not None and cstall is not None:
+            rise = float(cstall) - float(bstall)
+            header["stall_vs_baseline_pts"] = round(rise, 2)
+            if rise > stall_threshold_pts:
+                regressions.append(
+                    f"stall_pct {cstall} is {rise:.1f} pts above baseline "
+                    f"{bstall} (threshold {stall_threshold_pts} pts)"
+                )
+    header["regressions"] = regressions
+    return {"header": header, "epochs": rows}
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt(value: Any, width: int = 0) -> str:
+    if value is None or value == "":
+        out = "-"
+    elif isinstance(value, float):
+        out = f"{value:.4g}"
+    else:
+        out = str(value)
+    return out.rjust(width) if width else out
+
+
+_COLUMNS = [
+    "epoch", "wall_s", "map_s", "reduce_s", "deliver_s", "consume_s",
+    "overlap_s", "idle_s", "critical_path", "stall_upstream_s",
+    "stall_staging_s", "throttle_s", "epoch_s",
+]
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = ["epoch critical-path report"]
+    for k, v in report["header"].items():
+        if k == "regressions":
+            continue
+        lines.append(f"  {k}: {_fmt(v) if not isinstance(v, dict) else v}")
+    rows = report["epochs"]
+    if not rows:
+        lines.append("  (no per-epoch data in the given inputs)")
+    else:
+        columns = [
+            c
+            for c in _COLUMNS
+            if any(r.get(c) is not None for r in rows)
+            or c in ("epoch", "critical_path")
+        ]
+        widths = {
+            c: max(len(c), *(len(_fmt(r.get(c))) for r in rows))
+            for c in columns
+        }
+        lines.append("")
+        lines.append("  ".join(c.rjust(widths[c]) for c in columns))
+        lines.append("  ".join("-" * widths[c] for c in columns))
+        for r in rows:
+            lines.append(
+                "  ".join(_fmt(r.get(c), widths[c]) for c in columns)
+            )
+    for msg in report["header"].get("regressions", []):
+        lines.append(f"REGRESSION: {msg}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--trace", help="merged Chrome-trace JSON (telemetry.trace_export)"
+    )
+    parser.add_argument("--epoch-csv", help="stats.py epoch_stats.csv")
+    parser.add_argument("--trial-csv", help="stats.py trial_stats.csv")
+    parser.add_argument(
+        "--bench", help="current run's bench result JSON (bench.py stdout)"
+    )
+    parser.add_argument(
+        "--baseline",
+        help="baseline bench JSON (raw line or BENCH_rXX.json wrapper) "
+        "to gate regressions against",
+    )
+    parser.add_argument(
+        "--threshold-pct", type=float, default=10.0,
+        help="max tolerated throughput drop vs baseline (%%, default 10)",
+    )
+    parser.add_argument(
+        "--stall-threshold-pts", type=float, default=10.0,
+        help="max tolerated stall%% rise vs baseline (points, default 10)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the report as JSON instead of a table",
+    )
+    args = parser.parse_args(argv)
+    if not any((args.trace, args.epoch_csv, args.bench)):
+        parser.print_usage(sys.stderr)
+        print(
+            "epoch_report: need at least one of --trace/--epoch-csv/--bench",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        events: List[dict] = []
+        if args.trace:
+            payload = _load_json(args.trace) or {}
+            events = payload.get("traceEvents") or []
+        bench = _load_json(args.bench)
+        report = build_report(
+            events,
+            _load_csv(args.epoch_csv),
+            _load_csv(args.trial_csv),
+            bench,
+            _load_json(args.baseline),
+            args.threshold_pct,
+            args.stall_threshold_pts,
+        )
+    except (OSError, ValueError) as exc:
+        print(f"epoch_report: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report))
+    if report["header"].get("regressions"):
+        return 1
+    if not report["epochs"] and not _bench_fields(bench):
+        # Nothing per-epoch AND no headline numbers: the inputs carried
+        # zero signal — a gate must not go green on that.
+        print(
+            "epoch_report: no per-epoch data found in the given inputs",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
