@@ -635,6 +635,23 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     # keeps the real step; RSDL_BENCH_REAL_STEP=1 forces it on CPU too.
     mock_step_s = None
     env_mock = os.environ.get("RSDL_BENCH_MOCK_STEP_S")
+    # Calibrated-step config (VERDICT r5 item 5): measure ONE real
+    # compiled step on this backend, then pin the mock step to that
+    # duration (x RSDL_BENCH_CALIBRATED_SCALE) — so the stall claim
+    # rests on a realistic consumer cadence over many steps instead of
+    # 4 real steps at 0.1 GB. Calibration runs after the model is built
+    # (below); sizing treats it as loader-isolation (full workload).
+    calibrate = os.environ.get("RSDL_BENCH_CALIBRATED") == "1"
+    calibrated_from_s = None
+    if calibrate and env_mock is not None:
+        # An explicit RSDL_BENCH_MOCK_STEP_S (value OR the empty-string
+        # real-step opt-out) outranks a lingering calibrate flag — the
+        # per-run knob must never be silently overridden.
+        _log(
+            "RSDL_BENCH_MOCK_STEP_S is set explicitly; ignoring "
+            "RSDL_BENCH_CALIBRATED"
+        )
+        calibrate = False
     if env_mock is not None:
         # Explicitly set: a value mocks at that duration; the empty
         # string is the established real-step opt-out.
@@ -645,7 +662,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     ):
         mock_step_s = 0.002  # the r3-calibrated loader-isolation step
     num_rows, scaled_down = _sized_workload(
-        platform, full_size=mock_step_s is not None
+        platform, full_size=calibrate or mock_step_s is not None
     )
     filenames, dataset_bytes = _get_data(num_rows)
 
@@ -685,6 +702,28 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         state, _ = step_fn(state, example_dev, labels0)
         jax.block_until_ready(state.params)
         return state, step_fn, make_step_body(model, optimizer)
+
+    if calibrate:
+        # Measure the real compiled step, pin the mock to it, drop the
+        # model. min-of-3 (not mean): post-warm-up step time is stable
+        # and the minimum rejects scheduler noise on a loaded host.
+        scale = float(os.environ.get("RSDL_BENCH_CALIBRATED_SCALE", "1"))
+        cal_state, cal_step, _ = build_and_warm(False)
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cal_state, _cal_metrics = cal_step(
+                cal_state, example_dev, labels0
+            )
+            jax.block_until_ready(cal_state.step)
+            samples.append(time.perf_counter() - t0)
+        calibrated_from_s = min(samples)
+        mock_step_s = max(1e-4, calibrated_from_s * scale)
+        del cal_state, cal_step
+        _log(
+            f"calibrated step: measured {calibrated_from_s:.3f}s real "
+            f"x scale {scale} -> mock {mock_step_s:.3f}s"
+        )
 
     # Auto: fused Pallas interaction on single-chip TPU, XLA reference
     # elsewhere. A Mosaic/libtpu compile failure must not cost the round
@@ -1154,7 +1193,16 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "backend": platform,
         "target_context": _target_context(platform),
         "step": (
-            f"mock-{mock_step_s}s" if mock_step_s is not None else "real"
+            f"calibrated-{mock_step_s:.3f}s"
+            if calibrated_from_s is not None
+            else f"mock-{mock_step_s}s"
+            if mock_step_s is not None
+            else "real"
+        ),
+        **(
+            {"calibrated_from_s": round(calibrated_from_s, 4)}
+            if calibrated_from_s is not None
+            else {}
         ),
         "loader": "resident" if use_resident else "mapreduce",
         **({"resident_error": resident_error[:300]} if resident_error else {}),
@@ -1180,6 +1228,430 @@ def run_bench(platform: str, num_chips: int, tpu_error):
     return result
 
 
+# -- TCP-plane bench (two-process loopback "two hosts") ----------------------
+#
+# The DCN stand-in measurement the r5 VERDICT flagged as missing (#2): the
+# reference's cross-host plane (plasma + gRPC) ran on 4-node deployments;
+# this repo's StoreServer windowed fetch had no GB/s, latency, or
+# protocol-overhead number at all. `bench.py --plane tcp` starts a cluster
+# head on 127.0.0.1, joins ONE worker host in a subprocess with its own
+# shm dir (so nothing short-circuits through a shared /dev/shm), and then:
+#
+#   (a) windowed-fetch microbench — a publisher actor ON THE WORKER HOST
+#       publishes hardlinked row-window segments; the driver pulls every
+#       window over TCP through the real remote-fetch path, once with the
+#       legacy pickle framing and once with the zero-copy vectored plane
+#       (RSDL_TCP_ZEROCOPY), against a local-shm read of the same shape
+#       and a raw loopback-socket ceiling;
+#   (b) a mini end-to-end shuffle with locality DISABLED, so map/reduce
+#       tasks scatter across both hosts and reducers/trainers pull their
+#       inputs over TCP — with the audit plane on, proving exactly-once
+#       delivery over the new transport path (`audit.ok`).
+
+
+class _TcpPublisher:
+    """Actor placed on the WORKER host: publishes window segments into
+    that host's store so the driver's fetches must cross TCP."""
+
+    def publish(self, num_windows: int, window_bytes: int):
+        import numpy as np
+
+        from ray_shuffling_data_loader_tpu import runtime
+
+        ctx = runtime.ensure_initialized()
+        rows_per = max(1, window_bytes // 16)  # two 8-byte columns
+        total = rows_per * num_windows
+        pending = ctx.store.create_columns(
+            {
+                "a": ((total,), np.dtype(np.int64)),
+                "b": ((total,), np.dtype(np.float64)),
+            }
+        )
+        try:
+            pending.columns["a"][:] = np.arange(total, dtype=np.int64)
+            pending.columns["b"][:] = 0.5
+            refs = pending.publish_slices(
+                [
+                    (i * rows_per, (i + 1) * rows_per)
+                    for i in range(num_windows)
+                ]
+            )
+        finally:
+            pending.abort()
+        return refs
+
+    def free(self, refs):
+        from ray_shuffling_data_loader_tpu import runtime
+
+        runtime.ensure_initialized().store.free(list(refs))
+
+
+def _publisher_cls():
+    """The publisher class via the importable `bench` module (pickle by
+    reference must resolve on the worker host's agent, where __main__ is
+    the actor bootstrap, not this script)."""
+    try:
+        import bench as _self  # noqa: PLW0406 — self-import on purpose
+
+        return _self._TcpPublisher
+    except ImportError:
+        return _TcpPublisher
+
+
+_TCP_WORKER_SRC = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import cluster
+ctx = runtime.init(address={address!r}, num_workers=2)
+print("[tcp-bench-worker] joined", ctx.cluster.host_id, flush=True)
+cluster.serve_forever()
+runtime.shutdown()
+"""
+
+
+def _raw_loopback_gbps(nbytes: int = 256 << 20) -> float:
+    """Throughput of a plain sendall/recv_into stream over one loopback
+    TCP connection — the kernel-path ceiling any framing overhead is
+    measured against."""
+    import socket
+
+    server = socket.create_server(("127.0.0.1", 0))
+    port = server.getsockname()[1]
+    chunk = bytearray(4 << 20)
+
+    def _sink():
+        conn, _ = server.accept()
+        with conn:
+            buf = memoryview(bytearray(8 << 20))
+            got = 0
+            while got < nbytes:
+                n = conn.recv_into(buf)
+                if not n:
+                    break
+                got += n
+
+    t = threading.Thread(target=_sink, daemon=True)
+    t.start()
+    out = socket.create_connection(("127.0.0.1", port))
+    out.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    t0 = time.perf_counter()
+    sent = 0
+    while sent < nbytes:
+        out.sendall(chunk)
+        sent += len(chunk)
+    out.close()
+    t.join(30)
+    server.close()
+    return sent / 1e9 / max(1e-9, time.perf_counter() - t0)
+
+
+def _lat_stats(lat_s) -> dict:
+    lat_ms = sorted(1e3 * x for x in lat_s)
+    n = len(lat_ms)
+    return {
+        "mean": round(sum(lat_ms) / n, 3),
+        "p50": round(lat_ms[n // 2], 3),
+        "min": round(lat_ms[0], 3),
+        "max": round(lat_ms[-1], 3),
+    }
+
+
+def run_tcp_plane_bench() -> dict:
+    import tempfile as _tempfile
+
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.runtime import transport
+    from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
+
+    windows = int(os.environ.get("RSDL_BENCH_TCP_WINDOWS", "64"))
+    window_mb = float(os.environ.get("RSDL_BENCH_TCP_WINDOW_MB", "4"))
+    window_bytes = int(window_mb * 1e6)
+    shuffle_gb = float(os.environ.get("RSDL_BENCH_TCP_SHUFFLE_GB", "0.2"))
+
+    # Arm metrics + audit BEFORE the cluster comes up: worker-host agents
+    # fix their env at spawn, and the mini shuffle's exactly-once verdict
+    # needs every remote task folding digests.
+    _m.enable()
+    audit_dir = _tempfile.mkdtemp(prefix="rsdl-tcpbench-audit-")
+    _audit.enable(spool_dir=audit_dir)
+    # The mini shuffle must SCATTER (locality would keep reduces next to
+    # their inputs and off the wire — the opposite of what this bench
+    # exists to measure).
+    os.environ["RSDL_DISABLE_LOCALITY"] = "1"
+    # Worker-host processes fix their env at spawn: arm the zero-copy
+    # plane cluster-wide NOW so the shuffle leg's remote reducers ride
+    # it; the windowed-fetch microbench below toggles the DRIVER's gate
+    # per plane (the client side chooses the framing).
+    os.environ["RSDL_TCP_ZEROCOPY"] = "1"
+
+    worker_shm = _tempfile.mkdtemp(prefix="rsdl-tcpbench-shm-")
+    worker_spill = _tempfile.mkdtemp(prefix="rsdl-tcpbench-spill-")
+    ctx = runtime.init_cluster(
+        listen_host="127.0.0.1",
+        advertise_host="127.0.0.1",
+        num_workers=2,
+    )
+    worker_env = dict(
+        os.environ,
+        RSDL_SHM_DIR=worker_shm,
+        RSDL_SPILL_DIR=worker_spill,
+        RSDL_ADVERTISE_HOST="127.0.0.1",
+        JAX_PLATFORMS="cpu",
+    )
+    worker = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            _TCP_WORKER_SRC.format(
+                repo=os.path.dirname(os.path.abspath(__file__)),
+                address=ctx.cluster.address,
+            ),
+        ],
+        env=worker_env,
+    )
+    result = {
+        "metric": (
+            "Cross-host TCP plane: StoreServer windowed fetch GB/s + "
+            "two-host shuffle (loopback stand-in for DCN)"
+        ),
+        "plane": "tcp",
+        "unit": "GB/s",
+        "backend": "cpu",
+        "host_cpus": os.cpu_count(),
+        "windows": windows,
+        "window_mb": window_mb,
+    }
+    try:
+        deadline = time.monotonic() + 120
+        while len(ctx.cluster.registry.call("hosts")) < 2:
+            if worker.poll() is not None:
+                raise RuntimeError(
+                    f"worker host exited rc={worker.returncode}"
+                )
+            if time.monotonic() > deadline:
+                raise RuntimeError("worker host never joined")
+            time.sleep(0.2)
+        worker_host_id = next(
+            hid
+            for hid in ctx.cluster.registry.call("hosts")
+            if hid != ctx.cluster.host_id
+        )
+        pub = runtime.spawn_actor(
+            _publisher_cls(), host_id=worker_host_id
+        )
+        refs = pub.call("publish", windows, window_bytes)
+        store = ctx.store
+        total_bytes = sum(
+            16 * (r.rows[1] - r.rows[0]) for r in refs
+        )
+
+        def _timed_tcp_fetch():
+            lat = []
+            t0 = time.perf_counter()
+            for ref in refs:
+                s = time.perf_counter()
+                cb = store.get_columns(ref)
+                assert cb.num_rows > 0
+                del cb
+                lat.append(time.perf_counter() - s)
+            dt = time.perf_counter() - t0
+            # Drop the fetched caches OUTSIDE the timed window so the
+            # next plane re-fetches over the wire.
+            store.drop_cache(refs)
+            return total_bytes / 1e9 / dt, lat
+
+        # Plane 1: legacy pickle framing.
+        os.environ.pop("RSDL_TCP_ZEROCOPY", None)
+        transport.refresh_zerocopy_from_env()
+        pickle_gbps, pickle_lat = _timed_tcp_fetch()
+        # Plane 2: zero-copy vectored framing.
+        os.environ["RSDL_TCP_ZEROCOPY"] = "1"
+        transport.refresh_zerocopy_from_env()
+        zc_gbps, zc_lat = _timed_tcp_fetch()
+
+        # Baseline: the same windows living in LOCAL shm, reading every
+        # byte (the mmap is lazy; the sum forces the pages).
+        import numpy as np
+
+        rows_per = max(1, window_bytes // 16)
+        local_pending = store.create_columns(
+            {
+                "a": ((rows_per * windows,), np.dtype(np.int64)),
+                "b": ((rows_per * windows,), np.dtype(np.float64)),
+            }
+        )
+        local_pending.columns["a"][:] = 1
+        local_pending.columns["b"][:] = 0.5
+        local_refs = local_pending.publish_slices(
+            [(i * rows_per, (i + 1) * rows_per) for i in range(windows)]
+        )
+        local_pending.abort()
+        del local_pending
+        shm_lat = []
+        t0 = time.perf_counter()
+        for ref in local_refs:
+            s = time.perf_counter()
+            cb = store.get_columns(ref)
+            for col in cb.columns.values():
+                col.sum()
+            del cb
+            shm_lat.append(time.perf_counter() - s)
+        shm_gbps = total_bytes / 1e9 / (time.perf_counter() - t0)
+        store.free(local_refs)
+        pub.call("free", refs)
+
+        raw_gbps = _raw_loopback_gbps()
+        # HMAC challenge-response cost: full authed TCP connection setup
+        # to the worker's store server, amortized per connection.
+        store_addr = tuple(
+            ctx.cluster.registry.call("hosts")[worker_host_id]["store"]
+        )
+        t0 = time.perf_counter()
+        n_conn = 20
+        for _ in range(n_conn):
+            conn = transport.Connection(store_addr, timeout=10.0)
+            conn.close()
+        hmac_ms = 1e3 * (time.perf_counter() - t0) / n_conn
+
+        result["fetch"] = {
+            "total_gb": round(total_bytes / 1e9, 3),
+            "shm_gbps": round(shm_gbps, 3),
+            "tcp_pickle_gbps": round(pickle_gbps, 3),
+            "tcp_zerocopy_gbps": round(zc_gbps, 3),
+            "raw_loopback_gbps": round(raw_gbps, 3),
+            "window_ms": {
+                "shm": _lat_stats(shm_lat),
+                "tcp_pickle": _lat_stats(pickle_lat),
+                "tcp_zerocopy": _lat_stats(zc_lat),
+            },
+            "hmac_handshake_ms": round(hmac_ms, 3),
+            # Framing+pickle+copy overhead vs the raw socket ceiling,
+            # per plane (what fraction of achievable loopback bandwidth
+            # the protocol costs).
+            "overhead_vs_raw_pct": {
+                "tcp_pickle": round(100 * (1 - pickle_gbps / raw_gbps), 1),
+                "tcp_zerocopy": round(100 * (1 - zc_gbps / raw_gbps), 1),
+            },
+        }
+
+        # -- (b) two-host end-to-end shuffle over TCP ---------------------
+        import importlib
+
+        from ray_shuffling_data_loader_tpu.data_generation import (
+            cached_generate_data,
+        )
+
+        # The package re-exports shuffle() the FUNCTION under the module
+        # name; resolve the module explicitly.
+        shuffle_mod = importlib.import_module(
+            "ray_shuffling_data_loader_tpu.shuffle"
+        )
+
+        num_rows = max(4000, int(shuffle_gb * 1e9) // BYTES_PER_ROW)
+        data_dir = os.path.join(CACHE_DIR, f"tcp_r{num_rows}_f8")
+        os.makedirs(data_dir, exist_ok=True)
+        filenames, dataset_bytes = cached_generate_data(
+            num_rows, 8, 1, data_dir, seed=SEED
+        )
+
+        class _Drain(shuffle_mod.BatchConsumer):
+            def __init__(self):
+                self.nbytes = 0
+                self.rows = 0
+
+            def consume(self, rank, epoch, batches):
+                for ref in batches:
+                    cb = store.get_columns(ref)
+                    self.rows += cb.num_rows
+                    self.nbytes += cb.nbytes
+                    del cb
+                    store.free(ref)
+
+            def producer_done(self, rank, epoch):
+                pass
+
+            def wait_until_ready(self, epoch):
+                pass
+
+            def wait_until_all_epochs_done(self):
+                pass
+
+        consumer = _Drain()
+        schedule_log = []
+        t0 = time.perf_counter()
+        shuffle_mod.shuffle(
+            list(filenames),
+            consumer,
+            num_epochs=2,
+            num_reducers=8,
+            num_trainers=1,
+            seed=SEED,
+            schedule_log=schedule_log,
+        )
+        shuffle_s = time.perf_counter() - t0
+        served = {}
+        for hid, info in ctx.cluster.registry.call("hosts").items():
+            from ray_shuffling_data_loader_tpu.runtime.actor import (
+                ActorHandle,
+            )
+
+            role = "head" if hid == ctx.cluster.host_id else "worker"
+            served[role] = ActorHandle(tuple(info["store"])).call(
+                "fetch_stats"
+            )
+        audit_summary = _audit.summary()
+        # summary().ok is None when zero epochs actually reconciled —
+        # that must read as NOT verified, never as a pass.
+        audit_ok = audit_summary.get("ok") is True
+        shuffle_gbps = consumer.nbytes / 1e9 / shuffle_s
+        result["value"] = round(shuffle_gbps, 4)
+        result["shuffle"] = {
+            "dataset_gb": round(dataset_bytes / 1e9, 3),
+            "delivered_gb": round(consumer.nbytes / 1e9, 3),
+            "seconds": round(shuffle_s, 2),
+            "gbps": round(shuffle_gbps, 4),
+            "audit_ok": audit_ok,
+            "zerocopy": True,
+            "served_cross_host": served,
+            "schedules": [s for _, s in schedule_log],
+        }
+        if not audit_ok:
+            result["error"] = "audit mismatch over the TCP plane"
+        if _m.enabled():
+            try:
+                from ray_shuffling_data_loader_tpu.telemetry import (
+                    export as _export,
+                )
+
+                flat = _export.aggregate()
+                result["fetch_window_metrics"] = {
+                    k: v
+                    for k, v in flat.items()
+                    if k.startswith("store.fetch_window")
+                }
+            except Exception:
+                pass
+        return result
+    finally:
+        try:
+            runtime.shutdown()
+        except Exception:
+            pass
+        if worker.poll() is None:
+            worker.terminate()
+            try:
+                worker.wait(10)
+            except subprocess.TimeoutExpired:
+                worker.kill()
+        import shutil as _shutil
+
+        for d in (worker_shm, worker_spill):
+            _shutil.rmtree(d, ignore_errors=True)
+
+
 def _parse_args(argv=None):
     import argparse
 
@@ -1197,6 +1669,18 @@ def _parse_args(argv=None):
         default=os.environ.get("RSDL_METRICS_OUT") or None,
         help="write the sampled metrics timeline + final snapshot JSON "
         "here (default: <trace-out>.metrics.json when --trace-out is set)",
+    )
+    parser.add_argument(
+        "--plane",
+        choices=("local", "tcp"),
+        default="local",
+        help="'tcp' runs the two-process loopback cross-host plane bench "
+        "instead of the training bench: a worker host joins over TCP "
+        "(own shm dir), reducers/trainers fetch inputs through the "
+        "StoreServer windowed-fetch path, and the JSON records GB/s, "
+        "per-window latency, and HMAC/framing/pickle overhead vs the "
+        "same shape on local shm (plane: \"tcp\" artifact; see "
+        "docs/observability.md)",
     )
     parser.add_argument(
         "--audit",
@@ -1247,6 +1731,26 @@ def main() -> None:
             flush=True,
         )
         sys.exit(1)
+
+    if args.plane == "tcp":
+        # The loopback two-host plane bench: self-contained (owns its
+        # cluster, metrics, audit) and same one-JSON-line contract; a
+        # non-zero exit marks a failed capture for the CI lane's check.
+        try:
+            result = run_tcp_plane_bench()
+        except BaseException as exc:  # noqa: BLE001 — the JSON line matters
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            result = {
+                "metric": "Cross-host TCP plane (two-process loopback)",
+                "plane": "tcp",
+                "value": 0.0,
+                "unit": "GB/s",
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+        print(json.dumps(result), flush=True)
+        sys.exit(1 if "error" in result else 0)
 
     from ray_shuffling_data_loader_tpu import telemetry
     from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics

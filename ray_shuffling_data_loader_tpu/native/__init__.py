@@ -94,7 +94,6 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     p = ctypes.c_void_p
     lib.rsdl_take.argtypes = [p, p, p, c_i64, c_i64, c_int]
     lib.rsdl_take_multi.argtypes = [p, p, c_i64, p, p, c_i64, c_i64, c_int]
-    lib.rsdl_take_multi8.argtypes = [p, p, c_i64, p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.restype = c_int
@@ -303,16 +302,12 @@ def take_multi(
     shape = (len(idx), *parts[0].shape[1:])
     if not _out_ok(out, shape, parts[0].dtype):
         out = np.empty(shape, dtype=parts[0].dtype)
-    if row_bytes == 8:
-        lib.rsdl_take_multi8(
-            ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
-            len(idx), _NUM_THREADS,
-        )
-    else:
-        lib.rsdl_take_multi(
-            ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
-            len(idx), row_bytes, _NUM_THREADS,
-        )
+    # rsdl_take_multi dispatches typed inner loops for widths 1/2/4/8
+    # internally (the old separate take_multi8 entry point is gone).
+    lib.rsdl_take_multi(
+        ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
+        len(idx), row_bytes, _NUM_THREADS,
+    )
     return out
 
 

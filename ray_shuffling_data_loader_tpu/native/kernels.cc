@@ -65,6 +65,23 @@ void gather_bytes(const uint8_t* src, uint8_t* dst, const int64_t* idx,
   });
 }
 
+// Typed concat+gather inner loop for rsdl_take_multi (plain indexed
+// load/store instead of a per-row variable-size memcpy).
+template <typename T>
+void take_multi_typed(const void** parts, const int64_t* row_offsets,
+                      int64_t n_parts, T* out, const int64_t* idx,
+                      int64_t n, int n_threads) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      const int64_t* hi =
+          std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
+      int64_t p = hi - row_offsets - 1;
+      out[i] = static_cast<const T*>(parts[p])[j - row_offsets[p]];
+    }
+  });
+}
+
 }  // namespace
 
 extern "C" {
@@ -99,10 +116,32 @@ void rsdl_take(const void* src, void* dst, const int64_t* idx, int64_t n,
 // row_offsets[p] <= j < row_offsets[p+1]; dst[i] = parts[p(idx[i])][...].
 // This is the reduce-stage hot path — the reference materializes
 // pd.concat(parts) first and then permutes (shuffle.py:192-194); fusing
-// halves the memory traffic.
+// halves the memory traffic. Element widths 1/2/4/8 get a typed inner
+// loop (a plain indexed load/store — take_multi_typed above); after
+// 32-bit decode narrowing EVERY column is 4 bytes wide, and the per-row
+// variable-size memcpy was the measured hot spot of the whole reduce
+// stage (BENCHLOG 2026-08-03).
 void rsdl_take_multi(const void** parts, const int64_t* row_offsets,
                      int64_t n_parts, void* dst, const int64_t* idx,
                      int64_t n, int64_t itemsize, int n_threads) {
+  switch (itemsize) {
+    case 1:
+      take_multi_typed(parts, row_offsets, n_parts,
+                       static_cast<uint8_t*>(dst), idx, n, n_threads);
+      return;
+    case 2:
+      take_multi_typed(parts, row_offsets, n_parts,
+                       static_cast<uint16_t*>(dst), idx, n, n_threads);
+      return;
+    case 4:
+      take_multi_typed(parts, row_offsets, n_parts,
+                       static_cast<uint32_t*>(dst), idx, n, n_threads);
+      return;
+    case 8:
+      take_multi_typed(parts, row_offsets, n_parts,
+                       static_cast<uint64_t*>(dst), idx, n, n_threads);
+      return;
+  }
   parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
     uint8_t* out = static_cast<uint8_t*>(dst);
     for (int64_t i = begin; i < end; ++i) {
@@ -114,23 +153,6 @@ void rsdl_take_multi(const void** parts, const int64_t* row_offsets,
       const uint8_t* src = static_cast<const uint8_t*>(parts[p]);
       std::memcpy(out + i * itemsize,
                   src + (j - row_offsets[p]) * itemsize, itemsize);
-    }
-  });
-}
-
-// Same, specialized for 8-byte elements (the DATA_SPEC schema is all
-// int64/float64 on disk), avoiding the per-row memcpy call.
-void rsdl_take_multi8(const void** parts, const int64_t* row_offsets,
-                      int64_t n_parts, void* dst, const int64_t* idx,
-                      int64_t n, int n_threads) {
-  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
-    uint64_t* out = static_cast<uint64_t*>(dst);
-    for (int64_t i = begin; i < end; ++i) {
-      int64_t j = idx[i];
-      const int64_t* hi =
-          std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
-      int64_t p = hi - row_offsets - 1;
-      out[i] = static_cast<const uint64_t*>(parts[p])[j - row_offsets[p]];
     }
   });
 }
@@ -184,17 +206,43 @@ int rsdl_cast_i64_i32_checked(const int64_t* src, int32_t* dst, int64_t n,
 // validates the assignment range before calling.
 void rsdl_group_rows(const void* src, void* dst, const int32_t* assignment,
                      int64_t n, int64_t itemsize, int64_t* offsets) {
+  // Typed scatters for the common element widths: the loop is inherently
+  // serial (the running cursors define the stable order), so the only
+  // lever is making each row a plain indexed store. With 32-bit decode
+  // narrowing on, every column hits the 4-byte case — the map stage's
+  // hottest op (measured: the per-row memcpy path ran ~2x slower,
+  // BENCHLOG 2026-08-03).
+  switch (itemsize) {
+    case 1: {
+      const uint8_t* in1 = static_cast<const uint8_t*>(src);
+      uint8_t* out1 = static_cast<uint8_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) out1[offsets[assignment[i]]++] = in1[i];
+      return;
+    }
+    case 2: {
+      const uint16_t* in2 = static_cast<const uint16_t*>(src);
+      uint16_t* out2 = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) out2[offsets[assignment[i]]++] = in2[i];
+      return;
+    }
+    case 4: {
+      const uint32_t* in4 = static_cast<const uint32_t*>(src);
+      uint32_t* out4 = static_cast<uint32_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) out4[offsets[assignment[i]]++] = in4[i];
+      return;
+    }
+    case 8: {
+      const uint64_t* in8 = static_cast<const uint64_t*>(src);
+      uint64_t* out8 = static_cast<uint64_t*>(dst);
+      for (int64_t i = 0; i < n; ++i) out8[offsets[assignment[i]]++] = in8[i];
+      return;
+    }
+  }
   const uint8_t* in = static_cast<const uint8_t*>(src);
   uint8_t* out = static_cast<uint8_t*>(dst);
-  if (itemsize == 8) {
-    const uint64_t* in8 = static_cast<const uint64_t*>(src);
-    uint64_t* out8 = static_cast<uint64_t*>(dst);
-    for (int64_t i = 0; i < n; ++i) out8[offsets[assignment[i]]++] = in8[i];
-  } else {
-    for (int64_t i = 0; i < n; ++i) {
-      std::memcpy(out + offsets[assignment[i]]++ * itemsize,
-                  in + i * itemsize, itemsize);
-    }
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(out + offsets[assignment[i]]++ * itemsize,
+                in + i * itemsize, itemsize);
   }
 }
 

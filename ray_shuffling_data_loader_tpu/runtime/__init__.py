@@ -187,6 +187,7 @@ def _attach_cluster_client(ctx: RuntimeContext, record: dict, owns: bool):
     ctx._owns_cluster_services = owns
     ctx.store.owner_address = tuple(record["store"])
     ctx.store.remote_fetch = client.fetch_remote
+    ctx.store.remote_fetch_into = client.fetch_remote_into
     ctx.store.remote_free = client.free_remote
     return client
 

@@ -177,7 +177,17 @@ class _ActorHost:
                     if vtid is not None:
                         _release_vtid(vtid)
             if not oneway:
-                transport.write_frame(writer, (req_id, "ok", result))
+                if isinstance(result, transport.OutOfBand):
+                    # Zero-copy reply: meta in the pickle header, bulk
+                    # payload streamed verbatim after it (StoreServer
+                    # fetch_vec path). The sync caller reads it with
+                    # call_vectored/recv_frame.
+                    transport.write_frame_vectored(
+                        writer, (req_id, "okv", result.meta), result.buffers
+                    )
+                    result = None  # release buffer keepalives promptly
+                else:
+                    transport.write_frame(writer, (req_id, "ok", result))
                 await writer.drain()
         except Exception as exc:  # noqa: BLE001 — propagate to caller
             if not oneway:
@@ -384,11 +394,33 @@ class ActorHandle:
         ) from last
 
     def call(self, method: str, *args, **kwargs):
+        # One response tail for plain AND vectored calls (a vectored
+        # reply to a plain call is consumed into a throwaway buffer —
+        # methods that return OutOfBand are only ever invoked through
+        # call_vectored, which hands the payload back). ``into`` is a
+        # RESERVED kwarg name on this client (the vectored allocator);
+        # passing explicit into=None here makes a remote-method kwarg
+        # named ``into`` fail loudly (duplicate keyword) instead of
+        # being silently consumed as the allocator.
+        return self.call_vectored(method, *args, into=None, **kwargs)[0]
+
+    def call_oneway(self, method: str, *args, **kwargs) -> None:
+        self._send_with_retry(
+            self._next_id(), method, args, kwargs, True
+        )
+
+    def call_vectored(self, method: str, *args, into=None, **kwargs):
+        """Call a method whose reply may be a :class:`transport.OutOfBand`
+        vectored frame. Returns ``(meta, payload_view)``; the payload is
+        landed via ``recv_into`` in the buffer ``into(total_bytes)``
+        returns (the zero-copy fetch path mmaps the destination cache
+        file), or ``(result, None)`` when the method replied plainly."""
         req_id = self._next_id()
         conn = self._send_with_retry(req_id, method, args, kwargs, False)
         try:
             while True:
-                resp_id, status, payload = conn.recv()
+                frame, payload = conn.recv_frame(into=into)
+                resp_id, status, meta = frame
                 if resp_id == req_id:
                     break
         except (ConnectionError, OSError) as e:
@@ -396,17 +428,14 @@ class ActorHandle:
             raise ActorDiedError(
                 f"actor {self.name or self.address} died mid-call: {e}"
             ) from e
+        if status == "okv":
+            return meta, payload
         if status == "ok":
-            return payload
-        exc, tb = payload
+            return meta, None
+        exc, tb = meta
         if isinstance(exc, Exception):
             raise exc
         raise RemoteError(f"remote call {method} failed:\n{tb}")
-
-    def call_oneway(self, method: str, *args, **kwargs) -> None:
-        self._send_with_retry(
-            self._next_id(), method, args, kwargs, True
-        )
 
     async def call_async(self, method: str, *args, **kwargs):
         loop = asyncio.get_running_loop()

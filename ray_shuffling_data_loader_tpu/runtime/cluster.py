@@ -194,6 +194,40 @@ class StoreServer:
         self.served_bytes += len(data)
         return data
 
+    def fetch_vec(self, object_id: str, rows=None) -> "transport.OutOfBand":
+        """Zero-copy fetch (``RSDL_TCP_ZEROCOPY`` clients): the reply's
+        bulk payload is a scatter-gather list of views straight over this
+        host's mmapped segment — no ``serialize_columns`` materialization,
+        no ``bytes`` copy, no payload pickle. Wire bytes are identical to
+        :meth:`fetch`'s, so the reader's cache file is the same either
+        way."""
+        import mmap as _mmap
+
+        from .store import map_segment_file, serialize_columns_vectored
+
+        path = self._path(object_id)
+        if rows is None:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                size = os.fstat(fd).st_size
+                mm = _mmap.mmap(fd, size, prot=_mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            self.served_count += 1
+            self.served_bytes += size
+            return transport.OutOfBand(
+                {"nbytes": size}, [memoryview(mm)], keepalive=mm
+            )
+        batch = map_segment_file(path, object_id).slice(
+            int(rows[0]), int(rows[1])
+        )
+        total, bufs = serialize_columns_vectored(batch.columns)
+        self.served_count += 1
+        self.served_bytes += total
+        # keepalive pins the source mmap until the reply is written; the
+        # actor host drops the OutOfBand right after the frame goes out.
+        return transport.OutOfBand({"nbytes": total}, bufs, keepalive=batch)
+
     def fetch_stats(self) -> Dict[str, int]:
         """Cross-host traffic served by this host (the locality test's
         measurement; the reference's analog is plasma transfer metrics)."""
@@ -627,6 +661,23 @@ class ClusterClient:
         return self._peer_store(ref.owner).call(
             "fetch", ref.object_id, ref.rows
         )
+
+    def fetch_remote_into(self, ref: ObjectRef, alloc) -> None:
+        """Zero-copy fetch: the peer streams header + payload as one
+        vectored frame and the payload lands via ``recv_into`` in the
+        buffer ``alloc(total_bytes)`` returns (the store mmaps the
+        destination cache file) — no intermediate ``bytes`` join or
+        payload pickle on either side."""
+        meta, payload = self._peer_store(ref.owner).call_vectored(
+            "fetch_vec", ref.object_id, ref.rows, into=alloc
+        )
+        if payload is None:
+            # Plain reply (defensive — fetch_vec always replies vectored):
+            # land the bytes through the allocator so the caller's
+            # contract holds.
+            data = meta
+            view = memoryview(alloc(len(data))).cast("B")
+            view[: len(data)] = data
 
     def free_remote(self, ref: ObjectRef) -> None:
         try:

@@ -35,12 +35,15 @@ import os
 import secrets
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import faults
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+from . import faults, transport as _transport
 
 _MAGIC = b"RSDL1\x00"
 _ALIGN = 64
@@ -83,6 +86,21 @@ def _default_capacity_bytes(shm_dir: str) -> Optional[int]:
         return int(st.f_blocks * st.f_frsize * frac)
     except OSError:
         return None
+
+
+def fetch_window_depth(default: int = 8) -> int:
+    """The ONE parser of ``RSDL_FETCH_WINDOW_DEPTH``, the window-
+    pipelining depth knob. Call sites pass their own default (the
+    overlapped reduce uses 4 — it also bounds peak fetched-cache
+    residency there; the delivery-plane prefetch pool uses 8); the env
+    var, when set, overrides both."""
+    env = os.environ.get("RSDL_FETCH_WINDOW_DEPTH")
+    if not env:
+        return default
+    try:
+        return max(1, int(env))
+    except ValueError:
+        return default
 
 
 class ObjectLostError(FileNotFoundError):
@@ -406,6 +424,46 @@ def serialize_columns(columns: Mapping[str, np.ndarray]) -> bytes:
     return bytes(out)
 
 
+_PAD64 = bytes(_ALIGN)
+
+
+def serialize_columns_vectored(
+    columns: Mapping[str, np.ndarray],
+) -> Tuple[int, List]:
+    """``(total_bytes, buffers)`` for the segment wire/disk format WITHOUT
+    materializing the payload: the buffers are the source column views
+    themselves (plus a small header and sub-64-byte alignment pads), byte-
+    identical when concatenated to :func:`serialize_columns`'s output.
+    This is the zero-copy TCP plane's scatter-gather list — a window
+    fetch streams straight out of the owner's mmapped segment instead of
+    paying a full ``bytearray`` build plus a ``bytes()`` copy plus a
+    payload pickle. Callers must keep the source mapping alive until the
+    buffers are consumed."""
+    cols = {
+        k: (v if v.flags.c_contiguous else np.ascontiguousarray(v))
+        for k, v in columns.items()
+    }
+    meta, meta_blob, payload_start, total = _plan_layout(
+        {k: (v.shape, v.dtype) for k, v in cols.items()}
+    )
+    head = bytearray(payload_start)
+    head[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
+    head[_HEADER.size : _HEADER.size + len(meta_blob)] = meta_blob
+    bufs: List = [head]
+    pos = payload_start
+    for m, arr in zip(meta, cols.values()):
+        target = payload_start + m["offset"]
+        if target > pos:  # inter-column alignment gap, always < 64 B
+            bufs.append(_PAD64[: target - pos])
+            pos = target
+        if arr.nbytes:
+            bufs.append(memoryview(arr).cast("B"))
+            pos += arr.nbytes
+    if total > pos:  # trailing alignment pad
+        bufs.append(_PAD64[: total - pos])
+    return total, bufs
+
+
 @dataclass
 class StoreStats:
     num_objects: int = 0
@@ -444,6 +502,10 @@ class ObjectStore:
         # on foreign refs go through remote_fetch; frees forward to owners.
         self.owner_address: Optional[Tuple] = None
         self.remote_fetch = None  # Callable[[ObjectRef], bytes]
+        # Zero-copy fetch hook (RSDL_TCP_ZEROCOPY): pulls the ref's bytes
+        # straight into a buffer the allocator returns (an mmapped cache
+        # file) — Callable[[ObjectRef, Callable[[int], buffer]], None].
+        self.remote_fetch_into = None
         self.remote_free = None  # Callable[[ObjectRef], None]
         self._foreign: set = set()  # locally cached foreign object ids
         self._prefetch_pool = None  # lazy ThreadPoolExecutor
@@ -638,6 +700,25 @@ class ObjectStore:
             and self.remote_fetch is not None
         )
 
+    def is_foreign(self, ref: ObjectRef) -> bool:
+        """Does reading this ref require (or did it require) a cross-host
+        fetch? The shuffle reduce uses this to decide whether the
+        overlapped fetch/gather pipeline buys anything."""
+        return self._is_foreign(ref)
+
+    def needs_fetch(self, ref: ObjectRef) -> bool:
+        """Would reading this ref RIGHT NOW pay a cross-host fetch —
+        foreign, not yet cached locally, and not directly mappable
+        (sessions sharing one /dev/shm)? The overlap auto-policy keys on
+        this instead of :meth:`is_foreign`: a retried reduce whose first
+        attempt already cached its windows has no fetch latency to hide
+        and should keep the fused gather."""
+        return (
+            self._is_foreign(ref)
+            and self._find_cache(ref) is None
+            and self._find_segment(ref.object_id) is None
+        )
+
     def _cache_name(self, ref: ObjectRef) -> str:
         # Caches carry the READER session's prefix (not the producer's):
         # every process sharing this session computes the same name, and
@@ -671,9 +752,14 @@ class ObjectStore:
     def get_bytes(self, ref: ObjectRef) -> bytes:
         return self.get_columns(ref)["__bytes__"].tobytes()
 
-    def prefetch(self, refs, max_parallel: int = 8) -> List:
+    def prefetch(self, refs, max_parallel: Optional[int] = None) -> List:
         """Start pulling foreign refs' windows into the local cache on
         background threads; returns immediately with the fetch futures.
+        ``max_parallel`` defaults to :func:`fetch_window_depth` (the
+        ``RSDL_FETCH_WINDOW_DEPTH`` knob; this delivery-plane path
+        defaults to 8 when the env is unset, the overlapped reduce to
+        4) and binds on the FIRST call — the pool is process-lifetime,
+        so later calls reuse its width.
 
         The ``ray.wait(fetch_local=True)`` analog (reference
         ``dataset.py:132-137``): the reference pulls ALL pending reducer
@@ -698,6 +784,19 @@ class ObjectStore:
         ]
         if not foreign:
             return []
+        # An explicit prefetch REQUEST supersedes any free/drop_cache
+        # tombstone for these refs: the tombstones exist to discard a
+        # late-landing fetch from BEFORE the free, but a retried reduce
+        # (or a second bench plane) legitimately re-reads dropped
+        # windows, and a permanent tombstone would silently no-op its
+        # prefetches forever (degrading the retry to serial synchronous
+        # fetches). A still-in-flight old fetch that now lands is
+        # wanted again — object ids are immutable content, so the copy
+        # is identical either way.
+        for ref in foreign:
+            self._freed_caches.discard(self._cache_name(ref))
+        if max_parallel is None:
+            max_parallel = fetch_window_depth(default=8)
         with self._prefetch_lock:
             if self._prefetch_pool is None:
                 import concurrent.futures
@@ -733,15 +832,79 @@ class ObjectStore:
         """Pull a foreign segment's bytes (just the ref's window) and
         publish them locally.
 
+        With the zero-copy plane on (``RSDL_TCP_ZEROCOPY`` + cluster
+        wiring), the peer's vectored reply lands via ``recv_into``
+        directly in the mmapped destination file — no intermediate
+        ``bytes``, no payload pickle on either side. Otherwise the legacy
+        path fetches one bytes blob and writes it out.
+
         Concurrent readers may race here; both write a private tmp file and
         the renames are idempotent (same content), so the winner is
         irrelevant."""
-        data = self.remote_fetch(ref)
+        t0 = time.perf_counter() if _metrics.enabled() else None
         tmp = f"{path}.fetch-{os.getpid()}-{secrets.token_hex(4)}"
-        with open(tmp, "wb") as f:
-            f.write(data)
+        zerocopy = (
+            self.remote_fetch_into is not None
+            and _transport.zerocopy_enabled()
+        )
+        nbytes = 0
+        if zerocopy:
+            holder: Dict[str, mmap.mmap] = {}
+
+            def _alloc(n: int):
+                fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+                try:
+                    os.ftruncate(fd, max(n, 1))
+                    mm = mmap.mmap(fd, max(n, 1))
+                finally:
+                    os.close(fd)
+                holder["mm"] = mm
+                holder["n"] = n
+                return mm
+
+            try:
+                self.remote_fetch_into(ref, _alloc)
+                nbytes = holder.get("n", 0)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except FileNotFoundError:
+                    pass
+                raise
+            finally:
+                mm = holder.pop("mm", None)
+                if mm is not None:
+                    try:
+                        mm.close()
+                    except BufferError:
+                        # Belt-and-braces: should be unreachable now that
+                        # the transport releases its recv views on every
+                        # exit, but a still-exported view must never
+                        # replace the recoverable fetch error (the
+                        # retry/lineage ladder keys on it); GC closes the
+                        # mmap once the exception's traceback is dropped.
+                        pass
+        else:
+            data = self.remote_fetch(ref)
+            nbytes = len(data)
+            with open(tmp, "wb") as f:
+                f.write(data)
         os.rename(tmp, path)
         self._foreign.add(os.path.basename(path))
+        if t0 is not None:
+            # Per-window DCN latency + bytes — the TCP plane's primary
+            # observability (docs/observability.md); label carries which
+            # framing served the window.
+            try:
+                zc = "1" if zerocopy else "0"
+                _metrics.registry.histogram(
+                    "store.fetch_window_seconds", zerocopy=zc
+                ).observe(time.perf_counter() - t0)
+                _metrics.registry.counter(
+                    "store.fetch_window_bytes", zerocopy=zc
+                ).inc(float(nbytes))
+            except Exception:
+                pass
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -26,13 +26,61 @@ import os
 import pickle
 import socket
 import struct
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from . import faults
 
 _LEN = struct.Struct("<Q")
 _AUTH_MAGIC = b"RSDLAUTH"
 _NONCE_LEN = 16
+
+# Vectored-frame marker: the top bit of the length prefix. When set, the
+# remaining 63 bits are the length of a pickled ``(obj, [payload sizes])``
+# header and ``sum(sizes)`` raw payload bytes follow the header directly —
+# bulk data never transits pickle, and the receiver lands it straight in a
+# caller-provided buffer (``recv_into`` an mmapped cache segment). Plain
+# frames are unchanged, so the two framings interleave on one connection.
+_VEC_FLAG = 1 << 63
+# sendmsg iov count stays far below any IOV_MAX (Linux: 1024).
+_SENDMSG_MAX_VECS = 512
+
+ENV_ZEROCOPY = "RSDL_TCP_ZEROCOPY"
+_zerocopy: Optional[bool] = None  # tri-state cache, like the telemetry gates
+
+
+def zerocopy_enabled() -> bool:
+    """Is the zero-copy vectored fetch plane on (``RSDL_TCP_ZEROCOPY``)?
+    Off by default — the gated contract shared with the telemetry planes:
+    when off, no vectored frame is ever requested and the legacy pickle
+    path runs untouched. One cached boolean after the first read."""
+    global _zerocopy
+    if _zerocopy is None:
+        _zerocopy = os.environ.get(ENV_ZEROCOPY, "").strip().lower() in (
+            "1", "on", "true", "yes",
+        )
+    return _zerocopy
+
+
+def refresh_zerocopy_from_env() -> None:
+    """Forget the cached gate; next check re-reads the env (tests/bench)."""
+    global _zerocopy
+    _zerocopy = None
+
+
+class OutOfBand:
+    """An actor-method result whose bulk payload rides outside the pickle
+    frame: ``meta`` is pickled into the reply header, ``buffers`` are
+    buffer-protocol objects (mmaps, numpy views) streamed verbatim after
+    it. ``keepalive`` pins whatever owns the buffers' memory until the
+    reply is written."""
+
+    __slots__ = ("meta", "buffers", "keepalive")
+
+    def __init__(self, meta: Any, buffers: Sequence, keepalive: Any = None):
+        self.meta = meta
+        self.buffers = list(buffers)
+        self.keepalive = keepalive
+
 
 # Address = ("unix", path) | ("tcp", host, port)
 Address = Tuple
@@ -131,12 +179,74 @@ class Connection:
         payload = dumps(obj)
         self.sock.sendall(_LEN.pack(len(payload)) + payload)
 
+    def send_vectored(self, obj: Any, buffers: Sequence) -> None:
+        """Send ``obj`` plus raw payload buffers as ONE vectored frame:
+        header and payload hit the wire through a single ``sendmsg``
+        scatter-gather call (no intermediate ``bytes`` join, no pickle of
+        the payload). The receiver must use :meth:`recv_frame`.
+
+        Today's production bulk flow is server->client (StoreServer
+        replies via the asyncio :func:`write_frame_vectored`); this sync
+        send side is the client->server half of the same framing —
+        covered by the transport tests and reserved for a zero-copy put
+        path."""
+        if faults.enabled():
+            faults.fire("transport.send")
+        views = [memoryview(b).cast("B") for b in buffers]
+        header = dumps((obj, [v.nbytes for v in views]))
+        self._sendmsg_all(
+            [
+                memoryview(_LEN.pack(_VEC_FLAG | len(header))),
+                memoryview(header),
+                *views,
+            ]
+        )
+
+    def _sendmsg_all(self, views: List[memoryview]) -> None:
+        """sendall over a scatter-gather list, advancing across partial
+        sends without ever coalescing the buffers in user space."""
+        queue = [v for v in views if v.nbytes]
+        while queue:
+            try:
+                sent = self.sock.sendmsg(queue[:_SENDMSG_MAX_VECS])
+            except InterruptedError:
+                continue
+            while sent:
+                head = queue[0]
+                if sent >= head.nbytes:
+                    sent -= head.nbytes
+                    queue.pop(0)
+                else:
+                    queue[0] = head[sent:]
+                    sent = 0
+
     def recv(self) -> Any:
+        return self.recv_frame()[0]
+
+    def recv_frame(
+        self, into: Optional[Callable[[int], Any]] = None
+    ) -> Tuple[Any, Optional[memoryview]]:
+        """Read one frame. Plain frames return ``(obj, None)``. Vectored
+        frames return ``(obj, payload_view)`` with the payload landed via
+        ``recv_into`` in the buffer ``into(total_bytes)`` returns (an
+        mmapped cache file on the fetch path) — or a throwaway bytearray
+        when no allocator is given."""
         if faults.enabled():
             faults.fire("transport.recv")
         header = self._recv_exact(_LEN.size)
         (length,) = _LEN.unpack(header)
-        return loads(self._recv_exact(length))
+        if not length & _VEC_FLAG:
+            return loads(self._recv_exact(length)), None
+        obj, sizes = loads(self._recv_exact(length & ~_VEC_FLAG))
+        total = int(sum(sizes))
+        raw = into(total) if into is not None else bytearray(total)
+        # _recv_exact_into creates and RELEASES its own views: on a
+        # mid-payload failure no memoryview over ``raw`` may survive
+        # into the traceback — the fetch path's error cleanup closes the
+        # underlying mmap, and a still-exported view would turn the
+        # recoverable ConnectionError into BufferError at close().
+        self._recv_exact_into(raw, total)
+        return obj, memoryview(raw).cast("B")[:total]
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -147,6 +257,21 @@ class Connection:
             chunks.append(chunk)
             n -= len(chunk)
         return b"".join(chunks)
+
+    def _recv_exact_into(self, buf, n: int) -> None:
+        """Fill ``buf[:n]`` from the socket. The view over ``buf`` is
+        released on EVERY exit path (the caller may need to close the
+        buffer's mmap during exception cleanup — see recv_frame)."""
+        view = memoryview(buf).cast("B")
+        try:
+            off = 0
+            while off < n:
+                got = self.sock.recv_into(view[off:n])
+                if not got:
+                    raise ConnectionError("connection closed by peer")
+                off += got
+        finally:
+            view.release()
 
     def close(self) -> None:
         try:
@@ -161,12 +286,33 @@ class Connection:
 async def read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(header)
+    if length & _VEC_FLAG:
+        # Vectored frames only flow server -> sync fetch client; an actor
+        # server (or the async demux client) receiving one is a protocol
+        # violation — fail the connection rather than unpickle garbage.
+        raise ConnectionError("unexpected vectored frame")
     return loads(await reader.readexactly(length))
 
 
 def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
     payload = dumps(obj)
     writer.write(_LEN.pack(len(payload)) + payload)
+
+
+def write_frame_vectored(
+    writer: asyncio.StreamWriter, obj: Any, buffers: Sequence
+) -> None:
+    """Server side of a vectored reply: pickled header, then each payload
+    buffer written as-is (the transport sends what it can immediately and
+    buffers only the remainder — no payload pickle, no join). Sources may
+    be released once this returns: asyncio copies unsent tails."""
+    views = [memoryview(b).cast("B") for b in buffers]
+    header = dumps((obj, [v.nbytes for v in views]))
+    writer.write(_LEN.pack(_VEC_FLAG | len(header)))
+    writer.write(header)
+    for v in views:
+        if v.nbytes:
+            writer.write(v)
 
 
 async def open_connection(address: Address):

@@ -53,6 +53,7 @@ from ray_shuffling_data_loader_tpu.runtime.tasks import (
 )
 from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
 from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
 
 
@@ -309,36 +310,51 @@ def shuffle_map(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    prof = _phases.stage_profiler("map", epoch=epoch, file=file_index)
     new_cache_ref = None
     if cache_ref is not None:
-        batch = ctx.store.get_columns(cache_ref)
+        with prof.phase("window-fetch") as ph:
+            batch = ctx.store.get_columns(cache_ref)
+            ph.add_bytes(batch.nbytes)
     else:
         # Worker-side thread decision: this host's cores, capped pool
         # (utils.arrow_decode_threads; stage_tasks == files this epoch).
-        use_threads = stage_tasks > 0 and arrow_decode_threads(stage_tasks)
-        batch = read_parquet_columns(filename, use_threads=use_threads)
-        if narrow_to_32:
-            batch = ColumnBatch(
-                {k: _narrow_column(k, v) for k, v in batch.columns.items()}
+        with prof.phase("decode") as ph:
+            use_threads = (
+                stage_tasks > 0 and arrow_decode_threads(stage_tasks)
             )
+            batch = read_parquet_columns(filename, use_threads=use_threads)
+            ph.add_bytes(batch.nbytes)
+        if narrow_to_32:
+            with prof.phase("narrow", nbytes=batch.nbytes):
+                batch = ColumnBatch(
+                    {
+                        k: _narrow_column(k, v)
+                        for k, v in batch.columns.items()
+                    }
+                )
         if publish_cache:
             # The cache is purely an optimization: a failed publish
             # (ENOSPC etc.) degrades to plain per-epoch decode — it must
             # never sink the run (claim_or_wait treats a None ref as
             # "decode yourself").
-            try:
-                cache_pending = ctx.store.create_columns(
-                    {k: (v.shape, v.dtype) for k, v in batch.columns.items()}
-                )
+            with prof.phase("cache-publish", nbytes=batch.nbytes):
                 try:
-                    for k, v in batch.columns.items():
-                        np.copyto(cache_pending.columns[k], v)
-                    new_cache_ref = cache_pending.seal()
-                finally:
-                    cache_pending.abort()
-                del cache_pending
-            except Exception:
-                new_cache_ref = None
+                    cache_pending = ctx.store.create_columns(
+                        {
+                            k: (v.shape, v.dtype)
+                            for k, v in batch.columns.items()
+                        }
+                    )
+                    try:
+                        for k, v in batch.columns.items():
+                            np.copyto(cache_pending.columns[k], v)
+                        new_cache_ref = cache_pending.seal()
+                    finally:
+                        cache_pending.abort()
+                    del cache_pending
+                except Exception:
+                    new_cache_ref = None
     end_read = timeit.default_timer()
 
     # Any file size is legal, including n < num_reducers (some reducers
@@ -358,15 +374,17 @@ def shuffle_map(
         {k: (v.shape, v.dtype) for k, v in batch.columns.items()}
     )
     try:
-        _, offsets = native.group_rows_multi(
-            batch.columns, assignment, num_reducers, out=pending.columns
-        )
-        refs = pending.publish_slices(
-            [
-                (int(offsets[i]), int(offsets[i + 1]))
-                for i in range(num_reducers)
-            ]
-        )
+        with prof.phase("partition-scatter", nbytes=batch.nbytes):
+            _, offsets = native.group_rows_multi(
+                batch.columns, assignment, num_reducers, out=pending.columns
+            )
+        with prof.phase("publish"):
+            refs = pending.publish_slices(
+                [
+                    (int(offsets[i]), int(offsets[i + 1]))
+                    for i in range(num_reducers)
+                ]
+            )
     finally:
         # Reclaims the tmpfs segment if anything above raised; no-op after
         # a successful publish.
@@ -437,16 +455,18 @@ def shuffle_plan(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    prof = _phases.stage_profiler("plan", epoch=epoch, file=file_index)
     cached = ctx.store.get_columns(cache_ref)
     n = cached.num_rows
     del cached  # header read only; drop the mmap view immediately
     end_read = timeit.default_timer()
-    rng = _map_seed(seed, epoch, file_index)
-    assignment = rng.integers(num_reducers, size=n)
-    # Stable argsort groups indices by reducer preserving file order —
-    # the same stable grouping native.group_rows_multi applies to data.
-    order = np.argsort(assignment, kind="stable")
-    counts = np.bincount(assignment, minlength=num_reducers)
+    with prof.phase("plan", nbytes=8 * n):
+        rng = _map_seed(seed, epoch, file_index)
+        assignment = rng.integers(num_reducers, size=n)
+        # Stable argsort groups indices by reducer preserving file order —
+        # the same stable grouping native.group_rows_multi applies to data.
+        order = np.argsort(assignment, kind="stable")
+        counts = np.bincount(assignment, minlength=num_reducers)
     if _audit.enabled():
         # The index schedule never touches column data; the audit pays
         # one key-column read from the cached segment so the map side of
@@ -462,13 +482,21 @@ def shuffle_plan(
     idx_dtype = np.int32 if n <= np.iinfo(np.int32).max else np.int64
     pending = ctx.store.create_columns({"idx": ((n,), np.dtype(idx_dtype))})
     try:
-        np.copyto(pending.columns["idx"], order.astype(idx_dtype, copy=False))
-        refs = pending.publish_slices(
-            [
-                (int(offsets[r]), int(offsets[r + 1]))
-                for r in range(num_reducers)
-            ]
-        )
+        with prof.phase("publish", nbytes=n * np.dtype(idx_dtype).itemsize):
+            # One fused cast-copy straight into the segment view: the old
+            # ``astype(...)`` built a full int32 intermediate that copyto
+            # then copied AGAIN — a second full pass over the index data
+            # (values fit idx_dtype by construction, so the narrowing
+            # cast is exact).
+            np.copyto(
+                pending.columns["idx"], order, casting="same_kind"
+            )
+            refs = pending.publish_slices(
+                [
+                    (int(offsets[r]), int(offsets[r + 1]))
+                    for r in range(num_reducers)
+                ]
+            )
     finally:
         pending.abort()
     del pending
@@ -512,17 +540,23 @@ def shuffle_gather_reduce(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    prof = _phases.stage_profiler(
+        "gather-reduce", epoch=epoch, reducer=reduce_index
+    )
     caches: List[ColumnBatch] = []
     idx_parts: List[ColumnBatch] = []
     try:
-        caches = [ctx.store.get_columns(r) for r in cache_refs]
-        idx_parts = [ctx.store.get_columns(r)["idx"] for r in idx_refs]
+        with prof.phase("window-fetch") as ph:
+            caches = [ctx.store.get_columns(r) for r in cache_refs]
+            idx_parts = [ctx.store.get_columns(r)["idx"] for r in idx_refs]
+            ph.add_bytes(sum(ip.nbytes for ip in idx_parts))
         counts = [len(ip) for ip in idx_parts]
         dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
         np.cumsum(counts, out=dst_off[1:])
         total = int(dst_off[-1])
-        rng = _reduce_seed(seed, epoch, reduce_index)
-        perm = rng.permutation(total)
+        with prof.phase("permute", nbytes=8 * total):
+            rng = _reduce_seed(seed, epoch, reduce_index)
+            perm = rng.permutation(total)
         template = caches[0] if caches else None
         pending = ctx.store.create_columns(
             {
@@ -541,22 +575,27 @@ def shuffle_gather_reduce(
             from ray_shuffling_data_loader_tpu import native
 
             keys = list(template or {})
-            compact = {
-                k: np.empty(
-                    (total, *template[k].shape[1:]), template[k].dtype
-                )
-                for k in keys
-            }
-            for i, (idx_i, cache) in enumerate(zip(idx_parts, caches)):
-                lo, hi = int(dst_off[i]), int(dst_off[i + 1])
-                if hi > lo:
-                    for k in keys:
-                        native.take(cache[k], idx_i, out=compact[k][lo:hi])
-            for k, dst in pending.columns.items():
-                native.take(compact[k], perm, out=dst)
+            with prof.phase("gather") as ph:
+                compact = {
+                    k: np.empty(
+                        (total, *template[k].shape[1:]), template[k].dtype
+                    )
+                    for k in keys
+                }
+                for i, (idx_i, cache) in enumerate(zip(idx_parts, caches)):
+                    lo, hi = int(dst_off[i]), int(dst_off[i + 1])
+                    if hi > lo:
+                        for k in keys:
+                            native.take(
+                                cache[k], idx_i, out=compact[k][lo:hi]
+                            )
+                for k, dst in pending.columns.items():
+                    native.take(compact[k], perm, out=dst)
+                ph.add_bytes(2 * sum(v.nbytes for v in compact.values()))
             if _audit.enabled():
                 _audit.record_reduce(epoch, reduce_index, pending.columns)
-            out_ref = pending.seal()
+            with prof.phase("publish"):
+                out_ref = pending.seal()
         finally:
             pending.abort()
         del pending
@@ -580,6 +619,102 @@ def shuffle_gather_reduce(
     return out_ref
 
 
+def _ref_window_rows(ref) -> Optional[int]:
+    """Row count of a window ref, or None when the ref covers a whole
+    segment (row count unknowable without opening it)."""
+    rows = getattr(ref, "rows", None)
+    if rows is None:
+        return None
+    return int(rows[1]) - int(rows[0])
+
+
+def _fetch_window_depth() -> int:
+    """How many mapper-partition windows the overlapped reduce keeps in
+    flight ahead of the gather (``RSDL_FETCH_WINDOW_DEPTH``, default 4 —
+    measured flat from 2..8 on loopback, so the default leans small to
+    bound peak cache residency at ``depth`` windows)."""
+    from ray_shuffling_data_loader_tpu.runtime.store import (
+        fetch_window_depth,
+    )
+
+    return fetch_window_depth(default=4)
+
+
+def _overlapped_reduce(
+    store, part_refs, counts, reduce_index, epoch, seed, prof
+):
+    """Reduce-side fetch/gather overlap: prefetch mapper-partition
+    windows N+1..N+depth over DCN while scattering window N into the
+    output segment.
+
+    The fused ``concat_take`` needs every partition materialized before
+    the first gathered byte, so on a cluster the reduce used to sit idle
+    for the whole serial window-fetch tail. Here the permutation is
+    inverted once (``inv[perm] = arange``) so each window's destination
+    rows are a contiguous slice of ``inv`` — window ``i``'s rows land at
+    ``out[inv[off_i:off_i+1]]`` — and windows are consumed in arrival
+    order of the pipeline while later fetches proceed on the store's
+    prefetch threads. Output is bit-identical to the fused path
+    (``out[j] = concat[perm[j]]`` both ways; tested). Read-ahead is a
+    true sliding window: window ``i + depth`` is submitted only when
+    window ``i`` is consumed (and each consumed window's cache dropped
+    immediately), so peak fetched residency stays O(depth) windows — a
+    bulk prefetch of the whole ref list would only cap fetch
+    CONCURRENCY, and completed fetches would pile up to the full
+    reducer input whenever DCN outpaces the gather.
+    """
+    depth = _fetch_window_depth()
+    store.prefetch(part_refs[:depth], max_parallel=depth)
+    dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=dst_off[1:])
+    total = int(dst_off[-1])
+    with prof.phase("permute", nbytes=8 * total):
+        rng = _reduce_seed(seed, epoch, reduce_index)
+        perm = rng.permutation(total)
+        inv = np.empty(total, dtype=np.int64)
+        inv[perm] = np.arange(total, dtype=np.int64)
+    pending = None
+    try:
+        for i, ref in enumerate(part_refs):
+            if i + depth < len(part_refs):
+                # Slide the read-ahead window: one new fetch per
+                # consumed window keeps in-flight + landed-unconsumed
+                # bounded by ``depth``.
+                store.prefetch([part_refs[i + depth]])
+            with prof.phase("window-fetch") as ph:
+                part = store.get_columns(ref)
+                ph.add_bytes(part.nbytes)
+            if pending is None:
+                pending = store.create_columns(
+                    {
+                        k: ((total, *part[k].shape[1:]), part[k].dtype)
+                        for k in part
+                    }
+                )
+            lo, hi = int(dst_off[i]), int(dst_off[i + 1])
+            if hi > lo:
+                with prof.phase("gather", nbytes=2 * part.nbytes):
+                    dest = inv[lo:hi]
+                    for k, dst in pending.columns.items():
+                        dst[dest] = part[k]
+            del part
+            # This window is consumed; dropping its fetched copy now
+            # bounds peak local residency at ~depth windows (drop_cache
+            # no-ops for local refs; the authoritative copy survives, so
+            # the task stays retryable).
+            store.drop_cache([ref])
+        if pending is None:
+            pending = store.create_columns({})
+        if _audit.enabled():
+            _audit.record_reduce(epoch, reduce_index, pending.columns)
+        with prof.phase("publish"):
+            out_ref = pending.seal()
+    finally:
+        if pending is not None:
+            pending.abort()  # reclaims on failure; no-op after seal
+    return out_ref, total
+
+
 def shuffle_reduce(
     reduce_index: int,
     epoch: int,
@@ -592,6 +727,12 @@ def shuffle_reduce(
 
     Frees the consumed mapper partitions (the Ray build gets this from
     distributed ref-counting GC).
+
+    Cluster mode: when any input window lives on a remote host, the
+    fetch/gather pipeline overlaps — see :func:`_overlapped_reduce`
+    (``RSDL_REDUCE_FETCH_OVERLAP=auto|on|off``; ``auto`` engages only
+    when a DCN fetch actually exists, so the single-host path keeps the
+    fused native concat-take untouched).
     """
     if _faults.enabled():
         _faults.fire("task.reduce", epoch=epoch, point="entry")
@@ -600,32 +741,70 @@ def shuffle_reduce(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    prof = _phases.stage_profiler(
+        "reduce", epoch=epoch, reducer=reduce_index
+    )
     parts: List[ColumnBatch] = []
     try:
-        parts = [ctx.store.get_columns(r) for r in part_refs]
-        total_rows = sum(p.num_rows for p in parts)
-        rng = _reduce_seed(seed, epoch, reduce_index)
-        perm = rng.permutation(total_rows)
-        # Fused concat+permute straight out of the mmapped partitions INTO
-        # the output segment — this stage's only full data pass
-        # (put_columns copy-out eliminated).
-        template = parts[0] if parts else None
-        pending = ctx.store.create_columns(
-            {
-                k: ((total_rows, *template[k].shape[1:]), template[k].dtype)
-                for k in (template or {})
-            }
+        store = ctx.store
+        counts = [_ref_window_rows(r) for r in part_refs]
+        mode = os.environ.get(
+            "RSDL_REDUCE_FETCH_OVERLAP", "auto"
+        ).strip().lower()
+        overlap = (
+            mode not in ("off", "0", "false")
+            and all(c is not None for c in counts)
+            and (
+                mode in ("on", "1", "true")
+                # Auto engages only when a window would ACTUALLY ride
+                # DCN right now — already-cached windows (a retried
+                # reduce's first-attempt fetches) have no latency to
+                # hide, and the fused native gather is faster.
+                or any(store.needs_fetch(r) for r in part_refs)
+            )
         )
-        try:
-            ColumnBatch.concat_take(parts, perm, out=pending.columns)
-            if _audit.enabled():
-                # Reduce-side digest of the permuted output, while the
-                # writable views are still alive.
-                _audit.record_reduce(epoch, reduce_index, pending.columns)
-            out_ref = pending.seal()
-        finally:
-            pending.abort()  # reclaims the segment on failure; no-op on seal
-        del pending
+        if overlap:
+            out_ref, total_rows = _overlapped_reduce(
+                store, part_refs, counts, reduce_index, epoch, seed, prof
+            )
+        else:
+            with prof.phase("window-fetch") as ph:
+                parts = [store.get_columns(r) for r in part_refs]
+                ph.add_bytes(sum(p.nbytes for p in parts))
+            total_rows = sum(p.num_rows for p in parts)
+            with prof.phase("permute", nbytes=8 * total_rows):
+                rng = _reduce_seed(seed, epoch, reduce_index)
+                perm = rng.permutation(total_rows)
+            # Fused concat+permute straight out of the mmapped partitions
+            # INTO the output segment — this stage's only full data pass
+            # (put_columns copy-out eliminated).
+            template = parts[0] if parts else None
+            pending = ctx.store.create_columns(
+                {
+                    k: (
+                        (total_rows, *template[k].shape[1:]),
+                        template[k].dtype,
+                    )
+                    for k in (template or {})
+                }
+            )
+            try:
+                with prof.phase("gather") as ph:
+                    ColumnBatch.concat_take(parts, perm, out=pending.columns)
+                    ph.add_bytes(
+                        2 * sum(v.nbytes for v in pending.columns.values())
+                    )
+                if _audit.enabled():
+                    # Reduce-side digest of the permuted output, while the
+                    # writable views are still alive.
+                    _audit.record_reduce(
+                        epoch, reduce_index, pending.columns
+                    )
+                with prof.phase("publish"):
+                    out_ref = pending.seal()
+            finally:
+                pending.abort()  # reclaims on failure; no-op on seal
+            del pending
     finally:
         # Input partitions are NOT freed here — the driver frees them after
         # the result lands (shuffle_epoch), which keeps this task retryable

@@ -41,7 +41,11 @@ if [ "$tier" != "slow" ]; then
   # worker (2 workers) and 2 resets per driver process can never
   # exhaust a 3-attempt retry budget, so no probabilistic flake mode
   # exists regardless of task placement.
+  # RSDL_TCP_ZEROCOPY rides along so recovery is proven over the
+  # vectored-framing transport path too (ISSUE 5), not just the legacy
+  # pickle frames.
   RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
+    RSDL_TCP_ZEROCOPY=1 \
     RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1,transport.send/driver:reset:0.02x2" \
     RSDL_FAULTS_SEED=1234 \
     python -m pytest tests/test_chaos.py tests/test_shuffle.py \
@@ -71,6 +75,15 @@ if [ "$tier" != "slow" ]; then
     echo "epoch_report failed to flag the injected regression" >&2
     exit 1
   fi
+  # TCP-plane lane (ISSUE 5): the two-process loopback "two-host" bench
+  # at a small shape — a worker host joins over real TCP (own shm dir),
+  # the windowed-fetch microbench runs both framings (legacy pickle +
+  # RSDL_TCP_ZEROCOPY vectored), and the end-to-end two-host shuffle
+  # must reconcile exactly-once over the wire (the bench exits non-zero
+  # on any error OR an audit mismatch, so the exit code IS the gate).
+  RSDL_BENCH_TCP_WINDOWS=12 RSDL_BENCH_TCP_WINDOW_MB=1 \
+    RSDL_BENCH_TCP_SHUFFLE_GB=0.02 \
+    python bench.py --plane tcp > /dev/null
 fi
 if [ "$tier" != "fast" ]; then
   python -m pytest tests/ -m slow -v --durations=10 || rc=$?

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Shuffle hot-path phase microbench: where a map/reduce epoch spends
+its time, against this host's memory-bandwidth roofline.
+
+Runs the real shuffle pipeline (pool workers, store, both schedules) at
+a configurable shape with the per-op phase profiler on
+(``telemetry/phases.py``), folds the worker-spooled metrics with
+``telemetry.export.aggregate``, and prints:
+
+* a **phase-cost table** — per ``(stage, phase)``: task count, total
+  seconds, mean, bytes moved, and effective GB/s;
+* a **roofline estimate** — this host's measured single-core memcpy
+  bandwidth plus the gather/copy microprobe figures the schedule policy
+  uses (``shuffle._probed_host_costs``), and each data-moving phase's
+  bandwidth as a fraction of the copy roofline;
+* the **schedule auto-policy verdict** for the shape (decode cache +
+  index schedule), with its model terms — so a wrong decline at any
+  shape is visible next to the measured phase costs that refute or
+  confirm it.
+
+Usage::
+
+    python tools/shuffle_profile.py --gb 0.5 --files 8 --reducers 8 \
+        --epochs 3 [--narrow] [--schedule auto|index|mapreduce] \
+        [--out profile.json]
+
+The VERDICT r5 evidence hole this exists for: "nobody has profiled
+where the 7.7 s-average reduce task spends its time" — see BENCHLOG for
+the committed tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+# Profiler + worker spools must be armed BEFORE the runtime (and its
+# worker pool) come up, so every spawned process inherits the env.
+os.environ.setdefault("RSDL_METRICS", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_KEY_RE = re.compile(
+    r"^shuffle\.phase_(seconds|bytes)\{phase=(?P<phase>[^,}]+),"
+    r"stage=(?P<stage>[^,}]+)\}(?P<suffix>_count|_sum|_min|_max)?$"
+)
+
+
+class _DrainConsumer:
+    """Counts + frees delivered reducer outputs (keeps the driver's store
+    residency flat so the measured phases are the stage tasks, not an
+    unbounded consumer backlog)."""
+
+    def __init__(self):
+        self.rows = 0
+        self.nbytes = 0
+
+    def consume(self, rank, epoch, batches):
+        from ray_shuffling_data_loader_tpu import runtime
+
+        store = runtime.get_context().store
+        for ref in batches:
+            cb = store.get_columns(ref)
+            self.rows += cb.num_rows
+            self.nbytes += cb.nbytes
+            del cb
+            store.free(ref)
+
+    def producer_done(self, rank, epoch):
+        pass
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+def _memcpy_gbps(nbytes: int = 256 << 20, repeats: int = 3) -> float:
+    """Measured single-core host memcpy bandwidth (the roofline a
+    sequential data-moving phase cannot beat): best of ``repeats`` timed
+    ``np.copyto`` passes over an ``nbytes`` buffer, counted as read+write
+    traffic."""
+    src = np.arange(nbytes // 8, dtype=np.int64)  # defeat COW zero-pages
+    dst = np.empty_like(src)
+    np.copyto(dst, src)  # warm
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        best = max(best, 2 * src.nbytes / max(dt, 1e-9))
+    return best / 1e9
+
+
+def _phase_table(flat: dict) -> dict:
+    """``{(stage, phase): {count, total_s, bytes}}`` from an aggregated
+    flat snapshot."""
+    table: dict = {}
+    for key, value in flat.items():
+        m = _KEY_RE.match(key)
+        if not m:
+            continue
+        entry = table.setdefault(
+            (m.group("stage"), m.group("phase")),
+            {"count": 0, "total_s": 0.0, "bytes": 0.0},
+        )
+        kind, suffix = m.group(1), m.group("suffix")
+        if kind == "seconds" and suffix == "_count":
+            entry["count"] = int(value)
+        elif kind == "seconds" and suffix == "_sum":
+            entry["total_s"] = float(value)
+        elif kind == "bytes" and not suffix:
+            entry["bytes"] = float(value)
+    return table
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--gb", type=float, default=0.5)
+    parser.add_argument("--files", type=int, default=8)
+    parser.add_argument("--reducers", type=int, default=8)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--trainers", type=int, default=1)
+    parser.add_argument("--narrow", action="store_true")
+    parser.add_argument(
+        "--schedule",
+        choices=("auto", "index", "mapreduce"),
+        default="auto",
+        help="force the steady-state schedule (sets RSDL_INDEX_SHUFFLE)",
+    )
+    parser.add_argument(
+        "--data-dir",
+        default=None,
+        help="dataset cache dir (default: .bench_cache/profile_* shape key)",
+    )
+    parser.add_argument("--out", default=None, help="also dump JSON here")
+    args = parser.parse_args()
+
+    if args.schedule != "auto":
+        os.environ["RSDL_INDEX_SHUFFLE"] = (
+            "on" if args.schedule == "index" else "off"
+        )
+
+    import importlib
+
+    # The package re-exports shuffle() the FUNCTION under the same name as
+    # the module; resolve the module explicitly.
+    shuffle_mod = importlib.import_module(
+        "ray_shuffling_data_loader_tpu.shuffle"
+    )
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        cached_generate_data,
+    )
+    from ray_shuffling_data_loader_tpu.telemetry import export as _export
+
+    bytes_per_row = 168  # DATA_SPEC
+    num_rows = max(1000, int(args.gb * 1e9) // bytes_per_row)
+    data_dir = args.data_dir or os.path.join(
+        _REPO, ".bench_cache", f"profile_r{num_rows}_f{args.files}"
+    )
+    os.makedirs(data_dir, exist_ok=True)
+    filenames, dataset_bytes = cached_generate_data(
+        num_rows, args.files, 2, data_dir, seed=0
+    )
+    print(
+        f"[profile] dataset {dataset_bytes / 1e9:.2f} GB on disk, "
+        f"{num_rows} rows x {args.files} files",
+        file=sys.stderr,
+    )
+
+    runtime.init(num_workers=max(2, os.cpu_count() or 1))
+    consumer = _DrainConsumer()
+    schedule_log: list = []
+    t0 = time.perf_counter()
+    shuffle_mod.shuffle(
+        list(filenames),
+        consumer,
+        num_epochs=args.epochs,
+        num_reducers=args.reducers,
+        num_trainers=args.trainers,
+        seed=0,
+        narrow_to_32=args.narrow,
+        schedule_log=schedule_log,
+    )
+    wall_s = time.perf_counter() - t0
+
+    flat = _export.aggregate()
+    table = _phase_table(flat)
+    copy_gbps = _memcpy_gbps()
+    probed = shuffle_mod._probed_host_costs()
+
+    # Schedule-policy verdicts for this shape, with the model's terms.
+    est_cache = None
+    try:
+        est_cache = shuffle_mod._est_decoded_bytes(
+            list(filenames), args.narrow
+        )
+    except OSError:
+        pass
+    cache_auto = shuffle_mod._decode_cache_auto(
+        list(filenames), args.epochs, args.narrow
+    )
+    index_auto = shuffle_mod._index_schedule_allowed(
+        list(filenames), args.reducers, args.narrow
+    )
+    policy = {
+        "est_decoded_bytes": est_cache,
+        "decode_cache_auto": bool(cache_auto),
+        "index_schedule_auto": bool(index_auto),
+        "probed_costs": {k: float(v) for k, v in probed.items()},
+        "gather_bw_at_cache": (
+            shuffle_mod._gather_bw_for(est_cache) if est_cache else None
+        ),
+        "schedules_run": [s for _, s in schedule_log],
+    }
+
+    rows = []
+    order = sorted(table, key=lambda sp: -table[sp]["total_s"])
+    print()
+    print(
+        f"{'stage':<14} {'phase':<18} {'n':>5} {'total s':>9} "
+        f"{'mean s':>8} {'GB':>8} {'GB/s':>7} {'%roofline':>9}"
+    )
+    for stage, phase in order:
+        e = table[(stage, phase)]
+        gb = e["bytes"] / 1e9
+        gbps = gb / e["total_s"] if e["total_s"] > 0 else 0.0
+        frac = 100.0 * gbps / copy_gbps if copy_gbps else 0.0
+        mean = e["total_s"] / e["count"] if e["count"] else 0.0
+        print(
+            f"{stage:<14} {phase:<18} {e['count']:>5d} "
+            f"{e['total_s']:>9.2f} {mean:>8.3f} {gb:>8.2f} "
+            f"{gbps:>7.2f} {frac:>8.1f}%"
+        )
+        rows.append(
+            {
+                "stage": stage,
+                "phase": phase,
+                "count": e["count"],
+                "total_s": round(e["total_s"], 3),
+                "mean_s": round(mean, 4),
+                "gb": round(gb, 3),
+                "gbps": round(gbps, 3),
+                "roofline_frac": round(gbps / copy_gbps, 4)
+                if copy_gbps
+                else None,
+            }
+        )
+    phase_total = sum(e["total_s"] for e in table.values())
+    print(
+        f"\n[profile] wall {wall_s:.1f}s; phase-accounted task time "
+        f"{phase_total:.1f}s across all workers; delivered "
+        f"{consumer.nbytes / 1e9:.2f} GB ({consumer.rows} rows); "
+        f"pipeline {consumer.nbytes / 1e9 / wall_s:.3f} GB/s"
+    )
+    print(
+        f"[profile] roofline: single-core memcpy {copy_gbps:.2f} GB/s "
+        f"(r+w); probe copy {probed['copy'] / 1e9:.2f}, gather "
+        f"{probed['gather_small'] / 1e9:.2f} (cache-res) / "
+        f"{probed['gather_large'] / 1e9:.2f} (DRAM) GB/s, store "
+        f"round-trip {probed['roundtrip'] * 1e3:.2f} ms"
+    )
+    print(f"[profile] schedule policy: {json.dumps(policy)}")
+
+    result = {
+        "shape": {
+            "gb": args.gb,
+            "files": args.files,
+            "reducers": args.reducers,
+            "epochs": args.epochs,
+            "narrow": bool(args.narrow),
+            "schedule_arg": args.schedule,
+        },
+        "wall_s": round(wall_s, 2),
+        "pipeline_gbps": round(consumer.nbytes / 1e9 / wall_s, 4),
+        "delivered_gb": round(consumer.nbytes / 1e9, 3),
+        "memcpy_roofline_gbps": round(copy_gbps, 3),
+        "phases": rows,
+        "policy": policy,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[profile] wrote {args.out}", file=sys.stderr)
+    runtime.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
