@@ -1384,8 +1384,19 @@ def run_tcp_plane_bench() -> dict:
     # Worker-host processes fix their env at spawn: arm the zero-copy
     # plane cluster-wide NOW so the shuffle leg's remote reducers ride
     # it; the windowed-fetch microbench below toggles the DRIVER's gate
-    # per plane (the client side chooses the framing).
+    # per plane (the client side chooses the framing). Striping
+    # (RSDL_TCP_STREAMS) rides the same spawn-time env so the shuffle
+    # leg's worker-side fetches stripe too.
     os.environ["RSDL_TCP_ZEROCOPY"] = "1"
+    # Default 2: stream count should track cores devoted to recv — on
+    # this 2-core host more streams just oversubscribe (BENCHLOG r7).
+    # Clamped to the transport's own [1, 16] range so the JSON records
+    # the stream count that actually ran (an uncapped env value would be
+    # silently re-clamped inside transport.tcp_streams()).
+    streams = min(
+        16, max(1, int(os.environ.get("RSDL_BENCH_TCP_STREAMS", "2")))
+    )
+    os.environ["RSDL_TCP_STREAMS"] = str(streams)
 
     worker_shm = _tempfile.mkdtemp(prefix="rsdl-tcpbench-shm-")
     worker_spill = _tempfile.mkdtemp(prefix="rsdl-tcpbench-spill-")
@@ -1467,10 +1478,47 @@ def run_tcp_plane_bench() -> dict:
         os.environ.pop("RSDL_TCP_ZEROCOPY", None)
         transport.refresh_zerocopy_from_env()
         pickle_gbps, pickle_lat = _timed_tcp_fetch()
-        # Plane 2: zero-copy vectored framing.
+        # Plane 2: zero-copy vectored framing, single stream.
         os.environ["RSDL_TCP_ZEROCOPY"] = "1"
+        os.environ["RSDL_TCP_STREAMS"] = "1"
         transport.refresh_zerocopy_from_env()
+        transport.refresh_tcp_streams_from_env()
         zc_gbps, zc_lat = _timed_tcp_fetch()
+        # Plane 3: zero-copy striped over RSDL_TCP_STREAMS persistent
+        # connections — each window's payload split by byte range with
+        # parallel recv_into disjoint regions of one mmapped cache file
+        # (the single-stream framing + single-core recv gap, ROADMAP 2).
+        os.environ["RSDL_TCP_STREAMS"] = str(streams)
+        transport.refresh_tcp_streams_from_env()
+        striped_gbps, striped_lat = _timed_tcp_fetch()
+
+        def _timed_pipelined_fetch(depth: int = 8):
+            """Windowed fetch the way the reduce plane actually runs it:
+            ``store.prefetch`` keeps ``depth`` windows in flight, so
+            per-window costs (cache-file lifecycle, recv, server send)
+            overlap across windows instead of serializing — the
+            DELIVERED fetch bandwidth, vs the serial loop's per-window
+            latency view."""
+            t0 = time.perf_counter()
+            futs = store.prefetch(refs, max_parallel=depth)
+            if not futs:  # nothing was foreign/uncached: no real measure
+                return None
+            for f in futs:
+                f.result()
+            dt = time.perf_counter() - t0
+            missing = [r for r in refs if store._find_cache(r) is None]
+            store.drop_cache(refs)
+            if missing:  # a swallowed prefetch failure: don't fake a number
+                return None
+            return total_bytes / 1e9 / dt
+
+        # Pipelined rows, both framings (same windows, prefetch depth 8).
+        os.environ["RSDL_TCP_STREAMS"] = "1"
+        transport.refresh_tcp_streams_from_env()
+        zc_pipe_gbps = _timed_pipelined_fetch()
+        os.environ["RSDL_TCP_STREAMS"] = str(streams)
+        transport.refresh_tcp_streams_from_env()
+        striped_pipe_gbps = _timed_pipelined_fetch()
 
         # Baseline: the same windows living in LOCAL shm, reading every
         # byte (the mmap is lazy; the sum forces the pages).
@@ -1521,11 +1569,20 @@ def run_tcp_plane_bench() -> dict:
             "shm_gbps": round(shm_gbps, 3),
             "tcp_pickle_gbps": round(pickle_gbps, 3),
             "tcp_zerocopy_gbps": round(zc_gbps, 3),
+            "tcp_zerocopy_striped_gbps": round(striped_gbps, 3),
+            "tcp_zerocopy_pipelined_gbps": (
+                round(zc_pipe_gbps, 3) if zc_pipe_gbps else None
+            ),
+            "tcp_zerocopy_striped_pipelined_gbps": (
+                round(striped_pipe_gbps, 3) if striped_pipe_gbps else None
+            ),
+            "tcp_streams": streams,
             "raw_loopback_gbps": round(raw_gbps, 3),
             "window_ms": {
                 "shm": _lat_stats(shm_lat),
                 "tcp_pickle": _lat_stats(pickle_lat),
                 "tcp_zerocopy": _lat_stats(zc_lat),
+                "tcp_zerocopy_striped": _lat_stats(striped_lat),
             },
             "hmac_handshake_ms": round(hmac_ms, 3),
             # Framing+pickle+copy overhead vs the raw socket ceiling,
@@ -1534,6 +1591,9 @@ def run_tcp_plane_bench() -> dict:
             "overhead_vs_raw_pct": {
                 "tcp_pickle": round(100 * (1 - pickle_gbps / raw_gbps), 1),
                 "tcp_zerocopy": round(100 * (1 - zc_gbps / raw_gbps), 1),
+                "tcp_zerocopy_striped": round(
+                    100 * (1 - striped_gbps / raw_gbps), 1
+                ),
             },
         }
 
@@ -1615,6 +1675,7 @@ def run_tcp_plane_bench() -> dict:
             "gbps": round(shuffle_gbps, 4),
             "audit_ok": audit_ok,
             "zerocopy": True,
+            "tcp_streams": streams,
             "served_cross_host": served,
             "schedules": [s for _, s in schedule_log],
         }

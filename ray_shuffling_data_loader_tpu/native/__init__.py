@@ -40,8 +40,49 @@ _lib = None
 _lib_lock = threading.Lock()
 _load_attempted = False
 
-# Gathers are memory-bound; a handful of threads saturates DRAM.
-_NUM_THREADS = max(1, min(8, (os.cpu_count() or 1)))
+ENV_THREADS = "RSDL_NATIVE_THREADS"
+
+
+def _threads_from_env() -> int:
+    """Kernel thread count: ``RSDL_NATIVE_THREADS`` when set (clamped
+    ≥ 1), else the old heuristic — gathers are memory-bound, so a
+    handful of threads saturates DRAM and more just adds spawn cost."""
+    env = os.environ.get(ENV_THREADS, "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(8, (os.cpu_count() or 1)))
+
+
+# Read once at import (the knob is a process-level setting, like the
+# telemetry gates); tools that sweep thread counts pass n_threads= per
+# call instead of mutating the env.
+_NUM_THREADS = _threads_from_env()
+
+
+def num_threads() -> int:
+    """The resolved default kernel thread count (``RSDL_NATIVE_THREADS``)."""
+    return _NUM_THREADS
+
+
+def refresh_threads_from_env() -> None:
+    """Re-read ``RSDL_NATIVE_THREADS`` (tests)."""
+    global _NUM_THREADS
+    _NUM_THREADS = _threads_from_env()
+
+
+def _resolve_threads(n_threads: Optional[int]) -> int:
+    return _NUM_THREADS if n_threads is None else max(1, int(n_threads))
+
+
+# Thread-slice floor shared with the C side's parallel_for cap: one
+# thread per ~524k rows. Below ~1 ms of per-slice work the std::thread
+# spawn cost dominates and threading is a measured LOSS (the r7 sweep at
+# 372k rows ran 0.6-0.9x serial uncapped); the parallel group scatter
+# engages only when at least two such slices exist.
+_MIN_ROWS_PER_THREAD = 1 << 19
 
 
 def _build_lib() -> Optional[str]:
@@ -92,13 +133,20 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     c_i64 = ctypes.c_int64
     c_int = ctypes.c_int
     p = ctypes.c_void_p
-    lib.rsdl_take.argtypes = [p, p, p, c_i64, c_i64, c_int]
+    lib.rsdl_take.argtypes = [p, p, p, c_i64, c_i64, c_i64, c_int]
+    lib.rsdl_take.restype = c_int
     lib.rsdl_take_multi.argtypes = [p, p, c_i64, p, p, c_i64, c_i64, c_int]
     lib.rsdl_cast_i64_i32.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.restype = c_int
     lib.rsdl_cast_f64_f32.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_group_rows.argtypes = [p, p, p, c_i64, c_i64, p]
+    lib.rsdl_scatter.argtypes = [p, p, p, c_i64, c_i64, c_i64, c_int]
+    lib.rsdl_scatter.restype = c_int
+    lib.rsdl_group_plan.argtypes = [p, c_i64, c_i64, c_int, p, p]
+    lib.rsdl_group_rows_multi_mt.argtypes = [
+        p, p, p, c_i64, p, c_i64, p, c_int, c_i64
+    ]
     lib.rsdl_abi_version.restype = c_int
     return lib
 
@@ -122,7 +170,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             if candidate and os.path.exists(candidate):
                 try:
                     lib = _declare(ctypes.CDLL(candidate))
-                    if lib.rsdl_abi_version() == 3:
+                    if lib.rsdl_abi_version() == 4:
                         _lib = lib
                         break
                 except (OSError, AttributeError):
@@ -185,32 +233,115 @@ def _out_ok(out: Optional[np.ndarray], shape, dtype) -> bool:
 
 
 def take(
-    arr: np.ndarray, idx: np.ndarray, out: Optional[np.ndarray] = None
+    arr: np.ndarray,
+    idx: np.ndarray,
+    out: Optional[np.ndarray] = None,
+    n_threads: Optional[int] = None,
 ) -> np.ndarray:
     """``arr[idx]`` along axis 0 (multi-threaded when native is loaded).
 
     ``out``: pre-allocated destination (e.g. a writable store-segment view
     from ``ObjectStore.create_columns``) — the gather lands directly in
-    shared memory, skipping the copy-out a fresh array would need."""
+    shared memory, skipping the copy-out a fresh array would need.
+    ``n_threads`` overrides the ``RSDL_NATIVE_THREADS`` default.
+
+    Bounds are checked INSIDE the kernel (free per row): the old Python
+    ``idx.min()/idx.max()`` pre-scan cost two full single-threaded
+    passes per call, a fixed term that measurably capped multi-core
+    scaling. The rare failure (out-of-range raises, negative indices
+    fall back) re-derives exact numpy semantics off the hot path."""
     lib = _get_lib()
     row_bytes = _rows_contig(arr)
-    shape = (len(np.asarray(idx)), *arr.shape[1:])
+    idx_arr = np.asarray(idx)
+    shape = (len(idx_arr), *arr.shape[1:])
+    if (
+        lib is not None
+        and row_bytes is not None
+        and arr.size != 0
+        and len(idx_arr) != 0
+        and np.issubdtype(idx_arr.dtype, np.integer)
+    ):
+        idx_c = np.ascontiguousarray(idx_arr, dtype=np.int64)
+        if not _out_ok(out, shape, arr.dtype):
+            out = np.empty(shape, dtype=arr.dtype)
+        rc = lib.rsdl_take(
+            _ptr(arr), _ptr(out), _ptr(idx_c), len(idx_c), row_bytes,
+            len(arr), _resolve_threads(n_threads),
+        )
+        if rc == 0:
+            return out
+        try:
+            _check_bounds(idx_arr, len(arr))  # IndexError if truly OOB
+        except IndexError:
+            # The kernel may have partially written ``out`` before the
+            # bad index was hit; restore the fresh-segment invariant
+            # (direct-to-store destinations start zeroed) before
+            # surfacing the error — error-path only, never a hot cost.
+            out[...] = 0
+            raise
+        np.take(arr, idx_arr, axis=0, out=out)  # negative-index semantics
+        return out
+    if _out_ok(out, shape, arr.dtype):
+        np.take(arr, idx_arr, axis=0, out=out)
+        return out
+    return arr[idx]
+
+
+def scatter(
+    src: np.ndarray,
+    idx: np.ndarray,
+    out: np.ndarray,
+    n_threads: Optional[int] = None,
+) -> np.ndarray:
+    """``out[idx] = src`` along axis 0 — the write-side inverse of
+    :func:`take`, multi-threaded when native is loaded.
+
+    The overlapped reduce's hot op: each arriving partition window lands
+    at its permuted output rows (``idx`` = a slice of the inverted epoch
+    permutation) while later windows are still in flight over DCN — the
+    C call releases the GIL, so the scatter uses every core concurrently
+    with the prefetch threads' socket reads.
+
+    ``idx`` values must be UNIQUE (permutation-derived): numpy resolves
+    duplicate destinations last-write-wins, but across kernel threads
+    the winner would be racy — callers with possibly-duplicated indices
+    must use the numpy assignment directly. Non-integer / negative /
+    out-of-range indices fall back to (or raise like) numpy; on the
+    out-of-range raise, already-scattered rows of ``out`` keep their
+    new values (``out`` accumulates across calls in the overlapped
+    reduce, so "restore" has no meaning here — the failing task aborts
+    its pending segment instead)."""
+    src = np.asarray(src)
+    idx_arr = np.asarray(idx)
+    if len(src) != len(idx_arr):
+        raise ValueError(
+            f"scatter length mismatch: {len(src)} rows vs {len(idx_arr)} "
+            "indices"
+        )
+    lib = _get_lib()
+    row_bytes = _rows_contig(src)
     if (
         lib is None
         or row_bytes is None
-        or arr.size == 0
-        or not _check_bounds(np.asarray(idx), len(arr))
+        or row_bytes != _rows_contig(out)
+        or src.dtype != out.dtype
+        or src.shape[1:] != out.shape[1:]
+        or not out.flags.writeable
+        or src.size == 0
+        or not np.issubdtype(idx_arr.dtype, np.integer)
     ):
-        if _out_ok(out, shape, arr.dtype):
-            np.take(arr, np.asarray(idx), axis=0, out=out)
-            return out
-        return arr[idx]
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
-    if not _out_ok(out, shape, arr.dtype):
-        out = np.empty(shape, dtype=arr.dtype)
-    lib.rsdl_take(
-        _ptr(arr), _ptr(out), _ptr(idx), len(idx), row_bytes, _NUM_THREADS
+        out[idx_arr] = src
+        return out
+    idx_c = np.ascontiguousarray(idx_arr, dtype=np.int64)
+    rc = lib.rsdl_scatter(
+        _ptr(src), _ptr(out), _ptr(idx_c), len(idx_c), row_bytes,
+        len(out), _resolve_threads(n_threads),
     )
+    if rc != 0:
+        # Out-of-range raises (like numpy); negative indices fall back
+        # to numpy's wraparound semantics — both off the hot path.
+        _check_bounds(idx_arr, len(out))
+        out[idx_arr] = src
     return out
 
 
@@ -243,6 +374,7 @@ def take_multi(
     parts: Sequence[np.ndarray],
     idx: np.ndarray,
     out: Optional[np.ndarray] = None,
+    n_threads: Optional[int] = None,
 ) -> np.ndarray:
     """``np.concatenate(parts)[idx]`` without materializing the concat.
 
@@ -283,18 +415,19 @@ def take_multi(
     sparse = (
         compat and len(parts) > 1 and in_bounds and 2 * len(idx_arr) < total
     )
+    threads = _resolve_threads(n_threads)
     if (
         lib is None
         or row_bytes is None
         or not same
         or len(parts) == 1
-        or (_NUM_THREADS < 4 and not sparse)
+        or (threads < 4 and not sparse)
         or not in_bounds
     ):
         if sparse:
             return _take_multi_sparse(parts, idx_arr, out)
         base = parts[0] if len(parts) == 1 else np.concatenate(parts)
-        return take(base, idx, out=out)
+        return take(base, idx, out=out, n_threads=n_threads)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
     offsets = np.zeros(len(parts) + 1, dtype=np.int64)
     np.cumsum([len(p) for p in parts], out=offsets[1:])
@@ -306,12 +439,14 @@ def take_multi(
     # internally (the old separate take_multi8 entry point is gone).
     lib.rsdl_take_multi(
         ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
-        len(idx), row_bytes, _NUM_THREADS,
+        len(idx), row_bytes, threads,
     )
     return out
 
 
-def narrow_i64_checked(arr: np.ndarray) -> Optional[np.ndarray]:
+def narrow_i64_checked(
+    arr: np.ndarray, n_threads: Optional[int] = None
+) -> Optional[np.ndarray]:
     """Range-checked ``int64 -> int32`` in ONE fused pass (the numpy route
     costs three: max scan, min scan, astype). Returns the int32 array, or
     None when any value falls outside int32 range — the caller decides how
@@ -324,7 +459,7 @@ def narrow_i64_checked(arr: np.ndarray) -> Optional[np.ndarray]:
     if lib is not None and arr.flags.c_contiguous and arr.size:
         out = np.empty(arr.shape, dtype=np.int32)
         ok = lib.rsdl_cast_i64_i32_checked(
-            _ptr(arr), _ptr(out), arr.size, _NUM_THREADS
+            _ptr(arr), _ptr(out), arr.size, _resolve_threads(n_threads)
         )
         return out if ok else None
     if arr.size and (
@@ -334,25 +469,33 @@ def narrow_i64_checked(arr: np.ndarray) -> Optional[np.ndarray]:
     return arr.astype(np.int32)
 
 
-def narrow(arr: np.ndarray, dtype) -> np.ndarray:
+def narrow(
+    arr: np.ndarray, dtype, n_threads: Optional[int] = None
+) -> np.ndarray:
     """``arr.astype(dtype)`` with fast paths for the staging casts
     (int64→int32, float64→float32)."""
     dtype = np.dtype(dtype)
     if arr.dtype == dtype:
         return arr
     lib = _get_lib()
+    threads = _resolve_threads(n_threads)
     if lib is not None and arr.flags.c_contiguous and arr.size:
         out = np.empty(arr.shape, dtype=dtype)
         if arr.dtype == np.int64 and dtype == np.int32:
-            lib.rsdl_cast_i64_i32(_ptr(arr), _ptr(out), arr.size, _NUM_THREADS)
+            lib.rsdl_cast_i64_i32(_ptr(arr), _ptr(out), arr.size, threads)
             return out
         if arr.dtype == np.float64 and dtype == np.float32:
-            lib.rsdl_cast_f64_f32(_ptr(arr), _ptr(out), arr.size, _NUM_THREADS)
+            lib.rsdl_cast_f64_f32(_ptr(arr), _ptr(out), arr.size, threads)
             return out
     return arr.astype(dtype)
 
 
-def group_rows(arr: np.ndarray, assignment: np.ndarray, num_groups: int):
+def group_rows(
+    arr: np.ndarray,
+    assignment: np.ndarray,
+    num_groups: int,
+    n_threads: Optional[int] = None,
+):
     """Stable partition of rows by ``assignment`` (the map-stage op).
 
     Returns ``(grouped, offsets)`` where ``grouped`` has ``arr``'s rows
@@ -360,7 +503,9 @@ def group_rows(arr: np.ndarray, assignment: np.ndarray, num_groups: int):
     preserving input order within a group. Single-pass counting scatter vs
     the argsort+gather equivalent.
     """
-    grouped, offsets = group_rows_multi({"": arr}, assignment, num_groups)
+    grouped, offsets = group_rows_multi(
+        {"": arr}, assignment, num_groups, n_threads=n_threads
+    )
     return grouped[""], offsets
 
 
@@ -369,10 +514,18 @@ def group_rows_multi(
     assignment: np.ndarray,
     num_groups: int,
     out: Optional[dict] = None,
+    n_threads: Optional[int] = None,
 ):
     """:func:`group_rows` over several equal-length columns sharing one
     assignment. The numpy fallback argsorts the assignment ONCE and gathers
     each column, matching the native path's per-column O(n) cost.
+
+    With ``n_threads > 1`` (the ``RSDL_NATIVE_THREADS`` default) and
+    enough rows, the scatter runs the two-pass parallel kernel: one
+    (thread, group) histogram + prefix-sum plan per batch, then an
+    independent typed scatter per contiguous input range — bit-identical
+    to the serial kernel because thread ranges are contiguous and the
+    plan orders their output spans by thread id (stability preserved).
 
     ``out``: dict of pre-allocated destinations per column (e.g. writable
     store-segment views) — the partition scatter writes shared memory
@@ -416,15 +569,48 @@ def group_rows_multi(
                 result[k] = v[order]
         return result, offsets
     assignment = np.ascontiguousarray(assignment, dtype=np.int32)
-    result = {}
+    n = len(assignment)
+    # Cap threads so every contiguous slice is worth its spawn (shared
+    # policy with the C parallel_for — see _MIN_ROWS_PER_THREAD).
+    threads = min(
+        _resolve_threads(n_threads), max(1, n // _MIN_ROWS_PER_THREAD)
+    )
+    dsts = {}
     for name, arr in columns.items():
-        cursors = offsets[:num_groups].copy()  # C kernel advances these
         dst = _dst(name, arr)
         if not _out_ok(dst, arr.shape, arr.dtype):
             dst = np.empty_like(arr)
-        lib.rsdl_group_rows(
-            _ptr(arr), _ptr(dst), _ptr(assignment), len(arr),
-            _rows_contig(arr), _ptr(cursors),
+        dsts[name] = dst
+    if threads > 1:
+        # Two-pass parallel stable scatter: ONE (thread, group) cursor
+        # plan for the batch, then one multi-column kernel call — threads
+        # spawn once and sweep every column over their input range.
+        plan = np.empty(threads * num_groups, dtype=np.int64)
+        group_starts = np.ascontiguousarray(offsets[:num_groups])
+        lib.rsdl_group_plan(
+            _ptr(assignment), n, num_groups, threads,
+            _ptr(group_starts), _ptr(plan),
         )
-        result[name] = dst
-    return result, offsets
+        arrs_list = list(columns.values())
+        dst_list = [dsts[name] for name in columns]
+        src_ptrs = (ctypes.c_void_p * len(arrs_list))(
+            *[a.ctypes.data for a in arrs_list]
+        )
+        dst_ptrs = (ctypes.c_void_p * len(dst_list))(
+            *[d.ctypes.data for d in dst_list]
+        )
+        itemsizes = np.array(
+            [_rows_contig(a) for a in arrs_list], dtype=np.int64
+        )
+        lib.rsdl_group_rows_multi_mt(
+            src_ptrs, dst_ptrs, _ptr(itemsizes), len(arrs_list),
+            _ptr(assignment), n, _ptr(plan), threads, num_groups,
+        )
+    else:
+        for name, arr in columns.items():
+            cursors = offsets[:num_groups].copy()  # C kernel advances these
+            lib.rsdl_group_rows(
+                _ptr(arr), _ptr(dsts[name]), _ptr(assignment), len(arr),
+                _rows_contig(arr), _ptr(cursors),
+            )
+    return dsts, offsets

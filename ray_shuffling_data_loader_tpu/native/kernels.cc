@@ -28,8 +28,14 @@
 namespace {
 
 // Run fn(begin, end) over [0, n) split across up to n_threads threads.
+// Threads are capped so each slice is worth a spawn: std::thread startup
+// is ~100 µs-class, and a sub-512k-row slice of a memory-bound loop
+// finishes in that order — threading it is a measured LOSS (the r7
+// sweep at 372k rows ran 0.6-0.9x serial before this cap).
 template <typename Fn>
 void parallel_for(int64_t n, int n_threads, Fn fn) {
+  int64_t max_useful = n >> 19;  // one thread per ~524k rows
+  if (max_useful < n_threads) n_threads = static_cast<int>(max_useful);
   if (n_threads <= 1 || n < (1 << 14)) {
     fn(0, n);
     return;
@@ -46,21 +52,42 @@ void parallel_for(int64_t n, int n_threads, Fn fn) {
   for (auto& th : threads) th.join();
 }
 
-// Typed gather: dst[i] = src[idx[i]], specialized per element width so the
-// inner loop is a plain indexed load/store instead of memcpy.
+// Typed gather: dst[i] = src[idx[i]], specialized per element width so
+// the inner loop is a plain indexed load/store instead of memcpy. Bounds
+// are checked INLINE against n_src (one well-predicted compare per row,
+// invisible next to the random-access load): the old Python-side
+// idx.min()/idx.max() pre-scan cost two full single-threaded passes
+// over the index array per call — a fixed cost that measurably diluted
+// the kernel's multi-core scaling (r7 sweep: 1.5x -> 2.0x at 2 threads
+// with the scan gone). On any out-of-range index the shared flag is
+// raised and every thread bails; the wrapper re-derives exact numpy
+// semantics (negative-index fallback / IndexError) off the hot path.
 template <typename T>
 void gather_typed(const T* src, T* dst, const int64_t* idx, int64_t n,
-                  int n_threads) {
+                  int64_t n_src, int n_threads, std::atomic<int>* err) {
   parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
-    for (int64_t i = begin; i < end; ++i) dst[i] = src[idx[i]];
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_src)) {
+        err->store(1, std::memory_order_relaxed);
+        return;
+      }
+      dst[i] = src[j];
+    }
   });
 }
 
 void gather_bytes(const uint8_t* src, uint8_t* dst, const int64_t* idx,
-                  int64_t n, int64_t itemsize, int n_threads) {
+                  int64_t n, int64_t itemsize, int64_t n_src, int n_threads,
+                  std::atomic<int>* err) {
   parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
     for (int64_t i = begin; i < end; ++i) {
-      std::memcpy(dst + i * itemsize, src + idx[i] * itemsize, itemsize);
+      int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_src)) {
+        err->store(1, std::memory_order_relaxed);
+        return;
+      }
+      std::memcpy(dst + i * itemsize, src + j * itemsize, itemsize);
     }
   });
 }
@@ -82,34 +109,75 @@ void take_multi_typed(const void** parts, const int64_t* row_offsets,
   });
 }
 
+// Typed scatter inner loop for rsdl_scatter (dst[idx[i]] = src[i]),
+// bounds-checked inline like gather_typed.
+template <typename T>
+void scatter_typed(const T* src, T* dst, const int64_t* idx, int64_t n,
+                   int64_t n_dst, int n_threads, std::atomic<int>* err) {
+  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_dst)) {
+        err->store(1, std::memory_order_relaxed);
+        return;
+      }
+      dst[j] = src[i];
+    }
+  });
+}
+
+// Thread-range decomposition shared by the group plan and scatter
+// passes; must be identical in both or cursors and ranges disagree.
+inline int64_t group_chunk(int64_t n, int n_threads) {
+  return (n + n_threads - 1) / n_threads;
+}
+
+// Typed per-range stable group scatter (pass 2 inner loop).
+template <typename T>
+void group_scatter_typed(const T* in, T* out, const int32_t* assignment,
+                         int64_t begin, int64_t end, int64_t* cur) {
+  for (int64_t i = begin; i < end; ++i) out[cur[assignment[i]]++] = in[i];
+}
+
 }  // namespace
 
 extern "C" {
 
-// dst[i] = src[idx[i]] for n rows of `itemsize` bytes each.
-void rsdl_take(const void* src, void* dst, const int64_t* idx, int64_t n,
-               int64_t itemsize, int n_threads) {
+// dst[i] = src[idx[i]] for n rows of `itemsize` bytes each; `n_src` is
+// the source row count for the inline bounds check. Returns 0, or 1 if
+// any index fell outside [0, n_src) — dst contents are then unspecified
+// and the caller must re-derive numpy semantics (raise / negative-index
+// fallback).
+int rsdl_take(const void* src, void* dst, const int64_t* idx, int64_t n,
+              int64_t itemsize, int64_t n_src, int n_threads) {
+  std::atomic<int> err{0};
   switch (itemsize) {
     case 1:
       gather_typed(static_cast<const uint8_t*>(src),
-                   static_cast<uint8_t*>(dst), idx, n, n_threads);
+                   static_cast<uint8_t*>(dst), idx, n, n_src, n_threads,
+                   &err);
       break;
     case 2:
       gather_typed(static_cast<const uint16_t*>(src),
-                   static_cast<uint16_t*>(dst), idx, n, n_threads);
+                   static_cast<uint16_t*>(dst), idx, n, n_src, n_threads,
+                   &err);
       break;
     case 4:
       gather_typed(static_cast<const uint32_t*>(src),
-                   static_cast<uint32_t*>(dst), idx, n, n_threads);
+                   static_cast<uint32_t*>(dst), idx, n, n_src, n_threads,
+                   &err);
       break;
     case 8:
       gather_typed(static_cast<const uint64_t*>(src),
-                   static_cast<uint64_t*>(dst), idx, n, n_threads);
+                   static_cast<uint64_t*>(dst), idx, n, n_src, n_threads,
+                   &err);
       break;
     default:
       gather_bytes(static_cast<const uint8_t*>(src),
-                   static_cast<uint8_t*>(dst), idx, n, itemsize, n_threads);
+                   static_cast<uint8_t*>(dst), idx, n, itemsize, n_src,
+                   n_threads, &err);
   }
+  return err.load();
 }
 
 // Fused concat + gather across parts: logical row j lives in part p where
@@ -196,6 +264,176 @@ int rsdl_cast_i64_i32_checked(const int64_t* src, int32_t* dst, int64_t n,
   return ok.load();
 }
 
+// Scatter: dst[idx[i]] = src[i] — the write-side inverse of rsdl_take.
+// The reduce stage's overlapped path lands each arriving partition window
+// at its permuted output rows through this (idx = inv_perm[lo:hi]), so
+// the per-window placement uses every core while later windows are still
+// in flight over DCN. idx values MUST be unique (a permutation slice):
+// duplicate destinations would race across threads — the Python wrapper
+// only routes permutation-derived indices here. Bounds checked inline
+// against n_dst like rsdl_take; returns 0 ok / 1 out-of-range.
+int rsdl_scatter(const void* src, void* dst, const int64_t* idx, int64_t n,
+                 int64_t itemsize, int64_t n_dst, int n_threads) {
+  std::atomic<int> err{0};
+  switch (itemsize) {
+    case 1:
+      scatter_typed(static_cast<const uint8_t*>(src),
+                    static_cast<uint8_t*>(dst), idx, n, n_dst, n_threads,
+                    &err);
+      return err.load();
+    case 2:
+      scatter_typed(static_cast<const uint16_t*>(src),
+                    static_cast<uint16_t*>(dst), idx, n, n_dst, n_threads,
+                    &err);
+      return err.load();
+    case 4:
+      scatter_typed(static_cast<const uint32_t*>(src),
+                    static_cast<uint32_t*>(dst), idx, n, n_dst, n_threads,
+                    &err);
+      return err.load();
+    case 8:
+      scatter_typed(static_cast<const uint64_t*>(src),
+                    static_cast<uint64_t*>(dst), idx, n, n_dst, n_threads,
+                    &err);
+      return err.load();
+  }
+  const uint8_t* in = static_cast<const uint8_t*>(src);
+  uint8_t* out = static_cast<uint8_t*>(dst);
+  parallel_for(n, n_threads, [=, &err](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_dst)) {
+        err.store(1, std::memory_order_relaxed);
+        return;
+      }
+      std::memcpy(out + j * itemsize, in + i * itemsize, itemsize);
+    }
+  });
+  return err.load();
+}
+
+// ---------------------------------------------------------------------------
+// Parallel stable group-by scatter (two-pass).
+//
+// The serial rsdl_group_rows below is inherently sequential — the running
+// cursors define the stable order — so the classic parallelization is:
+//
+//   pass 1: split [0, n) into n_threads CONTIGUOUS ranges; each thread
+//           histograms its range's group counts;
+//   plan:   an exclusive prefix-sum over (thread, group) — thread t's
+//           write cursor for group g starts at
+//           group_start[g] + sum_{t' < t} hist[t'][g],
+//           giving every (thread, group) pair a disjoint output span;
+//   pass 2: each thread scatters its contiguous input range through its
+//           own cursors — no atomics, no sharing.
+//
+// Stability is preserved because thread ranges are contiguous in input
+// order and the prefix-sum orders their spans by thread id: within any
+// group, rows from range t precede rows from range t+1, and within one
+// range the serial loop keeps input order. The output is therefore
+// BIT-IDENTICAL to the serial kernel (tested).
+//
+// The plan is computed ONCE per batch (rsdl_group_plan) and reused for
+// every column (rsdl_group_rows_mt copies the cursor table per call —
+// n_threads * n_groups int64s, trivial next to the row data).
+
+// cursors: caller-allocated [n_threads * n_groups] int64. group_starts:
+// each group's first output row (the Python-side cumsum of the bincount).
+void rsdl_group_plan(const int32_t* assignment, int64_t n, int64_t n_groups,
+                     int n_threads, const int64_t* group_starts,
+                     int64_t* cursors) {
+  int64_t chunk = group_chunk(n, n_threads);
+  // Pass 1: per-thread-range histograms. Counted in a THREAD-LOCAL
+  // buffer and copied out once: adjacent threads' rows of `cursors` can
+  // share cache lines (8 groups x 8 B is exactly one line), and counting
+  // directly into them ping-pongs those lines between cores badly enough
+  // to erase the whole parallel win (measured 0.78x at the bench shape).
+  {
+    std::vector<std::thread> threads;
+    threads.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t begin = std::min<int64_t>(n, t * chunk);
+      int64_t end = std::min<int64_t>(n, begin + chunk);
+      int64_t* hist = cursors + int64_t(t) * n_groups;
+      threads.emplace_back([=] {
+        std::vector<int64_t> local(n_groups, 0);
+        for (int64_t i = begin; i < end; ++i) ++local[assignment[i]];
+        std::memcpy(hist, local.data(), sizeof(int64_t) * n_groups);
+      });
+    }
+    for (auto& th : threads) th.join();
+  }
+  // Plan: exclusive prefix-sum down each group's column of the
+  // (thread, group) histogram, offset by the group's global start.
+  for (int64_t g = 0; g < n_groups; ++g) {
+    int64_t run = group_starts[g];
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t count = cursors[int64_t(t) * n_groups + g];
+      cursors[int64_t(t) * n_groups + g] = run;
+      run += count;
+    }
+  }
+}
+
+// Pass 2: the parallel scatter itself, over the WHOLE batch of columns
+// in one call — threads spawn once per batch, not once per column (at
+// the bench shape a per-column spawn cost ~5-10% of the scatter
+// itself). `cursors` is the CONST plan from rsdl_group_plan; each
+// (thread, column) works on a private copy so one plan serves every
+// column.
+void rsdl_group_rows_multi_mt(const void** srcs, void** dsts,
+                              const int64_t* itemsizes, int64_t n_cols,
+                              const int32_t* assignment, int64_t n,
+                              const int64_t* cursors, int n_threads,
+                              int64_t n_groups) {
+  int64_t chunk = group_chunk(n, n_threads);
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t begin = std::min<int64_t>(n, t * chunk);
+    int64_t end = std::min<int64_t>(n, begin + chunk);
+    const int64_t* plan = cursors + int64_t(t) * n_groups;
+    threads.emplace_back([=] {
+      std::vector<int64_t> cur(n_groups);
+      for (int64_t c = 0; c < n_cols; ++c) {
+        std::copy(plan, plan + n_groups, cur.begin());
+        const void* src = srcs[c];
+        void* dst = dsts[c];
+        switch (itemsizes[c]) {
+          case 1:
+            group_scatter_typed(static_cast<const uint8_t*>(src),
+                                static_cast<uint8_t*>(dst), assignment,
+                                begin, end, cur.data());
+            continue;
+          case 2:
+            group_scatter_typed(static_cast<const uint16_t*>(src),
+                                static_cast<uint16_t*>(dst), assignment,
+                                begin, end, cur.data());
+            continue;
+          case 4:
+            group_scatter_typed(static_cast<const uint32_t*>(src),
+                                static_cast<uint32_t*>(dst), assignment,
+                                begin, end, cur.data());
+            continue;
+          case 8:
+            group_scatter_typed(static_cast<const uint64_t*>(src),
+                                static_cast<uint64_t*>(dst), assignment,
+                                begin, end, cur.data());
+            continue;
+        }
+        int64_t itemsize = itemsizes[c];
+        const uint8_t* in = static_cast<const uint8_t*>(src);
+        uint8_t* out = static_cast<uint8_t*>(dst);
+        for (int64_t i = begin; i < end; ++i) {
+          std::memcpy(out + cur[assignment[i]]++ * itemsize,
+                      in + i * itemsize, itemsize);
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
 // Stable group-by-key scatter: given assignment[i] in [0, n_groups), write
 // rows grouped by key preserving input order (the map-stage partitioner).
 // Equivalent to argsort(kind=stable)+gather but single-pass O(n).
@@ -203,7 +441,9 @@ int rsdl_cast_i64_i32_checked(const int64_t* src, int32_t* dst, int64_t n,
 // entry, end offsets on return) — the caller computes it once per batch
 // and passes a fresh copy per column, so the histogram pass is not
 // repeated for every column. No bounds checks: the Python wrapper
-// validates the assignment range before calling.
+// validates the assignment range before calling. This serial kernel is
+// the reference the parallel rsdl_group_plan/rsdl_group_rows_mt pair
+// must match bit-for-bit; the wrapper picks per call by thread count.
 void rsdl_group_rows(const void* src, void* dst, const int32_t* assignment,
                      int64_t n, int64_t itemsize, int64_t* offsets) {
   // Typed scatters for the common element widths: the loop is inherently
@@ -246,6 +486,6 @@ void rsdl_group_rows(const void* src, void* dst, const int32_t* assignment,
   }
 }
 
-int rsdl_abi_version() { return 3; }
+int rsdl_abi_version() { return 4; }
 
 }  // extern "C"

@@ -35,6 +35,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from typing import Any, Dict, Optional
 
 from ray_shuffling_data_loader_tpu import telemetry
@@ -110,6 +111,68 @@ class _ActorHost:
         self.address = address
         self._shutdown = None  # asyncio.Event, created on the loop
         self._inflight = 0  # dispatches in flight (loop-thread only)
+        # Per-connection reply locks: OutOfBand payloads are written by
+        # an executor thread on the RAW socket (see _send_out_of_band),
+        # so every reply on that connection must serialize against it —
+        # and so must the connection CLOSE (writer.close() while an
+        # executor send is mid-flight would free the fd under it; a
+        # reused fd number would then receive another connection's
+        # bytes). Weak-keyed: entries vanish with their writer, so a
+        # dispatch outliving its connection can't leak a lock entry.
+        self._write_locks: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+
+    def _writer_lock(self, writer) -> asyncio.Lock:
+        lock = self._write_locks.get(writer)
+        if lock is None:
+            lock = self._write_locks[writer] = asyncio.Lock()
+        return lock
+
+    async def _send_out_of_band(self, writer, req_id, oob) -> None:
+        """Write a vectored reply with the bulk payload sent from an
+        EXECUTOR thread straight on the raw socket (``sendmsg`` releases
+        the GIL). The asyncio loop single-threads every transport write;
+        with striped fetches (``RSDL_TCP_STREAMS``) serving N concurrent
+        window stripes, that one thread was the measured server-side
+        bottleneck — per-core sends are the point of striping. The
+        per-connection lock plus a drained transport buffer guarantee
+        the raw-socket bytes cannot interleave with loop-side writes."""
+        sock = writer.get_extra_info("socket")
+        if sock is None:
+            transport.write_frame_vectored(
+                writer, (req_id, "okv", oob.meta), oob.buffers
+            )
+            await writer.drain()
+            return
+        frames = transport.vectored_frames(
+            (req_id, "okv", oob.meta), oob.buffers
+        )
+        tr = writer.transport
+        # The transport buffer must be EMPTY (not merely below the high
+        # water mark, which is all drain() guarantees) before raw-socket
+        # bytes go out, or they would overtake loop-buffered ones. A
+        # yield-first spin keeps the common case (already empty) free;
+        # a stalled peer backs off to millisecond sleeps, a closed
+        # transport aborts, and a half-open peer that simply stops
+        # reading hits the same 120 s bound as the raw send path —
+        # without it this loop would hold the connection's reply lock
+        # forever.
+        spins = 0
+        deadline = time.monotonic() + 120.0
+        while tr.get_write_buffer_size() > 0:
+            if tr.is_closing():
+                raise ConnectionError("connection closed mid-reply")
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    "peer stalled a buffered reply > 120s"
+                )
+            await asyncio.sleep(0 if spins < 16 else 0.001)
+            spins += 1
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, transport.sendmsg_all, sock, frames
+        )
 
     async def _handle_client(self, reader, writer):
         try:
@@ -132,10 +195,14 @@ class _ActorHost:
                     )
                 )
         finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            # Close UNDER the reply lock: an executor-thread OutOfBand
+            # send still writing this fd must finish (or fail on its
+            # own) before the fd is released for reuse.
+            async with self._writer_lock(writer):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
 
     async def _dispatch(self, writer, req_id, method, args, kwargs, oneway,
                         trace_ctx=None):
@@ -180,28 +247,48 @@ class _ActorHost:
                 if isinstance(result, transport.OutOfBand):
                     # Zero-copy reply: meta in the pickle header, bulk
                     # payload streamed verbatim after it (StoreServer
-                    # fetch_vec path). The sync caller reads it with
-                    # call_vectored/recv_frame.
-                    transport.write_frame_vectored(
-                        writer, (req_id, "okv", result.meta), result.buffers
-                    )
+                    # fetch_vec path) by an executor thread — concurrent
+                    # stripe replies ride different cores. The sync
+                    # caller reads it with call_vectored/recv_frame.
+                    try:
+                        async with self._writer_lock(writer):
+                            await self._send_out_of_band(
+                                writer, req_id, result
+                            )
+                    except Exception:
+                        # The vectored frame may have PARTIALLY hit the
+                        # wire: the connection's framing is gone, and an
+                        # err reply on it would be consumed as payload
+                        # bytes by a blocked reader. Tear the connection
+                        # down so the client fails into its
+                        # ActorDiedError ladder instead of hanging.
+                        try:
+                            writer.close()
+                        except Exception:
+                            pass
+                        return
                     result = None  # release buffer keepalives promptly
                 else:
-                    transport.write_frame(writer, (req_id, "ok", result))
-                await writer.drain()
+                    async with self._writer_lock(writer):
+                        transport.write_frame(writer, (req_id, "ok", result))
+                        await writer.drain()
         except Exception as exc:  # noqa: BLE001 — propagate to caller
             if not oneway:
                 tb = traceback.format_exc()
                 try:
-                    transport.write_frame(writer, (req_id, "err", (exc, tb)))
-                    await writer.drain()
+                    async with self._writer_lock(writer):
+                        transport.write_frame(writer, (req_id, "err", (exc, tb)))
+                        await writer.drain()
                 except Exception:
                     # The exception itself didn't pickle; the caller still
                     # needs a reply frame or it blocks forever. Send just
                     # the traceback text.
                     try:
-                        transport.write_frame(writer, (req_id, "err", (None, tb)))
-                        await writer.drain()
+                        async with self._writer_lock(writer):
+                            transport.write_frame(
+                                writer, (req_id, "err", (None, tb))
+                            )
+                            await writer.drain()
                     except Exception:
                         pass
         finally:
@@ -414,8 +501,23 @@ class ActorHandle:
         vectored frame. Returns ``(meta, payload_view)``; the payload is
         landed via ``recv_into`` in the buffer ``into(total_bytes)``
         returns (the zero-copy fetch path mmaps the destination cache
-        file), or ``(result, None)`` when the method replied plainly."""
+        file), or ``(result, None)`` when the method replied plainly.
+
+        An allocator with a truthy ``wants_meta`` attribute is called
+        ``into(total_bytes, reply_meta)`` — the striped fetch needs the
+        reply's stripe range before it can hand out the destination
+        window (see :meth:`transport.Connection.recv_frame`)."""
         req_id = self._next_id()
+        if into is not None and getattr(into, "wants_meta", False):
+            user_into = into
+
+            def _shim(total, frame):
+                # frame is the raw (req_id, status, meta) reply tuple at
+                # the transport layer; hand the caller just the meta.
+                return user_into(total, frame[2])
+
+            _shim.wants_meta = True
+            into = _shim
         conn = self._send_with_retry(req_id, method, args, kwargs, False)
         try:
             while True:

@@ -147,6 +147,25 @@ class ClusterRegistry:
 # ---------------------------------------------------------------------------
 
 
+def _slice_buffers(bufs, lo: int, hi: int):
+    """The sub-list of scatter-gather ``bufs`` covering logical byte range
+    ``[lo, hi)`` of their concatenation (views sliced at the edges; whole
+    buffers passed through untouched). The striped fetch's server-side
+    cut — no payload bytes are copied."""
+    out = []
+    pos = 0
+    for b in bufs:
+        view = memoryview(b).cast("B")
+        n = view.nbytes
+        start, stop = max(lo - pos, 0), min(hi - pos, n)
+        if start < stop:
+            out.append(view if (start, stop) == (0, n) else view[start:stop])
+        pos += n
+        if pos >= hi:
+            break
+    return out
+
+
 class StoreServer:
     """Serves this host's shared-memory segments to remote readers.
 
@@ -165,6 +184,14 @@ class StoreServer:
         self.spill_dir = _default_spill_dir()
         self.served_count = 0
         self.served_bytes = 0
+        # Tiny mapping cache (path -> mapped batch/mmap): a striped
+        # fetch (RSDL_TCP_STREAMS) issues one fetch_vec per stripe of
+        # the SAME segment, and re-mmapping + re-faulting it per stripe
+        # was a measured per-window cost. Segments are immutable once
+        # published, so a cached mapping can only ever be stale-by-
+        # absence (freed), which the exists() probe in _path catches.
+        self._map_cache: Dict[str, Any] = {}
+        self._map_cache_cap = 8
 
     def _path(self, object_id: str) -> str:
         # object_ids are token_hex-based; reject anything path-like.
@@ -194,39 +221,79 @@ class StoreServer:
         self.served_bytes += len(data)
         return data
 
-    def fetch_vec(self, object_id: str, rows=None) -> "transport.OutOfBand":
+    def fetch_vec(
+        self, object_id: str, rows=None, stripe=None
+    ) -> "transport.OutOfBand":
         """Zero-copy fetch (``RSDL_TCP_ZEROCOPY`` clients): the reply's
         bulk payload is a scatter-gather list of views straight over this
         host's mmapped segment — no ``serialize_columns`` materialization,
         no ``bytes`` copy, no payload pickle. Wire bytes are identical to
         :meth:`fetch`'s, so the reader's cache file is the same either
-        way."""
+        way.
+
+        ``stripe=(i, n)`` serves only byte range
+        ``[i*total//n, (i+1)*total//n)`` of that same serialization — the
+        multi-stream striped fetch (``RSDL_TCP_STREAMS``) issues one such
+        call per stream on its own connection and lands each range in a
+        disjoint window of one destination mapping; the concatenation
+        across stripes is byte-identical to the unstriped reply. The
+        reply meta carries ``{"nbytes": total, "stripe": [lo, hi]}`` so
+        the client can size/position the mapping from any stripe's
+        header. Per-stream wire format is the ordinary vectored frame."""
         import mmap as _mmap
 
         from .store import map_segment_file, serialize_columns_vectored
 
         path = self._path(object_id)
-        if rows is None:
+        cache_key = (path, rows if rows is None else tuple(rows))
+        cached = self._map_cache.get(cache_key)
+        if cached is not None and not os.path.exists(path):
+            # The file vanished outside free() (external reaper, spill
+            # cleanup): evict, or the dead entry would both pin the
+            # unlinked segment's pages and block re-caching forever.
+            self._map_cache.pop(cache_key, None)
+            cached = None
+        if cached is not None:
+            total, bufs, keepalive = cached
+        elif rows is None:
             fd = os.open(path, os.O_RDONLY)
             try:
                 size = os.fstat(fd).st_size
                 mm = _mmap.mmap(fd, size, prot=_mmap.PROT_READ)
             finally:
                 os.close(fd)
-            self.served_count += 1
-            self.served_bytes += size
-            return transport.OutOfBand(
-                {"nbytes": size}, [memoryview(mm)], keepalive=mm
+            total, bufs, keepalive = size, [memoryview(mm)], mm
+        else:
+            batch = map_segment_file(path, object_id).slice(
+                int(rows[0]), int(rows[1])
             )
-        batch = map_segment_file(path, object_id).slice(
-            int(rows[0]), int(rows[1])
-        )
-        total, bufs = serialize_columns_vectored(batch.columns)
+            total, bufs = serialize_columns_vectored(batch.columns)
+            keepalive = batch
+        if cached is None:
+            if len(self._map_cache) >= self._map_cache_cap:
+                # FIFO eviction is plenty: stripes of one window land
+                # within milliseconds of each other.
+                self._map_cache.pop(next(iter(self._map_cache)))
+            self._map_cache[cache_key] = (total, bufs, keepalive)
+        meta = {"nbytes": total}
+        if stripe is not None:
+            i, n = int(stripe[0]), int(stripe[1])
+            if not (0 < n and 0 <= i < n):
+                raise ValueError(f"bad stripe {stripe!r}")
+            lo, hi = i * total // n, (i + 1) * total // n
+            bufs = _slice_buffers(bufs, lo, hi)
+            meta["stripe"] = [lo, hi]
+            self.served_bytes += hi - lo
+            if i > 0:
+                # One logical fetch, n striped calls: count the object
+                # once (stripe 0) but every stripe's bytes.
+                return transport.OutOfBand(meta, bufs, keepalive=keepalive)
+        else:
+            self.served_bytes += total
         self.served_count += 1
-        self.served_bytes += total
         # keepalive pins the source mmap until the reply is written; the
         # actor host drops the OutOfBand right after the frame goes out.
-        return transport.OutOfBand({"nbytes": total}, bufs, keepalive=batch)
+        return transport.OutOfBand(meta, bufs, keepalive=keepalive)
 
     def fetch_stats(self) -> Dict[str, int]:
         """Cross-host traffic served by this host (the locality test's
@@ -235,7 +302,12 @@ class StoreServer:
 
     def free(self, object_id: str) -> None:
         try:
-            os.unlink(self._path(object_id))
+            path = self._path(object_id)
+            # Drop cached mappings first: a pinned mmap would keep the
+            # unlinked segment's tmpfs pages alive until eviction.
+            for key in [k for k in self._map_cache if k[0] == path]:
+                self._map_cache.pop(key, None)
+            os.unlink(path)
         except (FileNotFoundError, ValueError):
             pass
 
@@ -372,6 +444,95 @@ class PlacementProbe:
 # ---------------------------------------------------------------------------
 # Client side (lives in RuntimeContext)
 # ---------------------------------------------------------------------------
+
+
+def fetch_vec_striped(
+    handle: ActorHandle,
+    object_id: str,
+    rows,
+    alloc,
+    n_streams: int,
+    executor: concurrent.futures.Executor,
+) -> None:
+    """Striped zero-copy fetch: ``n_streams`` concurrent ``fetch_vec``
+    calls, each pulling one byte range of the segment serialization over
+    its own persistent connection (the executor's threads each hold a
+    per-peer connection, so stream count = pool width) and landing it via
+    ``recv_into`` in a disjoint window of ONE destination mapping.
+
+    ``alloc(total_bytes)`` is the store's ordinary destination allocator
+    (mmaps the cache tmp file); it is called exactly once, by whichever
+    stripe's reply header lands first. Stripe failures (reset, tamper,
+    length/total mismatch) surface as :class:`~.actor.ActorDiedError` /
+    ``ConnectionError`` — the same retry-safe class as the single-stream
+    fetch, so the lineage/retry ladder above needs no new cases.
+
+    Stripe 0 runs ON THE CALLING THREAD (which already holds its own
+    per-peer connection — the same one single-stream fetches use), only
+    stripes 1..n-1 ride the executor: concurrent window fetches (the
+    prefetch plane runs up to depth of them) therefore keep at least
+    their previous one-recv-per-window concurrency as a floor even when
+    the shared stripe pool is saturated, instead of all windows
+    funnelling through ``n_streams`` pool threads."""
+    lock = threading.Lock()
+    state: Dict[str, Any] = {}
+
+    def _window(nbytes: int, meta) -> memoryview:
+        # Runs inside recv_frame, before any payload byte is read. All
+        # validation failures raise ConnectionError: the frame's payload
+        # is still on the wire, so the connection must be torn down (the
+        # caller's except path drops it), and ConnectionError is exactly
+        # what the call layer wraps into the retry-safe ActorDiedError.
+        if not isinstance(meta, dict) or "nbytes" not in meta:
+            raise ConnectionError(f"bad stripe reply meta: {meta!r}")
+        total = int(meta["nbytes"])
+        lo, hi = meta.get("stripe", (0, total))
+        if hi - lo != nbytes or not (0 <= lo <= hi <= total):
+            raise ConnectionError(
+                f"stripe range {lo}-{hi} inconsistent with payload "
+                f"{nbytes} B / total {total} B"
+            )
+        with lock:
+            if "mm" not in state:
+                state["total"] = total
+                state["mm"] = alloc(total)
+            elif state["total"] != total:
+                raise ConnectionError(
+                    f"stripe total mismatch: {total} != {state['total']}"
+                )
+            mm = state["mm"]
+        return memoryview(mm)[lo:hi]
+
+    _window.wants_meta = True
+
+    def _pull(i: int) -> None:
+        meta, payload = handle.call_vectored(
+            "fetch_vec", object_id, rows, stripe=(i, n_streams),
+            into=_window,
+        )
+        if payload is not None:
+            # Release promptly: the store closes the destination mmap the
+            # moment the fetch returns, and a surviving exported view
+            # would turn that close into BufferError.
+            payload.release()
+
+    futures = [
+        executor.submit(_pull, i) for i in range(1, n_streams)
+    ]
+    error: Optional[BaseException] = None
+    try:
+        _pull(0)
+    except BaseException as exc:
+        error = exc
+    for fut in futures:
+        try:
+            fut.result()
+        except BaseException as exc:
+            error = error or exc
+    if error is not None:
+        raise error
+    if "mm" not in state:
+        raise ConnectionError("striped fetch produced no data")
 
 
 class ClusterTaskFuture:
@@ -642,6 +803,18 @@ class ClusterClient:
         self._scheduler_read_ts = 0.0
         self._peer_stores: Dict[Tuple, ActorHandle] = {}
         self._peer_lock = threading.Lock()
+        # Striped-fetch stream pool (RSDL_TCP_STREAMS > 1): its threads
+        # each hold one persistent authed connection per peer store.
+        # Stripe 0 of every fetch runs on the calling thread, so the
+        # pool serves only the EXTRA stripes — sized (streams-1) x a few
+        # concurrent windows so the prefetch plane's parallel window
+        # fetches don't serialize behind each other's stripes. Shares
+        # the store's grow-on-demand pool semantics (retired pools are
+        # never shut down mid-run, so a racing submit can't hit a
+        # closed executor).
+        from .store import GrowingThreadPool
+
+        self._stripe_pool = GrowingThreadPool("store-stripe")
         # How often the scheduler re-reads cluster membership (late joiners
         # picked up; sub-second churn is not a target).
         self.membership_refresh_s = 5.0
@@ -662,12 +835,32 @@ class ClusterClient:
             "fetch", ref.object_id, ref.rows
         )
 
+    def _stripe_executor(self, streams: int):
+        # Pool threads serve stripes 1..n-1 of each fetch (stripe 0 is
+        # inline on the caller); x4 covers the prefetch plane's typical
+        # concurrent windows, capped — each thread holds one persistent
+        # connection per peer.
+        return self._stripe_pool.ensure(min(16, max(1, streams - 1) * 4))
+
     def fetch_remote_into(self, ref: ObjectRef, alloc) -> None:
         """Zero-copy fetch: the peer streams header + payload as one
         vectored frame and the payload lands via ``recv_into`` in the
         buffer ``alloc(total_bytes)`` returns (the store mmaps the
         destination cache file) — no intermediate ``bytes`` join or
-        payload pickle on either side."""
+        payload pickle on either side.
+
+        With ``RSDL_TCP_STREAMS`` > 1 the payload is striped by byte
+        range over that many persistent connections, each stripe landing
+        in a disjoint window of the same mapping with parallel
+        ``recv_into`` (single-stream framing + single-core recv was the
+        measured gap to the raw loopback ceiling — BENCHLOG r6)."""
+        streams = transport.tcp_streams()
+        if streams > 1:
+            fetch_vec_striped(
+                self._peer_store(ref.owner), ref.object_id, ref.rows,
+                alloc, streams, self._stripe_executor(streams),
+            )
+            return
         meta, payload = self._peer_store(ref.owner).call_vectored(
             "fetch_vec", ref.object_id, ref.rows, into=alloc
         )
@@ -815,6 +1008,7 @@ class ClusterClient:
             pass
         if self._scheduler is not None:
             self._scheduler.shutdown()
+        self._stripe_pool.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------

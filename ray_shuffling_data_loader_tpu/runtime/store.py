@@ -103,6 +103,58 @@ def fetch_window_depth(default: int = 8) -> int:
         return default
 
 
+class GrowingThreadPool:
+    """A ThreadPoolExecutor that widens on demand — the shared grow
+    mechanism for the store's prefetch pool and the cluster client's
+    striped-fetch pool (both bind a width on first use that a later,
+    wider caller must be able to raise).
+
+    Growth is by replacement, and replaced pools are RETIRED, never shut
+    down: a submit racing a grow may land on the old pool, and a closed
+    executor would turn that into a spurious ``RuntimeError``. Retired
+    pools drain their queues then idle — bounded at one small pool per
+    distinct growth step — until :meth:`shutdown`."""
+
+    def __init__(self, thread_name_prefix: str):
+        self._prefix = thread_name_prefix
+        self._lock = threading.Lock()
+        self._pool = None
+        self._retired: list = []
+        self.width = 0
+
+    def ensure(self, width: int) -> "GrowingThreadPool":
+        """Make the pool at least ``width`` wide; returns self (usable
+        wherever an executor's ``submit`` is expected)."""
+        import concurrent.futures
+
+        with self._lock:
+            if self._pool is None or width > self.width:
+                if self._pool is not None:
+                    self._retired.append(self._pool)
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=width, thread_name_prefix=self._prefix
+                )
+                self.width = width
+        return self
+
+    def submit(self, fn, *args, **kwargs):
+        with self._lock:
+            if self._pool is None:
+                raise RuntimeError("GrowingThreadPool: ensure() not called")
+            pool = self._pool
+        return pool.submit(fn, *args, **kwargs)
+
+    def shutdown(self, wait: bool = False) -> None:
+        with self._lock:
+            pools, self._retired = list(self._retired), []
+            if self._pool is not None:
+                pools.append(self._pool)
+                self._pool = None
+            self.width = 0
+        for pool in pools:
+            pool.shutdown(wait=wait)
+
+
 class ObjectLostError(FileNotFoundError):
     """A store object's segment is gone (freed early, host died holding
     the only copy, or an injected ``store.get:lost`` fault). Carries the
@@ -508,8 +560,8 @@ class ObjectStore:
         self.remote_fetch_into = None
         self.remote_free = None  # Callable[[ObjectRef], None]
         self._foreign: set = set()  # locally cached foreign object ids
-        self._prefetch_pool = None  # lazy ThreadPoolExecutor
-        self._prefetch_lock = threading.Lock()
+        # Grows to the largest max_parallel any prefetch call asks for.
+        self._prefetch_pool = GrowingThreadPool("store-prefetch")
         # Cache names freed in this process: a prefetch thread whose fetch
         # lands AFTER the consumer already freed the ref must discard its
         # result instead of orphaning a cache file (object ids are never
@@ -758,8 +810,11 @@ class ObjectStore:
         ``max_parallel`` defaults to :func:`fetch_window_depth` (the
         ``RSDL_FETCH_WINDOW_DEPTH`` knob; this delivery-plane path
         defaults to 8 when the env is unset, the overlapped reduce to
-        4) and binds on the FIRST call — the pool is process-lifetime,
-        so later calls reuse its width.
+        4). The pool is process-lifetime but its width follows the
+        LARGEST ``max_parallel`` seen: a later call asking for more
+        parallelism grows the pool (by replacement — in-flight fetches
+        on the old pool complete normally) instead of silently
+        serializing its extra fetches behind the first caller's width.
 
         The ``ray.wait(fetch_local=True)`` analog (reference
         ``dataset.py:132-137``): the reference pulls ALL pending reducer
@@ -797,14 +852,11 @@ class ObjectStore:
             self._freed_caches.discard(self._cache_name(ref))
         if max_parallel is None:
             max_parallel = fetch_window_depth(default=8)
-        with self._prefetch_lock:
-            if self._prefetch_pool is None:
-                import concurrent.futures
-
-                self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=max_parallel,
-                    thread_name_prefix="store-prefetch",
-                )
+        # Grow-on-demand (a first narrow caller must not serialize a
+        # later wider one's fetches); a ref whose fetch is in flight on
+        # a retired pool is at worst one redundant pull — object ids
+        # are immutable content and _pull re-checks the cache.
+        pool = self._prefetch_pool.ensure(max_parallel)
 
         def _pull(ref: ObjectRef) -> None:
             name = self._cache_name(ref)
@@ -826,7 +878,7 @@ class ObjectStore:
                         pass
                 self._foreign.discard(name)
 
-        return [self._prefetch_pool.submit(_pull, r) for r in foreign]
+        return [pool.submit(_pull, r) for r in foreign]
 
     def _materialize_remote(self, ref: ObjectRef, path: str) -> None:
         """Pull a foreign segment's bytes (just the ref's window) and
@@ -855,7 +907,15 @@ class ObjectStore:
                 fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
                 try:
                     os.ftruncate(fd, max(n, 1))
-                    mm = mmap.mmap(fd, max(n, 1))
+                    # MAP_POPULATE prefaults the whole window in one
+                    # kernel sweep: without it every 4 KB page of the
+                    # fresh cache file faults individually under
+                    # recv_into — measured as a large share of the
+                    # per-window fetch cost (BENCHLOG r7).
+                    flags = mmap.MAP_SHARED | getattr(
+                        mmap, "MAP_POPULATE", 0
+                    )
+                    mm = mmap.mmap(fd, max(n, 1), flags=flags)
                 finally:
                     os.close(fd)
                 holder["mm"] = mm
@@ -893,15 +953,19 @@ class ObjectStore:
         self._foreign.add(os.path.basename(path))
         if t0 is not None:
             # Per-window DCN latency + bytes — the TCP plane's primary
-            # observability (docs/observability.md); label carries which
-            # framing served the window.
+            # observability (docs/observability.md); labels carry which
+            # framing served the window and how many striped streams
+            # (RSDL_TCP_STREAMS; always 1 on the legacy pickle path).
             try:
                 zc = "1" if zerocopy else "0"
+                streams = str(_transport.tcp_streams()) if zerocopy else "1"
                 _metrics.registry.histogram(
-                    "store.fetch_window_seconds", zerocopy=zc
+                    "store.fetch_window_seconds", zerocopy=zc,
+                    streams=streams,
                 ).observe(time.perf_counter() - t0)
                 _metrics.registry.counter(
-                    "store.fetch_window_bytes", zerocopy=zc
+                    "store.fetch_window_bytes", zerocopy=zc,
+                    streams=streams,
                 ).inc(float(nbytes))
             except Exception:
                 pass

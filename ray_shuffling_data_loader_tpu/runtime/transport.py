@@ -44,8 +44,29 @@ _VEC_FLAG = 1 << 63
 # sendmsg iov count stays far below any IOV_MAX (Linux: 1024).
 _SENDMSG_MAX_VECS = 512
 
+# Data-plane socket buffer size. Default kernel buffers autotune from
+# ~128 KB, which turns a multi-MB window transfer into dozens of
+# event-loop/epoll ping-pongs — measured as the dominant cost of a
+# loopback window fetch (r7: 2.3 ms of a 2.85 ms 4 MB fetch was
+# scheduling, not copying). One setsockopt per connection buys back
+# most of it; the kernel clamps to net.core.{r,w}mem_max so an
+# over-ask degrades gracefully.
+_SOCK_BUF_BYTES = 4 << 20
+
+
+def _tune_sock(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF_BYTES)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF_BYTES)
+    except OSError:
+        pass
+
 ENV_ZEROCOPY = "RSDL_TCP_ZEROCOPY"
 _zerocopy: Optional[bool] = None  # tri-state cache, like the telemetry gates
+
+ENV_TCP_STREAMS = "RSDL_TCP_STREAMS"
+_MAX_TCP_STREAMS = 16
+_tcp_streams: Optional[int] = None
 
 
 def zerocopy_enabled() -> bool:
@@ -65,6 +86,30 @@ def refresh_zerocopy_from_env() -> None:
     """Forget the cached gate; next check re-reads the env (tests/bench)."""
     global _zerocopy
     _zerocopy = None
+
+
+def tcp_streams() -> int:
+    """Persistent connections per peer for striped zero-copy fetches
+    (``RSDL_TCP_STREAMS``; default 1 = single-stream, the pre-striping
+    wire behavior untouched). Clamped to [1, 16] — each stream costs a
+    socket + HMAC handshake per peer, and recv parallelism past the
+    core count buys nothing. Read once, like the zerocopy gate; only
+    meaningful with ``RSDL_TCP_ZEROCOPY`` on (the legacy pickle path
+    never stripes)."""
+    global _tcp_streams
+    if _tcp_streams is None:
+        try:
+            n = int(os.environ.get(ENV_TCP_STREAMS, "1").strip() or "1")
+        except ValueError:
+            n = 1
+        _tcp_streams = max(1, min(_MAX_TCP_STREAMS, n))
+    return _tcp_streams
+
+
+def refresh_tcp_streams_from_env() -> None:
+    """Forget the cached stream count; next check re-reads (tests/bench)."""
+    global _tcp_streams
+    _tcp_streams = None
 
 
 class OutOfBand:
@@ -124,8 +169,63 @@ def _recv_exact_sock(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def sendmsg_all(
+    sock: socket.socket, views: Sequence, timeout_s: float = 120.0
+) -> None:
+    """``sendall`` over a scatter-gather list via ``sendmsg``, advancing
+    across partial sends without coalescing buffers in user space. Works
+    on blocking AND non-blocking sockets: on ``EAGAIN`` it waits for
+    writability with ``select`` (bounded by ``timeout_s`` per wait) —
+    the actor host calls this from an executor thread on a socket whose
+    event loop owns the fd, so the socket's blocking mode must not be
+    touched. ``sendmsg`` releases the GIL, so concurrent replies to
+    different peers stream on different cores."""
+    import select as _select
+
+    # poll(), not select(): select raises ValueError for any fd >= 1024
+    # (FD_SETSIZE) — easily exceeded on a serving host once striping
+    # multiplies per-peer connections.
+    poller = _select.poll()
+    poller.register(sock.fileno(), _select.POLLOUT)
+    queue = [memoryview(v).cast("B") for v in views if memoryview(v).nbytes]
+    while queue:
+        try:
+            sent = sock.sendmsg(queue[:_SENDMSG_MAX_VECS])
+        except InterruptedError:
+            continue
+        except BlockingIOError:
+            if not poller.poll(timeout_s * 1000.0):
+                raise ConnectionError(
+                    f"peer stalled a vectored send > {timeout_s:.0f}s"
+                ) from None
+            continue
+        while sent:
+            head = queue[0]
+            if sent >= head.nbytes:
+                sent -= head.nbytes
+                queue.pop(0)
+            else:
+                queue[0] = head[sent:]
+                sent = 0
+
+
 def dumps(obj: Any) -> bytes:
     return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def vectored_frames(obj: Any, buffers: Sequence) -> List[memoryview]:
+    """THE encoder of the vectored wire frame, as a scatter-gather list:
+    ``[len|_VEC_FLAG][pickle((obj, sizes))][payload bytes...]``. Every
+    sender (sync ``send_vectored``, asyncio ``write_frame_vectored``,
+    the actor host's executor-thread reply) builds its frame here so the
+    layout can never drift between them."""
+    views = [memoryview(b).cast("B") for b in buffers]
+    header = dumps((obj, [v.nbytes for v in views]))
+    return [
+        memoryview(_LEN.pack(_VEC_FLAG | len(header))),
+        memoryview(header),
+        *views,
+    ]
 
 
 loads = pickle.loads
@@ -154,6 +254,7 @@ class Connection:
                 self.sock.setsockopt(
                     socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                 )
+                _tune_sock(self.sock)
                 token = cluster_token()
                 if token is not None:
                     # Don't hang forever on a server that never challenges.
@@ -192,33 +293,12 @@ class Connection:
         path."""
         if faults.enabled():
             faults.fire("transport.send")
-        views = [memoryview(b).cast("B") for b in buffers]
-        header = dumps((obj, [v.nbytes for v in views]))
-        self._sendmsg_all(
-            [
-                memoryview(_LEN.pack(_VEC_FLAG | len(header))),
-                memoryview(header),
-                *views,
-            ]
-        )
+        self._sendmsg_all(vectored_frames(obj, buffers))
 
     def _sendmsg_all(self, views: List[memoryview]) -> None:
         """sendall over a scatter-gather list, advancing across partial
         sends without ever coalescing the buffers in user space."""
-        queue = [v for v in views if v.nbytes]
-        while queue:
-            try:
-                sent = self.sock.sendmsg(queue[:_SENDMSG_MAX_VECS])
-            except InterruptedError:
-                continue
-            while sent:
-                head = queue[0]
-                if sent >= head.nbytes:
-                    sent -= head.nbytes
-                    queue.pop(0)
-                else:
-                    queue[0] = head[sent:]
-                    sent = 0
+        sendmsg_all(self.sock, views)
 
     def recv(self) -> Any:
         return self.recv_frame()[0]
@@ -230,7 +310,11 @@ class Connection:
         frames return ``(obj, payload_view)`` with the payload landed via
         ``recv_into`` in the buffer ``into(total_bytes)`` returns (an
         mmapped cache file on the fetch path) — or a throwaway bytearray
-        when no allocator is given."""
+        when no allocator is given. An allocator carrying a truthy
+        ``wants_meta`` attribute is called ``into(total_bytes, obj)``
+        instead — the striped fetch plane needs the reply's stripe
+        byte-range (carried in the header object) to hand back the right
+        window of the shared destination mapping."""
         if faults.enabled():
             faults.fire("transport.recv")
         header = self._recv_exact(_LEN.size)
@@ -239,7 +323,12 @@ class Connection:
             return loads(self._recv_exact(length)), None
         obj, sizes = loads(self._recv_exact(length & ~_VEC_FLAG))
         total = int(sum(sizes))
-        raw = into(total) if into is not None else bytearray(total)
+        if into is None:
+            raw = bytearray(total)
+        elif getattr(into, "wants_meta", False):
+            raw = into(total, obj)
+        else:
+            raw = into(total)
         # _recv_exact_into creates and RELEASES its own views: on a
         # mid-payload failure no memoryview over ``raw`` may survive
         # into the traceback — the fetch path's error cleanup closes the
@@ -306,11 +395,7 @@ def write_frame_vectored(
     buffer written as-is (the transport sends what it can immediately and
     buffers only the remainder — no payload pickle, no join). Sources may
     be released once this returns: asyncio copies unsent tails."""
-    views = [memoryview(b).cast("B") for b in buffers]
-    header = dumps((obj, [v.nbytes for v in views]))
-    writer.write(_LEN.pack(_VEC_FLAG | len(header)))
-    writer.write(header)
-    for v in views:
+    for v in vectored_frames(obj, buffers):
         if v.nbytes:
             writer.write(v)
 
@@ -353,6 +438,19 @@ async def start_server(address: Address, handler):
         token = cluster_token()
 
         async def tcp_handler(reader, writer):
+            # Data-plane socket + write-buffer tuning: large socket
+            # buffers (see _SOCK_BUF_BYTES) and a matching asyncio
+            # write high-water mark, so a multi-MB vectored reply
+            # drains in a few loop iterations instead of dozens.
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                _tune_sock(sock)
+            try:
+                writer.transport.set_write_buffer_limits(
+                    high=_SOCK_BUF_BYTES
+                )
+            except (AttributeError, RuntimeError):
+                pass
             # Gate BEFORE any pickle touches peer bytes: challenge the
             # peer with a nonce; the first frame back must be the keyed
             # digest. 10 s auth deadline so half-open peers can't pin

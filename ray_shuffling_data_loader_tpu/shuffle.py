@@ -663,6 +663,8 @@ def _overlapped_reduce(
     CONCURRENCY, and completed fetches would pile up to the full
     reducer input whenever DCN outpaces the gather.
     """
+    from ray_shuffling_data_loader_tpu import native
+
     depth = _fetch_window_depth()
     store.prefetch(part_refs[:depth], max_parallel=depth)
     dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
@@ -672,7 +674,11 @@ def _overlapped_reduce(
         rng = _reduce_seed(seed, epoch, reduce_index)
         perm = rng.permutation(total)
         inv = np.empty(total, dtype=np.int64)
-        inv[perm] = np.arange(total, dtype=np.int64)
+        # Permutation inversion is itself a scatter; the threaded kernel
+        # splits it by row range (numpy fallback: inv[perm] = arange).
+        native.scatter(
+            np.arange(total, dtype=np.int64), perm, inv
+        )
     pending = None
     try:
         for i, ref in enumerate(part_refs):
@@ -694,9 +700,15 @@ def _overlapped_reduce(
             lo, hi = int(dst_off[i]), int(dst_off[i + 1])
             if hi > lo:
                 with prof.phase("gather", nbytes=2 * part.nbytes):
+                    # Per-core ownership of the window's output rows: the
+                    # threaded scatter kernel splits dest by row range, so
+                    # window N's placement uses every core while windows
+                    # N+1..N+depth are still in flight on the prefetch
+                    # threads (the C call releases the GIL). dest is a
+                    # permutation slice — unique indices by construction.
                     dest = inv[lo:hi]
                     for k, dst in pending.columns.items():
-                        dst[dest] = part[k]
+                        native.scatter(part[k], dest, dst)
             del part
             # This window is consumed; dropping its fetched copy now
             # bounds peak local residency at ~depth windows (drop_cache

@@ -43,9 +43,10 @@ if [ "$tier" != "slow" ]; then
   # exists regardless of task placement.
   # RSDL_TCP_ZEROCOPY rides along so recovery is proven over the
   # vectored-framing transport path too (ISSUE 5), not just the legacy
-  # pickle frames.
+  # pickle frames; RSDL_TCP_STREAMS=2 keeps striping on so transport
+  # fault sites exercise per-stream connections (ISSUE 6).
   RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
-    RSDL_TCP_ZEROCOPY=1 \
+    RSDL_TCP_ZEROCOPY=1 RSDL_TCP_STREAMS=2 \
     RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1,transport.send/driver:reset:0.02x2" \
     RSDL_FAULTS_SEED=1234 \
     python -m pytest tests/test_chaos.py tests/test_shuffle.py \
@@ -75,14 +76,15 @@ if [ "$tier" != "slow" ]; then
     echo "epoch_report failed to flag the injected regression" >&2
     exit 1
   fi
-  # TCP-plane lane (ISSUE 5): the two-process loopback "two-host" bench
-  # at a small shape — a worker host joins over real TCP (own shm dir),
-  # the windowed-fetch microbench runs both framings (legacy pickle +
-  # RSDL_TCP_ZEROCOPY vectored), and the end-to-end two-host shuffle
+  # TCP-plane lane (ISSUE 5/6): the two-process loopback "two-host"
+  # bench at a small shape — a worker host joins over real TCP (own shm
+  # dir), the windowed-fetch microbench runs all framings (legacy
+  # pickle, RSDL_TCP_ZEROCOPY vectored, and RSDL_TCP_STREAMS=2 striped),
+  # and the end-to-end two-host shuffle — striping on cluster-wide —
   # must reconcile exactly-once over the wire (the bench exits non-zero
   # on any error OR an audit mismatch, so the exit code IS the gate).
   RSDL_BENCH_TCP_WINDOWS=12 RSDL_BENCH_TCP_WINDOW_MB=1 \
-    RSDL_BENCH_TCP_SHUFFLE_GB=0.02 \
+    RSDL_BENCH_TCP_SHUFFLE_GB=0.02 RSDL_BENCH_TCP_STREAMS=2 \
     python bench.py --plane tcp > /dev/null
 fi
 if [ "$tier" != "fast" ]; then

@@ -112,6 +112,109 @@ def test_group_rows_stable():
     assert off0[1] == off0[2] == off0[3] == len(arr)
 
 
+def test_group_rows_parallel_bit_identity():
+    """The two-pass parallel stable scatter must be BIT-identical to the
+    serial kernel and to the numpy argsort spec — across itemsizes
+    (1/2/4/8 and an odd 3-byte row), thread counts, empty groups, and
+    non-power-of-two row counts (thread ranges then split unevenly)."""
+    for n in (20_001, 1_048_577):  # non-power-of-two on both sides of MT
+        cols = {
+            "w1": rng.integers(0, 255, size=n).astype(np.uint8),
+            "w2": rng.integers(0, 1 << 14, size=n).astype(np.uint16),
+            "w4": rng.integers(0, 1 << 30, size=n).astype(np.int32),
+            "w8": rng.integers(0, 1 << 40, size=n),
+            "odd": rng.integers(0, 255, size=(n, 3)).astype(np.uint8),
+        }
+        # group 3 left empty on purpose
+        assign = rng.choice([0, 1, 2, 4, 5], size=n)
+        order = np.argsort(assign, kind="stable")
+        serial = {k: v[order] for k, v in cols.items()}
+        for t in (1, 2, 8):
+            got, offsets = native.group_rows_multi(
+                cols, assign, 6, n_threads=t
+            )
+            for k in cols:
+                assert got[k].tobytes() == serial[k].tobytes(), (n, t, k)
+            assert offsets[4] == offsets[3]  # empty group
+            np.testing.assert_array_equal(
+                np.diff(offsets), np.bincount(assign, minlength=6)
+            )
+
+
+def test_group_rows_parallel_out_views():
+    """Parallel path writing into pre-allocated out= destinations (the
+    map stage's store-segment views)."""
+    n = 1_200_000
+    cols = {"a": rng.integers(0, 1 << 30, size=n).astype(np.int32)}
+    assign = rng.integers(0, 8, size=n)
+    out = {"a": np.empty_like(cols["a"])}
+    got, _ = native.group_rows_multi(cols, assign, 8, out=out, n_threads=8)
+    assert got["a"] is out["a"]
+    order = np.argsort(assign, kind="stable")
+    np.testing.assert_array_equal(out["a"], cols["a"][order])
+
+
+def test_scatter_matches_numpy():
+    """out[idx] = src across dtypes/threads; permutation-derived indices
+    (the overlapped reduce's per-window placement op)."""
+    n = 10_000
+    perm = rng.permutation(n)
+    for arr in (
+        rng.integers(0, 1 << 30, size=n).astype(np.int32),
+        rng.random((n, 2)).astype(np.float32),
+        rng.integers(0, 255, size=(n, 3)).astype(np.uint8),
+        rng.integers(0, 1 << 40, size=n),
+    ):
+        for t in (1, 2, 8):
+            out = np.zeros_like(arr)
+            ref = np.zeros_like(arr)
+            ref[perm] = arr
+            got = native.scatter(arr, perm, out, n_threads=t)
+            assert got is out
+            np.testing.assert_array_equal(out, ref)
+    # windowed slice of an inverted permutation (the real call shape)
+    inv = np.empty(n, dtype=np.int64)
+    inv[perm] = np.arange(n)
+    src = rng.integers(0, 1 << 30, size=n // 4).astype(np.int32)
+    out = np.zeros(n, dtype=np.int32)
+    ref = np.zeros(n, dtype=np.int32)
+    ref[inv[: n // 4]] = src
+    native.scatter(src, inv[: n // 4], out)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_scatter_bounds_and_fallbacks():
+    arr = rng.integers(0, 100, size=10)
+    out = np.zeros(10, dtype=arr.dtype)
+    with pytest.raises(IndexError):
+        native.scatter(arr, np.arange(5, 15), out)
+    with pytest.raises(ValueError):
+        native.scatter(arr, np.arange(3), out)
+    # negative indices route to the numpy fallback's semantics
+    out[:] = 0
+    native.scatter(arr[:2], np.array([-1, -2]), out)
+    assert out[-1] == arr[0] and out[-2] == arr[1]
+
+
+def test_native_threads_env_knob(monkeypatch):
+    """RSDL_NATIVE_THREADS overrides the core-count heuristic, read once
+    and clamped >= 1."""
+    default = native.num_threads()
+    assert default >= 1
+    monkeypatch.setenv(native.ENV_THREADS, "5")
+    native.refresh_threads_from_env()
+    assert native.num_threads() == 5
+    monkeypatch.setenv(native.ENV_THREADS, "0")
+    native.refresh_threads_from_env()
+    assert native.num_threads() == 1  # clamped
+    monkeypatch.setenv(native.ENV_THREADS, "junk")
+    native.refresh_threads_from_env()
+    assert native.num_threads() == default  # unparsable -> heuristic
+    monkeypatch.delenv(native.ENV_THREADS)
+    native.refresh_threads_from_env()
+    assert native.num_threads() == default
+
+
 def test_take_bounds_semantics():
     arr = rng.integers(0, 100, size=100)
     # negative indices: numpy semantics via fallback

@@ -277,6 +277,60 @@ def test_prefetch_overlaps_foreign_fetches(tmp_path):
     store.free(refs)
 
 
+def test_prefetch_pool_grows_with_max_parallel(tmp_path):
+    """The prefetch pool's width follows the LARGEST ``max_parallel``
+    seen: a first narrow call must not pin later, wider callers to
+    serialized fetches (ISSUE 6 satellite — the old pool bound its
+    width on the first call forever)."""
+    from ray_shuffling_data_loader_tpu.runtime.store import (
+        ObjectRef,
+        ObjectStore,
+        serialize_columns,
+    )
+
+    store = ObjectStore("pfgrow", shm_dir=str(tmp_path))
+    store.owner_address = ("tcp", "local", 1)
+    payload = serialize_columns({"x": np.arange(8, dtype=np.int64)})
+    state = {"active": 0, "max_active": 0}
+    lock = threading.Lock()
+
+    def fake_fetch(ref):
+        with lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"], state["active"])
+        time.sleep(0.1)
+        with lock:
+            state["active"] -= 1
+        return payload
+
+    store.remote_fetch = fake_fetch
+
+    def make_refs(tag, n):
+        return [
+            ObjectRef(
+                object_id=f"other-{tag}-{i:02d}",
+                nbytes=len(payload),
+                session="other",
+                owner=("tcp", "remote", 2),
+            )
+            for i in range(n)
+        ]
+
+    # First caller pins a width of 1...
+    futs = store.prefetch(make_refs("narrow", 2), max_parallel=1)
+    for f in futs:
+        f.result(timeout=30)
+    assert store._prefetch_pool.width == 1
+    assert state["max_active"] == 1
+    # ...a later wider call must actually fetch in parallel.
+    state["max_active"] = 0
+    futs = store.prefetch(make_refs("wide", 4), max_parallel=4)
+    for f in futs:
+        f.result(timeout=30)
+    assert store._prefetch_pool.width == 4
+    assert state["max_active"] >= 2, "pool never grew"
+
+
 # -- actors -----------------------------------------------------------------
 
 

@@ -72,6 +72,31 @@ def test_vectored_recv_into_caller_buffer():
     cb.close()
 
 
+def test_vectored_recv_meta_aware_allocator():
+    """An allocator marked ``wants_meta`` receives the frame's header
+    object alongside the byte count — the striped fetch positions its
+    destination window from the stripe range carried there."""
+    ca, cb = _conn_pair()
+    seen = {}
+
+    def alloc(n, obj):
+        seen["n"], seen["obj"] = n, obj
+        return bytearray(n)
+
+    alloc.wants_meta = True
+    sender = threading.Thread(
+        target=ca.send_vectored,
+        args=(({"stripe": [3, 7]}, "x"), [b"abcd"]),
+    )
+    sender.start()
+    obj, view = cb.recv_frame(into=alloc)
+    sender.join()
+    assert seen == {"n": 4, "obj": ({"stripe": [3, 7]}, "x")}
+    assert bytes(view) == b"abcd"
+    ca.close()
+    cb.close()
+
+
 def test_plain_and_vectored_frames_interleave():
     ca, cb = _conn_pair()
 

@@ -90,16 +90,167 @@ def _memcpy_gbps(nbytes: int = 256 << 20, repeats: int = 3) -> float:
     sequential data-moving phase cannot beat): best of ``repeats`` timed
     ``np.copyto`` passes over an ``nbytes`` buffer, counted as read+write
     traffic."""
-    src = np.arange(nbytes // 8, dtype=np.int64)  # defeat COW zero-pages
-    dst = np.empty_like(src)
-    np.copyto(dst, src)  # warm
+    return _memcpy_gbps_mt(1, nbytes=nbytes, repeats=repeats)
+
+
+def _memcpy_gbps_mt(
+    threads: int, nbytes: int = 256 << 20, repeats: int = 3
+) -> float:
+    """N-core memcpy roofline: ``threads`` concurrent ``np.copyto``
+    passes over disjoint buffers (numpy releases the GIL for large
+    copies), counted as aggregate read+write traffic — the ceiling an
+    N-thread data-moving kernel is measured against."""
+    import threading as _threading
+
+    per = max(1 << 20, nbytes // threads)
+    srcs = [np.arange(per // 8, dtype=np.int64) for _ in range(threads)]
+    dsts = [np.empty_like(s) for s in srcs]
+    for s, d in zip(srcs, dsts):
+        np.copyto(d, s)  # warm (defeat COW zero-pages)
     best = 0.0
     for _ in range(repeats):
+        barrier = _threading.Barrier(threads + 1)
+
+        def _run(s, d):
+            barrier.wait()
+            np.copyto(d, s)
+
+        workers = [
+            _threading.Thread(target=_run, args=(s, d))
+            for s, d in zip(srcs, dsts)
+        ]
+        for w in workers:
+            w.start()
+        barrier.wait()
         t0 = time.perf_counter()
-        np.copyto(dst, src)
+        for w in workers:
+            w.join()
         dt = time.perf_counter() - t0
-        best = max(best, 2 * src.nbytes / max(dt, 1e-9))
+        best = max(best, 2 * sum(s.nbytes for s in srcs) / max(dt, 1e-9))
     return best / 1e9
+
+
+def _best_s(fn, repeats: int = 5) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_threads_sweep(args, thread_counts) -> int:
+    """Isolated kernel sweep (``--threads``): partition scatter, permute
+    scatter, and gather at the bench task shape, per kernel thread
+    count, against the matching N-core memcpy roofline. This is the
+    multi-core evidence ROADMAP item 2 asks for — the kernels must scale
+    with cores toward the roofline, isolated from pipeline effects
+    (worker scheduling, store I/O, consumer pacing)."""
+    from ray_shuffling_data_loader_tpu import native
+    from ray_shuffling_data_loader_tpu.data_generation import DATA_SPEC
+
+    bytes_per_row = 168  # DATA_SPEC (pre-narrowing)
+    num_rows = max(1000, int(args.gb * 1e9) // bytes_per_row)
+    task_rows = max(1, num_rows // args.files)
+    rng = np.random.default_rng(0)
+    # The narrowed map-task batch: every DATA_SPEC column 4 bytes wide
+    # (the regime the typed kernels were built for, BENCHLOG r6).
+    cols = {}
+    for name, (low, high, dtype) in DATA_SPEC.items():
+        if np.issubdtype(dtype, np.integer):
+            cols[name] = rng.integers(
+                low, high, task_rows, dtype=np.int64
+            ).astype(np.int32)
+        else:
+            cols[name] = rng.random(task_rows).astype(np.float32)
+    cols["key"] = np.arange(task_rows, dtype=np.int32)
+    batch_bytes = sum(v.nbytes for v in cols.values())
+    assignment = rng.integers(0, args.reducers, size=task_rows)
+    out = {k: np.empty_like(v) for k, v in cols.items()}
+    # Reduce-side shapes: one reducer's output (total epoch rows /
+    # reducers) permuted; windows arrive per mapper file.
+    red_rows = max(1, num_rows // args.reducers)
+    red_col = rng.integers(0, 1 << 30, size=red_rows).astype(np.int32)
+    perm = rng.permutation(red_rows)
+    red_out = np.empty_like(red_col)
+    print(
+        f"[sweep] map task: {task_rows} rows x {len(cols)} cols "
+        f"({batch_bytes / 1e6:.0f} MB narrowed), reducer output: "
+        f"{red_rows} rows; native={native.native_available()}",
+        file=sys.stderr,
+    )
+    if not native.native_available():
+        print(
+            "[sweep] WARNING: native kernels unavailable — numpy "
+            "fallbacks ignore n_threads, the sweep will show no scaling",
+            file=sys.stderr,
+        )
+
+    sweep = []
+    base = {}
+    print()
+    print(
+        f"{'threads':>7} {'op':<18} {'GB/s':>7} {'x vs 1':>7} "
+        f"{'roofline GB/s':>13} {'%roof':>6}"
+    )
+    for t in thread_counts:
+        roof = _memcpy_gbps_mt(t)
+        ops = {
+            "partition-scatter": (
+                2 * batch_bytes,
+                lambda t=t: native.group_rows_multi(
+                    cols, assignment, args.reducers, out=out, n_threads=t
+                ),
+            ),
+            "permute-scatter": (
+                2 * red_col.nbytes,
+                lambda t=t: native.scatter(
+                    red_col, perm, red_out, n_threads=t
+                ),
+            ),
+            "gather": (
+                2 * red_col.nbytes,
+                lambda t=t: native.take(
+                    red_col, perm, out=red_out, n_threads=t
+                ),
+            ),
+        }
+        for op, (nbytes, fn) in ops.items():
+            gbps = nbytes / _best_s(fn) / 1e9
+            base.setdefault(op, gbps)
+            speedup = gbps / base[op]
+            print(
+                f"{t:>7d} {op:<18} {gbps:>7.2f} {speedup:>6.2f}x "
+                f"{roof:>13.2f} {100 * gbps / roof:>5.1f}%"
+            )
+            sweep.append(
+                {
+                    "threads": t,
+                    "op": op,
+                    "gbps": round(gbps, 3),
+                    "speedup_vs_1": round(speedup, 3),
+                    "memcpy_roofline_gbps": round(roof, 3),
+                    "roofline_frac": round(gbps / roof, 4),
+                }
+            )
+    result = {
+        "mode": "threads-sweep",
+        "shape": {
+            "gb": args.gb,
+            "files": args.files,
+            "reducers": args.reducers,
+            "task_rows": task_rows,
+            "batch_mb": round(batch_bytes / 1e6, 1),
+        },
+        "host_cpus": os.cpu_count(),
+        "native": native.native_available(),
+        "sweep": sweep,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[sweep] wrote {args.out}", file=sys.stderr)
+    return 0
 
 
 def _phase_table(flat: dict) -> dict:
@@ -146,8 +297,20 @@ def main() -> int:
         default=None,
         help="dataset cache dir (default: .bench_cache/profile_* shape key)",
     )
+    parser.add_argument(
+        "--threads",
+        default=None,
+        help="comma list of kernel thread counts (e.g. 1,2,4): run the "
+        "ISOLATED kernel sweep (partition scatter / permute scatter / "
+        "gather at the bench task shape vs the N-core memcpy roofline) "
+        "instead of the pipeline profile",
+    )
     parser.add_argument("--out", default=None, help="also dump JSON here")
     args = parser.parse_args()
+
+    if args.threads:
+        thread_counts = [int(x) for x in args.threads.split(",") if x]
+        return run_threads_sweep(args, thread_counts)
 
     if args.schedule != "auto":
         os.environ["RSDL_INDEX_SHUFFLE"] = (
