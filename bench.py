@@ -144,6 +144,54 @@ def _target_context(platform: str, strict: bool = True) -> str:
     return "tunneled-tpu" if axon else "direct-tpu"
 
 
+def _attach_obs_summaries(result: dict) -> None:
+    """End-of-run straggler/skew summary + structured-event counts
+    (ISSUE 7), embedded on success AND watchdog/error paths (the PR-4
+    telemetry_final convention). Publishes the rsdl_straggler_* gauges
+    into the registry FIRST, so the subsequent aggregate() (the
+    telemetry_final embed) carries them; the compact dicts ride
+    alongside for humans. Pure file reads — safe on error paths."""
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
+
+    if not _m.enabled():
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import stragglers
+
+        analysis = stragglers.analyze()
+        stragglers.publish_metrics(analysis)
+        if analysis.get("tasks_total"):
+            result["stragglers"] = {
+                "tasks_total": analysis["tasks_total"],
+                "wedged": len(analysis.get("wedged", [])),
+                "flagged": analysis.get("flagged_total", 0),
+                "stages": {
+                    stage: {
+                        "count": st.get("count"),
+                        "median_s": st.get("median_s"),
+                        "p99_s": st.get("p99_s"),
+                        "skew_ratio": st.get("skew_ratio"),
+                        "slowest_host": st.get("slowest_host"),
+                    }
+                    for stage, st in analysis.get("stages", {}).items()
+                },
+            }
+    except Exception:
+        pass
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import events
+
+        by_kind = events.counts()
+        if by_kind:
+            result["events"] = by_kind
+            for kind, count in by_kind.items():
+                # Gauges (recomputed totals), so telemetry_final and a
+                # final scrape show rsdl_events_total{kind=...} too.
+                _m.registry.gauge("events.total", kind=kind).set(count)
+    except Exception:
+        pass
+
+
 def _error_result(platform, msg: str) -> dict:
     """The failure shape of the one-JSON-line contract (shared by the
     stall watchdog and main()'s last-resort handler so the contract has
@@ -168,6 +216,9 @@ def _error_result(platform, msg: str) -> dict:
         from ray_shuffling_data_loader_tpu.telemetry import metrics as _m
 
         if _m.enabled():
+            # Straggler/event summaries FIRST so their gauges land in
+            # the aggregate below (success path mirrors this ordering).
+            _attach_obs_summaries(result)
             # The CLUSTER view, not the driver-local one: worker/actor
             # registries already spooled at task-done/quiescence, and
             # aggregate() is a pure file read plus the local registry —
@@ -1930,11 +1981,13 @@ def main() -> None:
         # error path embeds them via _error_result) — worker map/reduce
         # counters spooled at task-done fold in here; the driver-local
         # snapshot alone would silently drop everything worker-side.
+        # Straggler/event summaries first, so their gauges fold in too.
         try:
             from ray_shuffling_data_loader_tpu.telemetry import (
                 export as _metrics_export,
             )
 
+            _attach_obs_summaries(result)
             result["telemetry_final"] = _metrics_export.aggregate()
         except Exception as exc:
             result["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:200]

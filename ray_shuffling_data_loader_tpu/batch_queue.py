@@ -57,6 +57,17 @@ class ProducerDiedError(Exception):
         return (ProducerDiedError, (self.epoch, self.rank))
 
 
+def _emit_producer_died(epoch: int, rank: int) -> None:
+    """Structured event-log record of a producer-liveness trip (the
+    consumer side is the only place that *detects* the death)."""
+    try:
+        from ray_shuffling_data_loader_tpu import telemetry
+
+        telemetry.emit_event("producer.died", epoch=epoch, rank=rank)
+    except Exception:
+        pass
+
+
 def _liveness_interval_s() -> float:
     """How long a blocking consumer waits between producer-liveness
     checks — the detection bound for :class:`ProducerDiedError`.
@@ -501,6 +512,7 @@ class BatchQueue:
                 return self.actor.call("get", rank, epoch, interval)
             except Empty:
                 if not self.actor.call("producer_alive", epoch):
+                    _emit_producer_died(epoch, rank)
                     raise ProducerDiedError(epoch, rank) from None
 
     async def get_async(self, rank, epoch, block=True, timeout=None) -> Any:
@@ -524,6 +536,7 @@ class BatchQueue:
                 return self.actor.call("get_batch", rank, epoch, interval)
             except Empty:
                 if not self.actor.call("producer_alive", epoch):
+                    _emit_producer_died(epoch, rank)
                     raise ProducerDiedError(epoch, rank) from None
 
     def put_nowait(self, rank, epoch, item) -> None:

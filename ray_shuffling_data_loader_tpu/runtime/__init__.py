@@ -127,33 +127,57 @@ def _maybe_start_obs_server(ctx: RuntimeContext) -> None:
     (spawned workers and task processes join with ``owner=False`` and
     inherit the same env; letting each of them bind the port would just
     race). A bind failure is logged inside maybe_start, never fatal."""
-    if not ctx.owner or not os.environ.get("RSDL_OBS_PORT"):
+    if not ctx.owner:
         return
-    try:
-        from ray_shuffling_data_loader_tpu.telemetry import obs_server
+    if os.environ.get("RSDL_OBS_PORT"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import obs_server
 
-        obs_server.maybe_start()
-    except Exception:
-        import logging
+            obs_server.maybe_start()
+        except Exception:
+            import logging
 
-        logging.getLogger(__name__).warning(
-            "obs server bring-up failed", exc_info=True
-        )
+            logging.getLogger(__name__).warning(
+                "obs server bring-up failed", exc_info=True
+            )
+    # The temporal half (ISSUE 7): the timeseries sampler runs with the
+    # endpoint (or headless under RSDL_TS=1) whenever metrics are on —
+    # it is what /timeseries, rsdl_top sparklines, and the straggler
+    # gauges' history come from. Same zero-overhead contract: no env
+    # set, no import, no thread.
+    if os.environ.get("RSDL_OBS_PORT") or os.environ.get("RSDL_TS"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import metrics
+            from ray_shuffling_data_loader_tpu.telemetry import timeseries
+
+            if metrics.enabled() and (
+                os.environ.get("RSDL_OBS_PORT") or timeseries.forced_on()
+            ):
+                timeseries.start()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "timeseries sampler bring-up failed", exc_info=True
+            )
 
 
 def _stop_obs_server() -> None:
-    """Stop the endpoint if (and only if) its module was ever loaded —
-    shutdown must not import http.server on runs that never served."""
+    """Stop the endpoint + timeseries sampler if (and only if) their
+    modules were ever loaded — shutdown must not import http.server
+    (or the temporal plane) on runs that never served."""
     import sys as _sys
 
-    mod = _sys.modules.get(
-        "ray_shuffling_data_loader_tpu.telemetry.obs_server"
-    )
-    if mod is not None:
-        try:
-            mod.stop()
-        except Exception:
-            pass
+    for name in (
+        "ray_shuffling_data_loader_tpu.telemetry.obs_server",
+        "ray_shuffling_data_loader_tpu.telemetry.timeseries",
+    ):
+        mod = _sys.modules.get(name)
+        if mod is not None:
+            try:
+                mod.stop()
+            except Exception:
+                pass
 
 
 def _new_session_dir() -> str:

@@ -648,6 +648,9 @@ class ClusterScheduler:
             # must read one per dead host, not one per racing task).
             return
         telemetry.metrics.safe_inc("recovery.agent_evictions")
+        telemetry.emit_event(
+            "agent.evicted", agent=str(getattr(agent, "address", None))
+        )
         if self.on_agent_dead is not None:
             try:
                 self.on_agent_dead(agent)
@@ -706,6 +709,11 @@ class ClusterScheduler:
                 # the rotation (bounded — every failure evicts an agent,
                 # and an empty rotation raises ActorDiedError above).
                 telemetry.metrics.safe_inc("recovery.task_failover")
+                telemetry.emit_event(
+                    "task.failover",
+                    fn=getattr(fn, "__name__", "task"),
+                    agent=str(getattr(agent, "address", None)),
+                )
 
     def submit(self, fn: Callable, *args, **kwargs) -> ClusterTaskFuture:
         inner = self._executor.submit(

@@ -70,6 +70,28 @@ def _default_spill_dir() -> str:
     return os.path.join(tempfile.gettempdir(), "rsdl-spill")
 
 
+_spill_event_last = 0.0
+_SPILL_EVENT_INTERVAL_S = 5.0
+
+
+def _emit_spill_event(nbytes: int) -> None:
+    """Structured event-log mark that the store hit its budget and
+    started spilling. Rate-limited: a budget-pinned run places *every*
+    segment on disk, and one event per 5 s per process tells the story
+    without flooding the log. Metrics-gated inside emit_event."""
+    global _spill_event_last
+    now = time.monotonic()
+    if now - _spill_event_last < _SPILL_EVENT_INTERVAL_S:
+        return
+    _spill_event_last = now
+    try:
+        from ray_shuffling_data_loader_tpu import telemetry
+
+        telemetry.emit_event("store.spill", nbytes=int(nbytes))
+    except Exception:
+        pass
+
+
 def _default_capacity_bytes(shm_dir: str) -> Optional[int]:
     """Session budget for shared-memory residency. ``RSDL_STORE_CAPACITY_BYTES``
     absolute, else ``RSDL_STORE_CAPACITY_FRACTION`` (default 0.8) of the
@@ -627,6 +649,7 @@ class ObjectStore:
             and nbytes + self._shm_session_bytes() > self.capacity_bytes
         ):
             os.makedirs(self.spill_dir, exist_ok=True)
+            _emit_spill_event(nbytes)
             return self.spill_dir
         # Count the imminent write against the cached estimate so rapid
         # placements between scans see each other.

@@ -16,6 +16,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -158,6 +159,43 @@ def wait(
     return done, pending
 
 
+def _record_task_done(fn, duration_s: float, trace_ctx) -> None:
+    """Feed the straggler detector one completed-task record
+    (ISSUE 7). Metrics-gated BEFORE the import so the disabled path
+    never loads the stragglers module; never raises."""
+    if not telemetry.metrics.enabled():
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import stragglers
+
+        epoch = (trace_ctx or {}).get("epoch")
+        stragglers.record_task(
+            getattr(fn, "__name__", "task"), duration_s, epoch=epoch
+        )
+    except Exception:
+        pass
+
+
+def _flush_telemetry_spools() -> None:
+    """The task-done spool barrier: trace, audit, metrics registry,
+    plus (metrics-gated, lazily imported) the event log and straggler
+    task records."""
+    telemetry.safe_flush()
+    telemetry.audit.safe_flush()
+    telemetry.export.safe_flush()
+    if telemetry.metrics.enabled():
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import (
+                events,
+                stragglers,
+            )
+
+            events.safe_flush()
+            stragglers.safe_flush()
+        except Exception:
+            pass
+
+
 def _worker_main(task_q, result_q, env: Dict[str, str]):
     import pickle
 
@@ -179,6 +217,8 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
                 os._exit(0)
 
     threading.Thread(target=_watch_parent, daemon=True).start()
+    import time as _time
+
     while True:
         item = task_q.get()
         if item is None:
@@ -192,25 +232,25 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
             # re-entered context give every task a runtime-layer span and
             # make in-task spans inherit (trial, epoch, ...).
             fn, args, kwargs, trace_ctx = pickle.loads(blob)
+            t0 = _time.perf_counter()
             with telemetry.propagated_span(
                 f"task:{getattr(fn, '__name__', 'task')}", trace_ctx
             ):
                 result = fn(*args, **kwargs)
+            _record_task_done(fn, _time.perf_counter() - t0, trace_ctx)
             # Flush BEFORE reporting done: by the time the caller can
             # observe the result, this task's spans, audit digest
-            # records, AND metrics-registry snapshot are on their spools
-            # (the driver's reconciler and the cluster metrics
-            # aggregator both rely on this ordering — all futures
-            # resolved implies all worker-side records visible; without
-            # the metrics flush, worker counters died with the pool).
-            telemetry.safe_flush()
-            telemetry.audit.safe_flush()
-            telemetry.export.safe_flush()
+            # records, event-log + task-duration records, AND
+            # metrics-registry snapshot are on their spools (the
+            # driver's reconciler, the cluster metrics aggregator, and
+            # the straggler detector all rely on this ordering — all
+            # futures resolved implies all worker-side records visible;
+            # without the metrics flush, worker counters died with the
+            # pool).
+            _flush_telemetry_spools()
             result_q.put(("done", task_id, result, None))
         except Exception as exc:
-            telemetry.safe_flush()
-            telemetry.audit.safe_flush()
-            telemetry.export.safe_flush()
+            _flush_telemetry_spools()
             result_q.put(
                 (
                     "done",
@@ -253,12 +293,30 @@ class WorkerPool:
         self._futures: Dict[int, TaskFuture] = {}
         self._futures_lock = threading.Lock()
         self._running_on: Dict[int, int] = {}  # task_id -> worker pid
+        self._task_names: Dict[int, str] = {}  # task_id -> fn name
+        self._started: Dict[int, float] = {}  # task_id -> start monotonic
         self._next_id = 0
         self._closed = False
         self._collector = threading.Thread(target=self._collect, daemon=True)
         self._collector.start()
         self._watchdog = threading.Thread(target=self._watch, daemon=True)
         self._watchdog.start()
+        # Publish the live in-flight view to the straggler detector
+        # (ISSUE 7): which task functions started when, on which worker
+        # pid — the feed the wedged-worker flag needs. Metrics-gated
+        # before the import, like every temporal-plane touchpoint.
+        self._inflight_name = f"pool-{id(self)}"
+        if telemetry.metrics.enabled():
+            try:
+                from ray_shuffling_data_loader_tpu.telemetry import (
+                    stragglers,
+                )
+
+                stragglers.register_inflight_provider(
+                    self._inflight_name, self.in_flight
+                )
+            except Exception:
+                pass
 
     def _collect(self):
         while True:
@@ -272,11 +330,14 @@ class WorkerPool:
                 _, task_id, pid = item
                 with self._futures_lock:
                     self._running_on[task_id] = pid
+                    self._started[task_id] = time.monotonic()
                 continue
             _, task_id, result, error = item
             with self._futures_lock:
                 fut = self._futures.pop(task_id, None)
                 self._running_on.pop(task_id, None)
+                self._started.pop(task_id, None)
+                self._task_names.pop(task_id, None)
             if fut is not None:
                 fut._fulfill(result, error)
 
@@ -302,12 +363,30 @@ class WorkerPool:
                 for tid, pid in lost:
                     fut = self._futures.pop(tid, None)
                     self._running_on.pop(tid, None)
+                    self._started.pop(tid, None)
+                    self._task_names.pop(tid, None)
                     if fut is not None:
                         futs.append((fut, pid))
             for fut, pid in futs:
                 fut._fulfill(
                     None, f"worker process {pid} died while running this task"
                 )
+
+    def in_flight(self) -> List[Dict[str, Any]]:
+        """The live in-flight task view the straggler detector folds:
+        one entry per started-but-unfinished task with its function
+        name, worker pid, and age."""
+        now = time.monotonic()
+        with self._futures_lock:
+            return [
+                {
+                    "stage": self._task_names.get(tid, "task"),
+                    "pid": pid,
+                    "age_s": now - self._started[tid],
+                }
+                for tid, pid in self._running_on.items()
+                if tid in self._started
+            ]
 
     def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
         """Locality-aware submit surface shared with the cluster scheduler;
@@ -332,6 +411,7 @@ class WorkerPool:
             self._next_id += 1
             fut = TaskFuture(task_id)
             self._futures[task_id] = fut
+            self._task_names[task_id] = getattr(fn, "__name__", "task")
         self._task_q.put((task_id, blob))
         return fut
 
@@ -339,6 +419,18 @@ class WorkerPool:
         if self._closed:
             return
         self._closed = True
+        # Unregister only if the module was ever loaded — shutdown on a
+        # telemetry-off run must not import the temporal plane.
+        import sys as _sys
+
+        stragglers = _sys.modules.get(
+            "ray_shuffling_data_loader_tpu.telemetry.stragglers"
+        )
+        if stragglers is not None:
+            try:
+                stragglers.unregister_inflight_provider(self._inflight_name)
+            except Exception:
+                pass
         for _ in self._procs:
             try:
                 self._task_q.put(None)

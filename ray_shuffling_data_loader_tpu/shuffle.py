@@ -81,8 +81,11 @@ class StageFailedError(TaskError):
 
 def _count_recovery(name: str, **labels) -> None:
     """``recovery.*`` counter increment, metrics-gated and never raising
-    into the data path."""
+    into the data path. Each increment also lands in the structured
+    event log (with the ambient epoch context) so the obs plane can
+    answer *when* recovery work happened, not just how much."""
     _metrics.safe_inc(name, **labels)
+    telemetry.emit_event("recovery", counter=name, **labels)
 
 
 # ---------------------------------------------------------------------------
@@ -1274,6 +1277,10 @@ def shuffle_epoch(
     if schedule_log is not None:
         schedule_log.append((epoch, schedule))
     _status_epoch(epoch, state="running", schedule=schedule)
+    telemetry.emit_event(
+        "epoch.start", epoch=epoch, schedule=schedule,
+        files=len(filenames), reducers=num_reducers,
+    )
     map_futs: List[TaskFuture] = []
     map_published: List[bool] = []
     # Trace context for everything this epoch submits from THIS thread:
@@ -1452,6 +1459,11 @@ def shuffle_epoch(
                         f"{attempt} attempts:\n{exc}",
                     ) from exc
                 _count_recovery("recovery.stage_retries", stage="map")
+                telemetry.emit_event(
+                    "stage.retry", stage="map", epoch=epoch,
+                    attempt=attempt, file=i,
+                    error=f"{exc.error_type or type(exc).__name__}",
+                )
                 backoff.backoff(str(exc))
                 _recover_lost_cache(exc.lost_object_id)
                 fut = _resubmit_map(i, publish=published)
@@ -1621,6 +1633,13 @@ def shuffle_epoch(
                             _count_recovery(
                                 "recovery.stage_retries", stage="reduce"
                             )
+                            telemetry.emit_event(
+                                "stage.retry", stage="reduce", epoch=epoch,
+                                attempt=attempt, reducer=r,
+                                error=(
+                                    f"{exc.error_type or type(exc).__name__}"
+                                ),
+                            )
                             backoff.backoff(str(exc))
                             lost = exc.lost_object_id
                             if lost is not None and lost in lineage:
@@ -1675,9 +1694,17 @@ def shuffle_epoch(
         except BaseException as exc:
             thread.error = exc
         finally:
-            _status_epoch(
-                epoch, state="failed" if thread.error is not None else "done"
-            )
+            failed = thread.error is not None
+            _status_epoch(epoch, state="failed" if failed else "done")
+            if failed:
+                telemetry.emit_event(
+                    "epoch.failed", _flush=True, epoch=epoch,
+                    error=(
+                        f"{type(thread.error).__name__}: {thread.error}"
+                    )[:200],
+                )
+            else:
+                telemetry.emit_event("epoch.done", epoch=epoch, _flush=True)
             # Every rank gets its done sentinel even on failure (or when it
             # was assigned zero reducers): consumers must unblock; the
             # driver re-raises the stored error after joining.
@@ -1732,6 +1759,11 @@ def shuffle(
     runtime.ensure_initialized()
     _status_begin_trial(
         num_epochs, len(filenames), num_reducers, num_trainers, start_epoch
+    )
+    telemetry.emit_event(
+        "trial.start", epochs=num_epochs, files=len(filenames),
+        reducers=num_reducers, trainers=num_trainers,
+        start_epoch=start_epoch,
     )
     if os.environ.get("RSDL_OBS_PORT"):
         # Publish the live trial view to the obs endpoint. Registration
@@ -1810,9 +1842,16 @@ def shuffle(
             )
     except BaseException as exc:
         _status_end_trial(error=f"{type(exc).__name__}: {exc}")
+        telemetry.emit_event(
+            "trial.failed", _flush=True,
+            error=f"{type(exc).__name__}: {exc}"[:200],
+        )
         raise
     _status_end_trial()
     duration = timeit.default_timer() - start
+    telemetry.emit_event(
+        "trial.done", duration_s=round(duration, 3), _flush=True
+    )
     if stats_collector is not None:
         stats_collector.call_oneway("trial_done", duration)
     return duration

@@ -63,10 +63,33 @@ from ray_shuffling_data_loader_tpu.telemetry import metrics  # noqa: F401
 from ray_shuffling_data_loader_tpu.telemetry import audit  # noqa: F401
 from ray_shuffling_data_loader_tpu.telemetry import export  # noqa: F401
 
-# NOTE: obs_server (the /metrics //healthz //status endpoint) is NOT
-# imported here — it is lazily imported by runtime.init() only when
-# RSDL_OBS_PORT is set, so the off-by-default path never even loads
-# http.server.
+# NOTE: obs_server (the /metrics //healthz //status endpoint) and the
+# temporal plane (events / timeseries / stragglers, ISSUE 7) are NOT
+# imported here — obs_server is lazily imported by runtime.init() only
+# when RSDL_OBS_PORT is set, and the temporal modules only load on the
+# first metrics-enabled use (emit_event below / the task-done flush in
+# runtime/tasks.py), so the off-by-default path pays no import cost.
 
 metrics_snapshot = metrics.global_snapshot
 metrics_dump = metrics.dump_json
+
+
+def emit_event(kind: str, _flush: bool = False, **fields) -> None:
+    """Record one structured event (:mod:`.events`) — the lazy facade
+    every wiring site calls: when ``RSDL_METRICS`` is off this is one
+    cached boolean check and the events module is never imported.
+    ``_flush=True`` drains the buffer to the spool right away — used at
+    trial/epoch boundaries so a long-lived driver's lifecycle events
+    are durable (and joinable by a post-hoc epoch report) without
+    waiting for the buffer high-water mark or atexit. Never raises
+    into the caller's data path."""
+    if not metrics.enabled():
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import events
+
+        events.emit(kind, **fields)
+        if _flush:
+            events.safe_flush()
+    except Exception:
+        pass

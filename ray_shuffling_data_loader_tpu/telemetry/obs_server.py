@@ -1,4 +1,5 @@
-"""Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``.
+"""Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``,
+``/timeseries``, ``/events``, ``/stragglers``.
 
 One stdlib ``http.server`` on a daemon thread inside the driver process,
 env-gated by ``RSDL_OBS_PORT`` — so a running shuffle can be *watched*
@@ -13,14 +14,31 @@ Endpoints:
   spooled snapshot + the driver's live registry, merged per-kind by
   :mod:`.export`) rendered as Prometheus exposition text with
   ``# TYPE`` lines and per-source (``source=<role>-<pid>``) breakdown.
-  Point a stock Prometheus at it.
+  Point a stock Prometheus at it. Self-observability rides along:
+  ``rsdl_up``, an ``rsdl_obs_build_info`` gauge (version / python /
+  session labels), and ``rsdl_obs_scrape_duration_seconds`` — so a
+  dashboard can alert on a dead or slow obs server, not just on the
+  pipeline it watches.
 * ``GET /healthz`` — liveness JSON: the server itself, the spool's
   producer sources (age + staleness per process), and the epoch-window
   state from the registered status providers.
 * ``GET /status`` — the operator view: in-flight epochs, per-epoch
   delivery progress (``shuffle.py``'s provider), per-``(epoch, rank)``
   queue depths (batch-queue provider + ``queue.depth`` gauges), store
-  bytes/spill, ``recovery.*`` counters, and the latest audit verdicts.
+  bytes/spill, ``recovery.*`` counters, the latest audit verdicts,
+  plus (ISSUE 7) the straggler/skew summary and recent-event counts.
+* ``GET /timeseries?name=&window=&step=`` — the temporal plane
+  (:mod:`.timeseries`): per-key rate/level series from the sampler's
+  ring buffer, counter deltas already turned into rates. ``name``
+  accepts either registry names (``shuffle.map_rows``) or their
+  Prometheus aliases (``rsdl_shuffle_map_rows``); ``sources=1``
+  includes the per-source breakdown keys.
+* ``GET /events?since=&kind=&limit=`` — the structured event log
+  (:mod:`.events`): epoch starts, stage retries, recoveries,
+  failovers, spills, producer deaths, evictions — newest last.
+* ``GET /stragglers`` — the full straggler/skew analysis
+  (:mod:`.stragglers`): per-stage p99/median skew, slowest-host
+  attribution, flagged outliers, and live wedged-worker flags.
 
 **Status providers** are how subsystems publish live state without this
 module knowing about them: ``register_status_provider(name, fn)`` where
@@ -45,8 +63,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_shuffling_data_loader_tpu.telemetry import events as _events
 from ray_shuffling_data_loader_tpu.telemetry import export as _export
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+from ray_shuffling_data_loader_tpu.telemetry import stragglers as _stragglers
+from ray_shuffling_data_loader_tpu.telemetry import timeseries as _timeseries
 
 ENV_OBS_PORT = "RSDL_OBS_PORT"
 ENV_OBS_HOST = "RSDL_OBS_HOST"
@@ -132,6 +153,53 @@ def _stale_cutoff() -> Optional[float]:
 
 def _metrics_text() -> str:
     return _export.prometheus_text(max_age_s=_stale_cutoff())
+
+
+def _self_metrics_text(scrape_s: float) -> str:
+    """The obs server's self-observability block, appended to every
+    ``/metrics`` response: ``rsdl_up 1`` (the canonical is-it-alive
+    series — its *absence* from a scrape is the alert), a build/session
+    info gauge, and the duration of this very scrape (a slow scrape
+    means a bloated spool or a wedged page build — alertable before it
+    becomes an outage). Rendered directly (not via the registry) so a
+    metrics-off server still reports itself; the histogram observe
+    below additionally gives the scrape time a timeseries history when
+    metrics are on."""
+    import platform as _platform
+    import sys as _sys
+
+    if _metrics.enabled():
+        try:
+            _metrics.registry.histogram("obs.scrape_seconds").observe(
+                scrape_s
+            )
+        except Exception:
+            pass
+    try:
+        from ray_shuffling_data_loader_tpu import __version__ as _version
+    except Exception:
+        _version = "unknown"
+    session = ""
+    try:
+        from ray_shuffling_data_loader_tpu import runtime as _runtime
+
+        if _runtime.is_initialized():
+            session = _runtime.get_context().session
+    except Exception:
+        pass
+    python = "%d.%d.%d" % _sys.version_info[:3]
+    uptime = round(time.time() - (_started_ts or time.time()), 1)
+    return (
+        "# TYPE rsdl_up gauge\n"
+        "rsdl_up 1\n"
+        "# TYPE rsdl_obs_build_info gauge\n"
+        f'rsdl_obs_build_info{{version="{_version}",python="{python}",'
+        f'platform="{_platform.system()}",session="{session}"}} 1\n'
+        "# TYPE rsdl_obs_uptime_seconds gauge\n"
+        f"rsdl_obs_uptime_seconds {uptime}\n"
+        "# TYPE rsdl_obs_scrape_duration_seconds gauge\n"
+        f"rsdl_obs_scrape_duration_seconds {scrape_s:.6f}\n"
+    )
 
 
 def _source_health() -> list:
@@ -233,7 +301,73 @@ def _status_body() -> dict:
             }
     except Exception:
         pass
+    # The temporal plane (ISSUE 7): straggler summary + recent events.
+    # Guarded like the providers — a broken section reports its error
+    # string instead of breaking the page.
+    try:
+        status["stragglers"] = _stragglers.status_section()
+    except Exception as exc:
+        status["stragglers"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:200]
+        }
+    try:
+        # One spool read serves both views (load is O(total events)).
+        records = _events.load()
+        status["events"] = {
+            "by_kind": _events.counts(records),
+            "latest": records[-8:],
+        }
+    except Exception as exc:
+        status["events"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     return status
+
+
+def _qparam(params: Dict[str, list], name: str, cast, default=None):
+    """Last value of one query-string param, cast, defaulting on
+    absence or a bad value (shared by the JSON endpoints)."""
+    values = params.get(name)
+    if not values or not values[-1]:
+        return default
+    try:
+        return cast(values[-1])
+    except (TypeError, ValueError):
+        return default
+
+
+def _timeseries_body(params: Dict[str, list]) -> dict:
+    name = _qparam(params, "name", str)
+    window_s = _qparam(params, "window", float)
+    step_s = _qparam(params, "step", float)
+    include_sources = bool(_qparam(params, "sources", int, 0))
+    series = _timeseries.series(
+        name=name,
+        window_s=window_s,
+        step_s=step_s,
+        include_sources=include_sources,
+    )
+    return {
+        "name": name,
+        "window_s": window_s,
+        "step_s": step_s,
+        "period_s": _timeseries.period_s(),
+        "sampler_running": _timeseries.running(),
+        "samples": len(_timeseries.samples()),
+        "series": series,
+    }
+
+
+def _events_body(params: Dict[str, list]) -> dict:
+    since = _qparam(params, "since", float)
+    kind = _qparam(params, "kind", str)
+    limit = _qparam(params, "limit", int, 200)
+    records = _events.load(since=since, kind=kind, limit=limit)
+    return {
+        "since": since,
+        "kind": kind,
+        "count": len(records),
+        "by_kind": _events.counts(records),
+        "events": records,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -257,13 +391,19 @@ def _make_handler():
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 — stdlib handler contract
-            path = self.path.split("?", 1)[0]
+            from urllib.parse import parse_qs
+
+            path, _, query = self.path.partition("?")
+            params = parse_qs(query) if query else {}
             try:
                 if path == "/metrics":
+                    t0 = time.perf_counter()
+                    body = _metrics_text()
+                    body += _self_metrics_text(time.perf_counter() - t0)
                     self._send(
                         200,
                         "text/plain; version=0.0.4; charset=utf-8",
-                        _metrics_text().encode(),
+                        body.encode(),
                     )
                 elif path == "/healthz":
                     self._send(
@@ -276,6 +416,30 @@ def _make_handler():
                         200,
                         "application/json",
                         json.dumps(_status_body(), default=str).encode(),
+                    )
+                elif path == "/timeseries":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _timeseries_body(params), default=str
+                        ).encode(),
+                    )
+                elif path == "/events":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _events_body(params), default=str
+                        ).encode(),
+                    )
+                elif path == "/stragglers":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _stragglers.analyze(), default=str
+                        ).encode(),
                     )
                 else:
                     self._send(404, "text/plain", b"not found\n")

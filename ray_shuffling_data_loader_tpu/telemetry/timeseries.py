@@ -1,0 +1,365 @@
+"""Time-series history of the aggregated metrics registry.
+
+The PR-4 obs plane is point-in-time: ``/metrics`` folds the latest
+spools into *cumulative* values, so "is map throughput dropping?" and
+"what was the queue depth two minutes ago?" are unanswerable live —
+exactly the signals an autoscaling policy (ROADMAP item 5) and the
+``rsdl_top`` dashboard need. This module is the temporal half: a
+driver-side sampler thread that periodically snapshots the aggregated
+registry (reusing :func:`.export.aggregate_typed` — the same merge
+the ``/metrics`` page serves, per-source breakdown included) into a
+fixed-size in-memory **ring buffer**, deriving per-kind temporal
+views:
+
+* **counters** become *rates*: ``(cur - prev) / dt``, with counter
+  **reset** handling — a source restart (new pid, or a cleared spool)
+  can only lower the merged cumulative value, and a negative rate
+  would poison every dashboard ratio, so a decrease is treated as a
+  restart-from-zero (``delta = cur``), mirroring Prometheus
+  ``rate()``;
+* **gauges** keep their last value per sample (the merge already
+  applied latest-by-timestamp semantics);
+* **histograms** keep the cumulative components plus the *windowed*
+  view over the step: observation rate (``Δcount/dt``) and windowed
+  mean (``Δsum/Δcount``) — min/max stay cumulative (component merges
+  cannot be un-merged into true windowed quantiles; the windowed mean
+  + cumulative envelope is what the components support).
+
+Samples are **persisted append-only** as NDJSON under
+``<metrics spool>/ts/timeseries.ndjson`` so the history survives the
+sampler process and ``tools/epoch_report.py`` can join it post-hoc,
+and served live by :mod:`.obs_server` as
+``/timeseries?name=&window=&step=``.
+
+Lifecycle: the runtime session owner starts the sampler at obs-plane
+bring-up (``RSDL_OBS_PORT`` set AND metrics on — or ``RSDL_TS=1`` to
+force it headless) and stops it at session shutdown. Zero overhead
+when off: no thread, no file, and this module is never imported.
+
+Knobs: ``RSDL_TS_PERIOD_S`` (sample period, default 2 s),
+``RSDL_TS_SAMPLES`` (ring capacity, default 900 — 30 min at 2 s).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_shuffling_data_loader_tpu.telemetry import export as _export
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+ENV_TS = "RSDL_TS"
+ENV_TS_PERIOD_S = "RSDL_TS_PERIOD_S"
+ENV_TS_SAMPLES = "RSDL_TS_SAMPLES"
+
+_DEFAULT_PERIOD_S = 2.0
+_DEFAULT_SAMPLES = 900
+
+_lock = threading.Lock()
+_ring: List[dict] = []
+_capacity: Optional[int] = None
+_prev: Dict[str, Dict[str, float]] = {}  # key -> last cumulative components
+_prev_ts: Optional[float] = None
+_thread: Optional[threading.Thread] = None
+_stop_event: Optional[threading.Event] = None
+_persist_error = False
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def period_s() -> float:
+    value = _env_float(ENV_TS_PERIOD_S, _DEFAULT_PERIOD_S)
+    return max(0.1, value)
+
+
+def capacity() -> int:
+    global _capacity
+    if _capacity is None:
+        _capacity = max(2, int(_env_float(ENV_TS_SAMPLES, _DEFAULT_SAMPLES)))
+    return _capacity
+
+
+def persist_path() -> Optional[str]:
+    """Where samples append: ``<metrics spool>/ts/timeseries.ndjson`` —
+    riding the metrics spool dir keeps one ``RSDL_METRICS_DIR``
+    override relocating the whole plane. None disables persistence."""
+    directory = _export.spool_dir()
+    if not directory:
+        return None
+    return os.path.join(directory, "ts", "timeseries.ndjson")
+
+
+def reset(capacity_override: Optional[int] = None) -> None:
+    """Drop the ring, rate state, and cached capacity (tests and run
+    boundaries); ``capacity_override`` pins a small ring for
+    wraparound tests."""
+    global _capacity, _prev_ts, _persist_error
+    with _lock:
+        _ring.clear()
+        _prev.clear()
+        _prev_ts = None
+        _capacity = capacity_override
+        _persist_error = False
+
+
+# ---------------------------------------------------------------------------
+# Sampling
+# ---------------------------------------------------------------------------
+
+
+def _delta(cur: float, prev: float) -> float:
+    """Counter delta with reset handling: a decrease means the merged
+    source set restarted (pid change dropping a spool file, cleared
+    spool) — count from zero, never negative."""
+    return cur - prev if cur >= prev else cur
+
+
+def _build_sample(
+    typed: Dict[str, Dict[str, Any]], now: float, dt: Optional[float]
+) -> dict:
+    metrics_out: Dict[str, Dict[str, Any]] = {}
+    for key, entry in typed.items():
+        kind = entry.get("kind")
+        if kind == "counter":
+            value = float(entry.get("value", 0.0))
+            out: Dict[str, Any] = {"kind": "counter", "value": value}
+            prev = _prev.get(key)
+            if prev is not None and dt:
+                out["rate"] = max(0.0, _delta(value, prev["value"])) / dt
+            _prev[key] = {"value": value}
+            metrics_out[key] = out
+        elif kind == "gauge":
+            metrics_out[key] = {
+                "kind": "gauge",
+                "value": float(entry.get("value", 0.0)),
+            }
+        elif kind == "histogram":
+            count = float(entry.get("count", 0))
+            total = float(entry.get("sum", 0.0))
+            out = {"kind": "histogram", "count": count, "sum": total}
+            for field in ("min", "max"):
+                if field in entry:
+                    out[field] = float(entry[field])
+            prev = _prev.get(key)
+            if prev is not None and dt:
+                dcount = max(0.0, _delta(count, prev["value"]))
+                dsum = _delta(total, prev.get("sum", 0.0))
+                out["rate"] = dcount / dt
+                if dcount > 0:
+                    out["window_mean"] = max(0.0, dsum) / dcount
+            _prev[key] = {"value": count, "sum": total}
+            metrics_out[key] = out
+    return {"ts": now, "dt": dt, "metrics": metrics_out}
+
+
+def _persist(sample: dict) -> None:
+    global _persist_error
+    if _persist_error:
+        return  # one failure (full/readonly disk) disables, not spams
+    path = persist_path()
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(sample) + "\n")
+    except OSError:
+        _persist_error = True
+
+
+def sample_now(now: Optional[float] = None) -> dict:
+    """Take one sample: aggregate the registry (spools + local), derive
+    rates against the previous sample, append to the ring, persist.
+    Returns the sample (tests assert on it directly)."""
+    global _prev_ts
+    now = time.time() if now is None else float(now)
+    typed = _export.aggregate_typed(per_source=True)
+    with _lock:
+        dt = None if _prev_ts is None else max(1e-9, now - _prev_ts)
+        sample = _build_sample(typed, now, dt)
+        _prev_ts = now
+        _ring.append(sample)
+        cap = capacity()
+        while len(_ring) > cap:
+            _ring.pop(0)
+    _persist(sample)
+    return sample
+
+
+def samples() -> List[dict]:
+    with _lock:
+        return list(_ring)
+
+
+def load_persisted(path: Optional[str] = None) -> List[dict]:
+    """Samples from the append-only file (post-hoc tools running in a
+    different process than the sampler). Torn tail lines are skipped."""
+    path = path or persist_path()
+    out: List[dict] = []
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "metrics" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Query
+# ---------------------------------------------------------------------------
+
+
+_PROM_CACHE: Dict[str, str] = {}
+
+
+def _prom_name(base: str) -> str:
+    """The Prometheus-rendered name of a registry key's base name —
+    accepted as a query alias so ``/timeseries?name=`` takes the same
+    names a scrape of ``/metrics`` shows."""
+    cached = _PROM_CACHE.get(base)
+    if cached is None:
+        import re
+
+        cached = re.sub(r"[^a-zA-Z0-9_:]", "_", base)
+        if not cached.startswith("rsdl_"):
+            cached = "rsdl_" + cached
+        _PROM_CACHE[base] = cached
+    return cached
+
+
+def _key_base(key: str) -> str:
+    brace = key.find("{")
+    return key if brace < 0 else key[:brace]
+
+
+def _key_matches(key: str, name: Optional[str]) -> bool:
+    if not name:
+        return True
+    base = _key_base(key)
+    return name == base or name == _prom_name(base) or name == key
+
+
+def series(
+    name: Optional[str] = None,
+    window_s: Optional[float] = None,
+    step_s: Optional[float] = None,
+    include_sources: bool = False,
+    now: Optional[float] = None,
+) -> Dict[str, List[dict]]:
+    """Per-key point lists from the ring: ``{key: [{"ts", "value",
+    "rate", ...}, ...]}``. ``name`` matches the registry key base name
+    OR its Prometheus alias (``shuffle.map_rows`` ==
+    ``rsdl_shuffle_map_rows``); ``window_s`` keeps the trailing
+    window; ``step_s`` downsamples to at most one point per step.
+    ``source=``-labeled per-source keys are excluded unless asked for
+    (they multiply the payload by the process count)."""
+    now = time.time() if now is None else float(now)
+    cutoff = None if not window_s else now - float(window_s)
+    out: Dict[str, List[dict]] = {}
+    last_kept: Dict[str, float] = {}
+    for sample in samples():
+        ts = float(sample.get("ts", 0.0))
+        if cutoff is not None and ts < cutoff:
+            continue
+        for key, entry in sample.get("metrics", {}).items():
+            if not include_sources and "source=" in key:
+                continue
+            if not _key_matches(key, name):
+                continue
+            if step_s and key in last_kept and (
+                ts - last_kept[key] < float(step_s)
+            ):
+                continue
+            last_kept[key] = ts
+            point = {"ts": ts}
+            for field in ("value", "rate", "count", "sum",
+                          "window_mean", "min", "max"):
+                if field in entry:
+                    point[field] = entry[field]
+            out.setdefault(key, []).append(point)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sampler thread lifecycle
+# ---------------------------------------------------------------------------
+
+
+def running() -> bool:
+    return _thread is not None and _thread.is_alive()
+
+
+def start(period: Optional[float] = None) -> None:
+    """Start the sampler daemon thread (idempotent). Call from the
+    session owner only — one sampler per spool, like the obs server."""
+    global _thread, _stop_event
+    if not _metrics.enabled():
+        return
+    interval = period_s() if period is None else max(0.1, float(period))
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return
+        stop_event = threading.Event()
+        _stop_event = stop_event
+
+        def _loop():
+            while not stop_event.wait(interval):
+                try:
+                    # Fold the latest straggler view in first so the
+                    # rsdl_straggler_* gauges have history too.
+                    from ray_shuffling_data_loader_tpu.telemetry import (
+                        stragglers as _stragglers,
+                    )
+
+                    _stragglers.publish_metrics()
+                except Exception:
+                    pass
+                try:
+                    sample_now()
+                except Exception:
+                    pass  # telemetry must never sink anything
+
+        _thread = threading.Thread(
+            target=_loop, name="rsdl-ts-sampler", daemon=True
+        )
+        _thread.start()
+
+
+def stop() -> None:
+    """Stop the sampler and join its thread (session shutdown, tests).
+    The ring and persisted file stay — history outlives the sampler."""
+    global _thread, _stop_event
+    with _lock:
+        thread, _thread = _thread, None
+        stop_event, _stop_event = _stop_event, None
+    if stop_event is not None:
+        stop_event.set()
+    if thread is not None:
+        thread.join(timeout=5.0)
+
+
+def forced_on() -> bool:
+    """``RSDL_TS=1`` forces the sampler on without an obs port (headless
+    history for a post-hoc epoch report)."""
+    from ray_shuffling_data_loader_tpu.telemetry import _env
+
+    return _env.read_flag(ENV_TS)

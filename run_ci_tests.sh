@@ -76,6 +76,13 @@ if [ "$tier" != "slow" ]; then
     echo "epoch_report failed to flag the injected regression" >&2
     exit 1
   fi
+  # Temporal-obs smoke (ISSUE 7), exit-code gated: against a MID-FLIGHT
+  # shuffle with the obs endpoint up, /timeseries must serve a non-empty
+  # rate series for rsdl_shuffle_map_rows, `rsdl_top --once --json` must
+  # render a frame from the live endpoint, and /events must carry the
+  # full epoch lifecycle afterwards (tools/obs_smoke.py asserts all
+  # three; its exit code is the gate).
+  RSDL_METRICS=1 python tools/obs_smoke.py
   # TCP-plane lane (ISSUE 5/6): the two-process loopback "two-host"
   # bench at a small shape — a worker host joins over real TCP (own shm
   # dir), the windowed-fetch microbench runs all framings (legacy

@@ -159,3 +159,134 @@ def test_empty_inputs_exit_3(epoch_report, tmp_path):
     empty.write_text(json.dumps({"traceEvents": []}))
     rc = epoch_report.main(["--trace", str(empty)])
     assert rc == 3
+
+
+# ---------------------------------------------------------------------------
+# Temporal-plane joins (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _ndjson(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_absent_temporal_artifact_is_informational(
+    epoch_report, tmp_path, capsys
+):
+    """A temporal artifact that was never produced (path absent — the
+    plane was off) is a NOTE, not a failure: the report still exits 0
+    on otherwise-good inputs."""
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps({"value": 1.0, "stall_pct": 1.0}))
+    rc = epoch_report.main(
+        [
+            "--bench", str(bench),
+            "--events", str(tmp_path / "never-written"),
+            "--task-records", str(tmp_path / "also-never"),
+            "--timeseries", str(tmp_path / "nope"),
+        ]
+    )
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "informational" in err and "no events present" in err
+
+
+def test_present_but_empty_temporal_artifact_exits_3(
+    epoch_report, tmp_path, capsys
+):
+    """The zero-coverage rule: an events spool that exists but holds
+    zero records means the plane was ON and recorded nothing — that
+    must not gate green, even when the bench numbers look fine."""
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps({"value": 1.0, "stall_pct": 1.0}))
+    spool = tmp_path / "events"
+    spool.mkdir()
+    _ndjson(str(spool / "events-123.ndjson"), [])
+    rc = epoch_report.main(
+        ["--bench", str(bench), "--events", str(spool)]
+    )
+    err = capsys.readouterr().err
+    assert rc == 3
+    assert "present but empty" in err
+
+
+def test_events_join_and_straggler_table(epoch_report, tmp_path, capsys):
+    """Events fold into per-epoch retry/recovery counts + a notable
+    list, and the task records render the per-epoch straggler table
+    with the outlier flagged."""
+    events_file = tmp_path / "events-1.ndjson"
+    _ndjson(
+        str(events_file),
+        [
+            {"ts": 10.0, "kind": "epoch.start", "epoch": 0},
+            {"ts": 11.0, "kind": "stage.retry", "epoch": 0,
+             "stage": "map", "attempt": 1},
+            {"ts": 12.0, "kind": "recovery", "epoch": 0,
+             "counter": "recovery.rematerialized"},
+            {"ts": 13.0, "kind": "epoch.done", "epoch": 0},
+        ],
+    )
+    tasks_file = tmp_path / "tasks-1.ndjson"
+    _ndjson(
+        str(tasks_file),
+        [
+            {"ts": 10.0, "stage": "reduce", "host": "hA", "pid": 1,
+             "epoch": 0, "dur_s": 0.2},
+            {"ts": 10.5, "stage": "reduce", "host": "hA", "pid": 1,
+             "epoch": 0, "dur_s": 0.25},
+            {"ts": 11.0, "stage": "reduce", "host": "hB", "pid": 2,
+             "epoch": 0, "dur_s": 0.21},
+            {"ts": 12.0, "stage": "reduce", "host": "hB", "pid": 2,
+             "epoch": 0, "dur_s": 5.0},
+        ],
+    )
+    rc = epoch_report.main(
+        [
+            "--events", str(events_file),
+            "--task-records", str(tasks_file),
+            "--json",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert report["header"]["events_by_kind"]["stage.retry"] == 1
+    row = next(r for r in report["epochs"] if r["epoch"] == 0)
+    assert row["retries"] == 1 and row["recoveries"] == 1
+    srow = report["stragglers"][0]
+    assert srow["stage"] == "reduce" and srow["tasks"] == 4
+    assert srow["flagged"] == 1
+    assert srow["slowest_host"] == "hB"
+    assert any(e["kind"] == "stage.retry" for e in report["events"])
+
+    # The rendered table names the straggler too.
+    rc = epoch_report.main(
+        ["--events", str(events_file), "--task-records", str(tasks_file)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "straggler table" in out and "STRAGGLER:" in out
+    assert "notable events" in out
+
+
+def test_timeseries_summary_in_header(epoch_report, tmp_path, capsys):
+    ts_file = tmp_path / "timeseries.ndjson"
+    _ndjson(
+        str(ts_file),
+        [
+            {"ts": 100.0, "dt": None, "metrics": {
+                "shuffle.map_rows": {"kind": "counter", "value": 10.0}}},
+            {"ts": 102.0, "dt": 2.0, "metrics": {
+                "shuffle.map_rows": {"kind": "counter", "value": 30.0,
+                                     "rate": 10.0}}},
+        ],
+    )
+    rc = epoch_report.main(["--timeseries", str(ts_file), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    header = json.loads(out)["header"]
+    assert header["timeseries"]["samples"] == 2
+    assert header["timeseries"]["span_s"] == 2.0
+    assert header["timeseries"]["map_rows_rate"]["max"] == 10.0

@@ -1,0 +1,131 @@
+"""Structured event-log tests (ISSUE 7): emit/flush/load/counts, trace
+context riding the records, the metrics-off no-op facade, and the
+flush-before-task-done ordering — a resolved task future implies the
+worker's events are already on the spool (function-scoped runtime, per
+the obs/chaos test convention)."""
+
+import os
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime, telemetry
+from ray_shuffling_data_loader_tpu.telemetry import events, metrics
+
+_ENV = ("RSDL_METRICS", "RSDL_METRICS_DIR", "RSDL_EVENTS_DIR",
+        "RSDL_OBS_PORT")
+
+
+@pytest.fixture
+def events_env(tmp_path):
+    saved = {k: os.environ.get(k) for k in _ENV}
+    spool = str(tmp_path / "events-spool")
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = str(tmp_path / "metrics-spool")
+    os.environ["RSDL_EVENTS_DIR"] = spool
+    os.environ.pop("RSDL_OBS_PORT", None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    events.reset(clear_spool=True)
+    yield spool
+    events.reset(clear_spool=True)
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+
+
+def test_emit_flush_load_counts(events_env):
+    events.emit("epoch.start", epoch=0, schedule="mapreduce")
+    events.emit("epoch.done", epoch=0)
+    events.emit("stage.retry", epoch=0, stage="map", attempt=1)
+    # Buffered records are visible without a flush (same-process load).
+    loaded = events.load()
+    assert [r["kind"] for r in loaded] == [
+        "epoch.start", "epoch.done", "stage.retry"
+    ]
+    events.flush()
+    fnames = os.listdir(events_env)
+    assert fnames == [f"events-{os.getpid()}.ndjson"]
+    # Spooled records load identically; identity stamped.
+    loaded = events.load()
+    assert len(loaded) == 3
+    assert loaded[0]["pid"] == os.getpid()
+    assert loaded[0]["role"] == "driver"
+    assert loaded[0]["schedule"] == "mapreduce"
+    assert events.counts() == {
+        "epoch.start": 1, "epoch.done": 1, "stage.retry": 1
+    }
+
+
+def test_load_filters(events_env):
+    t0 = time.time()
+    events.emit("a.one")
+    events.emit("a.two")
+    events.emit("a.two")
+    assert [r["kind"] for r in events.load(kind="a.two")] == [
+        "a.two", "a.two"
+    ]
+    assert len(events.load(since=t0 - 1)) == 3
+    assert events.load(since=time.time() + 60) == []
+    assert len(events.load(limit=2)) == 2
+
+
+def test_trace_context_rides_records(events_env):
+    with telemetry.context(trial=1, epoch=5):
+        events.emit("epoch.start")
+        # Explicit fields win over ambient context.
+        events.emit("epoch.start", epoch=6)
+    first, second = events.load()
+    assert first["trial"] == 1 and first["epoch"] == 5
+    assert second["epoch"] == 6
+
+
+def test_facade_noop_when_metrics_off(events_env):
+    metrics.disable()
+    telemetry.emit_event("should.not.appear")
+    events.emit("also.should.not.appear")
+    metrics.enable()
+    metrics.refresh_from_env()
+    assert events.load() == []
+    assert not os.path.isdir(events_env) or not os.listdir(events_env)
+
+
+def _emitting_task(payload):
+    """Worker-side task body: emits an event, does NOT flush — the
+    task-done path must."""
+    from ray_shuffling_data_loader_tpu import telemetry as t
+
+    t.emit_event("test.worker_event", payload=payload)
+    return payload * 2
+
+
+def test_event_flush_before_task_done(events_env, tmp_path):
+    """The ordering contract: by the time a task future resolves, the
+    worker's events are on the spool — no sleep, no polling."""
+    ctx = runtime.init(num_workers=1)
+    try:
+        fut = ctx.pool.submit(_emitting_task, 21)
+        assert fut.result(timeout=120) == 42
+        # Immediately after the result is observable, the record is
+        # loadable from the spool (written by the worker pid).
+        recs = events.load(kind="test.worker_event")
+        assert len(recs) == 1
+        assert recs[0]["payload"] == 21
+        assert recs[0]["pid"] != os.getpid()
+        assert recs[0]["role"] == "task"
+    finally:
+        runtime.shutdown()
+
+
+def test_torn_tail_line_skipped(events_env):
+    events.emit("whole.record")
+    events.flush()
+    path = os.path.join(events_env, f"events-{os.getpid()}.ndjson")
+    with open(path, "a") as f:
+        f.write('{"kind": "torn.rec')  # a crash mid-append
+    loaded = events.load()
+    assert [r["kind"] for r in loaded] == ["whole.record"]

@@ -239,6 +239,65 @@ def test_obs_server_pages(metrics_spool):
     assert not obs_server.running()
 
 
+def test_temporal_endpoints(metrics_spool):
+    """ISSUE 7 pages: /timeseries serves the sampler ring (rates under
+    both raw and Prometheus-alias names), /events the structured log,
+    /stragglers the skew analysis — and /metrics carries the
+    self-observability block."""
+    from ray_shuffling_data_loader_tpu.telemetry import (
+        events,
+        stragglers,
+        timeseries,
+    )
+
+    timeseries.reset()
+    events.reset(clear_spool=True)
+    stragglers.reset(clear_spool=True)
+    counter = metrics.registry.counter("shuffle.map_rows")
+    counter.inc(100)
+    timeseries.sample_now(now=1000.0)
+    counter.inc(100)
+    timeseries.sample_now(now=1002.0)
+    events.emit("epoch.start", epoch=0)
+    stragglers.record_task("shuffle_reduce", 0.5, epoch=0)
+    port = obs_server.start(0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _, body = _get(base + "/timeseries?name=rsdl_shuffle_map_rows")
+        ts = json.loads(body)
+        points = ts["series"]["shuffle.map_rows"]
+        assert points[-1]["value"] == 200.0
+        assert points[-1]["rate"] == pytest.approx(50.0)  # 100 rows / 2 s
+
+        _, body = _get(base + "/events?kind=epoch.start")
+        ev = json.loads(body)
+        assert ev["count"] == 1
+        assert ev["events"][0]["epoch"] == 0
+
+        _, body = _get(base + "/stragglers")
+        st = json.loads(body)
+        assert st["stages"]["reduce"]["count"] == 1
+
+        _, body = _get(base + "/status")
+        status = json.loads(body)
+        assert status["stragglers"]["tasks_total"] == 1
+        assert status["events"]["by_kind"] == {"epoch.start": 1}
+
+        _, text = _get(base + "/metrics")
+        assert "rsdl_up 1" in text
+        assert "rsdl_obs_build_info{" in text
+        assert "rsdl_obs_scrape_duration_seconds " in text
+        # Self-obs lines keep the one-sample-per-line contract.
+        for line in text.splitlines():
+            if line and not line.startswith("#"):
+                assert len(line.rsplit(" ", 1)) == 2
+    finally:
+        obs_server.stop()
+        timeseries.reset()
+        events.reset(clear_spool=True)
+        stragglers.reset(clear_spool=True)
+
+
 def test_no_server_without_env(metrics_spool):
     ctx = runtime.init(num_workers=1)
     try:

@@ -1,0 +1,272 @@
+"""Straggler/skew-attribution tests (ISSUE 7): the analysis fold
+(median/p99/skew, slowest-host attribution, flagged outliers), the
+wedged-worker flag from the in-flight feed, the straggler.* gauges,
+and the chaos integration — a ``wedge`` fault injected into a reduce
+task must be flagged by the live detector, appear in ``/status``, and
+land in the epoch-report straggler table (function-scoped runtimes,
+per the obs/chaos test convention)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import faults
+from ray_shuffling_data_loader_tpu.telemetry import metrics, stragglers
+
+_ENV = (
+    "RSDL_METRICS", "RSDL_METRICS_DIR", "RSDL_OBS_PORT",
+    "RSDL_FAULTS", "RSDL_FAULTS_SEED", "RSDL_FAULTS_WEDGE_S",
+    "RSDL_STRAGGLER_K", "RSDL_STRAGGLER_MIN_S",
+    "RSDL_AUDIT", "RSDL_AUDIT_DIR",
+)
+
+
+@pytest.fixture
+def straggler_env(tmp_path):
+    saved = {k: os.environ.get(k) for k in _ENV}
+    spool = str(tmp_path / "metrics-spool")
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = spool
+    for k in _ENV[2:]:
+        os.environ.pop(k, None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    stragglers.reset(clear_spool=True)
+    yield spool
+    stragglers.reset(clear_spool=True)
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+    faults.refresh_from_env()
+
+
+def _rec(stage, dur, host="hostA", pid=1, epoch=0, ts=None):
+    return {
+        "ts": ts if ts is not None else time.time(),
+        "stage": stage, "host": host, "pid": pid,
+        "epoch": epoch, "dur_s": dur,
+    }
+
+
+def test_analyze_skew_and_slowest_host(straggler_env):
+    records = (
+        [_rec("reduce", 0.1, host="hostA") for _ in range(8)]
+        + [_rec("reduce", 0.12, host="hostB") for _ in range(7)]
+        + [_rec("reduce", 6.0, host="hostB")]  # the outlier
+        + [_rec("map", 0.05) for _ in range(4)]
+    )
+    analysis = stragglers.analyze(records=records, in_flight=[])
+    reduce_st = analysis["stages"]["reduce"]
+    assert reduce_st["count"] == 16
+    assert reduce_st["median_s"] == pytest.approx(0.12, abs=0.02)
+    assert reduce_st["p99_s"] == pytest.approx(6.0)
+    assert reduce_st["skew_ratio"] > 10
+    assert reduce_st["slowest_host"] == "hostB"
+    # One flagged outlier: 6.0 > max(1.0, 4 x 0.12); the true count is
+    # carried separately from the (capped) sample rows.
+    assert [t["dur_s"] for t in reduce_st["flagged"]] == [6.0]
+    assert reduce_st["flagged_total"] == 1
+    assert analysis["flagged_total"] == 1
+    assert analysis["flagged"][0]["stage"] == "reduce"
+    assert analysis["wedged"] == []
+    # Fast, even stages flag nothing (floor keeps tiny medians sane).
+    assert analysis["stages"]["map"]["flagged"] == []
+
+
+def test_wedged_from_inflight_feed(straggler_env):
+    records = [_rec("reduce", 0.1) for _ in range(8)]
+    in_flight = [
+        {"stage": "shuffle_reduce", "pid": 999, "age_s": 30.0},
+        {"stage": "shuffle_reduce", "pid": 1000, "age_s": 0.05},
+    ]
+    analysis = stragglers.analyze(records=records, in_flight=in_flight)
+    assert len(analysis["wedged"]) == 1
+    wedged = analysis["wedged"][0]
+    # Task-fn names canonicalize to stage names.
+    assert wedged["stage"] == "reduce" and wedged["pid"] == 999
+    assert wedged["age_s"] == pytest.approx(30.0)
+
+
+def test_record_task_spool_roundtrip(straggler_env):
+    stragglers.record_task("shuffle_map", 0.25, epoch=3)
+    stragglers.flush()
+    files = os.listdir(stragglers.spool_dir())
+    assert files == [f"tasks-{os.getpid()}.ndjson"]
+    recs = stragglers.load_records()
+    assert len(recs) == 1
+    assert recs[0]["stage"] == "map" and recs[0]["epoch"] == 3
+    # The cumulative histogram rode the registry too.
+    snap = metrics.registry.snapshot()
+    assert snap["task.duration_seconds{stage=map}_count"] == 1.0
+
+
+def test_load_records_tail_read_sees_appends(straggler_env):
+    """The live-spool read is incremental (append-only files tail-read
+    from the last offset) — records appended after a first load must
+    still appear in the next one."""
+    stragglers.record_task("shuffle_map", 0.1, epoch=0)
+    stragglers.flush()
+    assert len(stragglers.load_records()) == 1
+    stragglers.record_task("shuffle_map", 0.2, epoch=0)
+    stragglers.flush()
+    recs = stragglers.load_records()
+    assert sorted(r["dur_s"] for r in recs) == [0.1, 0.2]
+    # Unchanged files are served from cache (same result, no re-parse).
+    assert len(stragglers.load_records()) == 2
+
+
+def test_publish_metrics_gauges(straggler_env):
+    records = [_rec("reduce", 0.5) for _ in range(4)] + [
+        _rec("reduce", 9.0)
+    ]
+    analysis = stragglers.analyze(records=records, in_flight=[
+        {"stage": "shuffle_reduce", "pid": 7, "age_s": 60.0}
+    ])
+    stragglers.publish_metrics(analysis)
+    snap = metrics.registry.snapshot()
+    assert snap["straggler.p99_seconds{stage=reduce}"] == pytest.approx(9.0)
+    assert snap["straggler.flagged_tasks{stage=reduce}"] == 1.0
+    assert snap["straggler.wedged_tasks"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Chaos integration: a wedged worker is caught live and post-hoc
+# ---------------------------------------------------------------------------
+
+NUM_FILES = 2
+ROWS_PER_FILE = 512
+NUM_REDUCERS = 4
+
+
+def test_chaos_wedge_flagged_live_and_in_report(
+    straggler_env, tmp_path, capsys
+):
+    """Arm a deterministic ``wedge`` fault on one reduce task: while it
+    sleeps, the in-flight detector must flag the wedged worker (live,
+    visible in /status); after completion the task lands as a flagged
+    outlier; and the epoch-report straggler table renders it."""
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+    from ray_shuffling_data_loader_tpu.telemetry import audit, obs_server
+
+    os.environ["RSDL_FAULTS"] = "task.reduce/task:wedge:1x1"
+    os.environ["RSDL_FAULTS_SEED"] = "42"
+    os.environ["RSDL_FAULTS_WEDGE_S"] = "2.5"
+    faults.refresh_from_env()
+    # The audit plane rides along (ISSUE 7 acceptance): the wedge must
+    # be flagged with exactly-once delivery intact.
+    audit.enable(spool_dir=str(tmp_path / "audit-spool"))
+    # One worker process: the x1 cap is per process, so exactly one
+    # reduce task wedges and the other three stay fast.
+    ctx = runtime.init(num_workers=1)
+    port = obs_server.start(0)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    files = [
+        generate_file(i, i * ROWS_PER_FILE, ROWS_PER_FILE, 1,
+                      str(data_dir))[0]
+        for i in range(NUM_FILES)
+    ]
+
+    class _Consumer(BatchConsumer):
+        def __init__(self):
+            self.done = threading.Event()
+
+        def consume(self, rank, epoch, batches):
+            pass
+
+        def producer_done(self, rank, epoch):
+            self.done.set()
+
+        def wait_until_ready(self, epoch):
+            pass
+
+        def wait_until_all_epochs_done(self):
+            assert self.done.wait(timeout=180)
+
+    errors = []
+
+    def _run():
+        try:
+            shuffle(
+                files, _Consumer(), num_epochs=1,
+                num_reducers=NUM_REDUCERS, num_trainers=1, seed=3,
+            )
+        except BaseException as exc:
+            errors.append(exc)
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    try:
+        # Live: poll until the wedged in-flight task is flagged — both
+        # by the detector and on the /status page.
+        wedged_live = status_wedged = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            analysis = stragglers.analyze()
+            if analysis["wedged"]:
+                wedged_live = analysis["wedged"][0]
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=10
+                ) as resp:
+                    status = json.loads(resp.read().decode())
+                if status.get("stragglers", {}).get("wedged"):
+                    status_wedged = status["stragglers"]["wedged"][0]
+                    break
+            time.sleep(0.05)
+        assert wedged_live is not None, "wedged worker never flagged live"
+        assert wedged_live["stage"] == "reduce"
+        assert status_wedged is not None, "/status never showed it"
+        thread.join(timeout=180)
+        assert not thread.is_alive()
+        assert not errors, errors
+        # Post-hoc: the wedged task completed ~2.5 s slow and is now a
+        # flagged outlier with correct stage attribution.
+        analysis = stragglers.analyze()
+        flagged = [
+            t for t in analysis["flagged"] if t["stage"] == "reduce"
+        ]
+        assert flagged and flagged[0]["dur_s"] >= 2.0
+        assert analysis["stages"]["reduce"]["skew_ratio"] is None or (
+            analysis["stages"]["reduce"]["skew_ratio"] > 2
+        )
+        # Audit ok=true throughout: the wedge slowed the epoch, it did
+        # not drop or duplicate a row.
+        assert audit.summary().get("ok") is True
+    finally:
+        obs_server.stop()
+        runtime.shutdown()
+        audit.disable()
+        audit.reset(clear_spool=True)
+        audit.refresh_from_env()
+
+    # The epoch report renders the straggler table from the spool.
+    import importlib.util
+
+    tool_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "epoch_report.py",
+    )
+    spec = importlib.util.spec_from_file_location("epoch_report", tool_path)
+    epoch_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(epoch_report)
+    rc = epoch_report.main(
+        ["--task-records", stragglers.spool_dir(), "--json"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    reduce_rows = [
+        r for r in report["stragglers"] if r["stage"] == "reduce"
+    ]
+    assert reduce_rows and reduce_rows[0]["flagged"] >= 1
+    assert reduce_rows[0]["tasks"] == NUM_REDUCERS

@@ -1,0 +1,237 @@
+"""Timeseries-history tests (ISSUE 7): ring-buffer wraparound, counter
+rates (including reset handling across a source restart — a pid change
+must never produce a negative rate), query name aliases / windowing,
+append-only persistence, sampler thread lifecycle, and the
+zero-overhead proof for the whole temporal plane (no sampler thread,
+no event files, no module import when the env gates are unset)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.telemetry import export, metrics
+from ray_shuffling_data_loader_tpu.telemetry import timeseries
+
+_ENV = ("RSDL_METRICS", "RSDL_METRICS_DIR", "RSDL_OBS_PORT", "RSDL_TS")
+
+
+@pytest.fixture
+def ts_env(tmp_path):
+    """Metrics on, spooling to a per-test dir, timeseries state reset —
+    fully unwound on teardown (function-scoped per the obs test
+    convention)."""
+    saved = {k: os.environ.get(k) for k in _ENV}
+    spool = str(tmp_path / "metrics-spool")
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = spool
+    os.environ.pop("RSDL_OBS_PORT", None)
+    os.environ.pop("RSDL_TS", None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    timeseries.reset()
+    yield spool
+    timeseries.stop()
+    timeseries.reset()
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+
+
+def _write_record(spool, pid, role, ts, typed):
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, f"metrics-{role}-{pid}.json"), "w") as f:
+        json.dump(
+            {
+                "source": {
+                    "role": role,
+                    "host": socket.gethostname(),
+                    "pid": pid,
+                },
+                "ts": ts,
+                "metrics": typed,
+            },
+            f,
+        )
+
+
+def test_ring_wraparound(ts_env):
+    timeseries.reset(capacity_override=4)
+    metrics.registry.counter("wrap.rows").inc(1)
+    stamps = []
+    for i in range(7):
+        stamps.append(100.0 + i)
+        timeseries.sample_now(now=stamps[-1])
+    samples = timeseries.samples()
+    # Capacity held, oldest rolled off, order preserved.
+    assert len(samples) == 4
+    assert [s["ts"] for s in samples] == stamps[-4:]
+
+
+def test_counter_rate_between_samples(ts_env):
+    counter = metrics.registry.counter("rate.rows")
+    counter.inc(10)
+    first = timeseries.sample_now(now=1000.0)
+    # The very first sample has no previous to rate against.
+    assert "rate" not in first["metrics"]["rate.rows"]
+    counter.inc(10)
+    second = timeseries.sample_now(now=1002.0)
+    entry = second["metrics"]["rate.rows"]
+    assert entry["value"] == 20.0
+    assert entry["rate"] == pytest.approx(5.0)
+
+
+def test_counter_reset_across_source_restart_never_negative(ts_env):
+    """A restarted source (new pid; the old spool file expired or was
+    cleaned) can only LOWER the merged cumulative value — the sampler
+    must treat the drop as a restart-from-zero, not a negative rate."""
+    _write_record(
+        ts_env, 111, "task", time.time(),
+        {"restart.rows": {"kind": "counter", "value": 100.0}},
+    )
+    timeseries.sample_now(now=2000.0)
+    # The worker restarts: old spool file gone, new pid starts from 0.
+    os.unlink(os.path.join(ts_env, "metrics-task-111.json"))
+    _write_record(
+        ts_env, 222, "task", time.time(),
+        {"restart.rows": {"kind": "counter", "value": 6.0}},
+    )
+    sample = timeseries.sample_now(now=2002.0)
+    entry = sample["metrics"]["restart.rows"]
+    assert entry["value"] == 6.0
+    # delta = cur (restart), never cur - prev = -94.
+    assert entry["rate"] == pytest.approx(3.0)
+    assert all(
+        e.get("rate", 0.0) >= 0.0 for e in sample["metrics"].values()
+    )
+
+
+def test_histogram_windowed_view(ts_env):
+    hist = metrics.registry.histogram("lat")
+    hist.observe(1.0)
+    timeseries.sample_now(now=3000.0)
+    hist.observe(3.0)
+    hist.observe(5.0)
+    sample = timeseries.sample_now(now=3002.0)
+    entry = sample["metrics"]["lat"]
+    assert entry["count"] == 3
+    assert entry["rate"] == pytest.approx(1.0)  # 2 new obs / 2 s
+    assert entry["window_mean"] == pytest.approx(4.0)  # (3+5)/2
+
+
+def test_series_query_aliases_window_and_sources(ts_env):
+    counter = metrics.registry.counter("shuffle.map_rows")
+    _write_record(
+        ts_env, 333, "task", time.time(),
+        {"shuffle.map_rows": {"kind": "counter", "value": 7.0}},
+    )
+    for i in range(3):
+        counter.inc(5)
+        timeseries.sample_now(now=4000.0 + i)
+    # Prometheus alias and raw name both match.
+    for name in ("rsdl_shuffle_map_rows", "shuffle.map_rows"):
+        series = timeseries.series(name=name, now=4002.0)
+        assert "shuffle.map_rows" in series
+        assert len(series["shuffle.map_rows"]) == 3
+        # source= breakdown keys excluded by default...
+        assert all("source=" not in k for k in series)
+    # ...and included on request.
+    series = timeseries.series(
+        name="shuffle.map_rows", include_sources=True, now=4002.0
+    )
+    assert any("source=" in k for k in series)
+    # Trailing window keeps only fresh points.
+    series = timeseries.series(
+        name="shuffle.map_rows", window_s=1.5, now=4002.0
+    )
+    assert len(series["shuffle.map_rows"]) == 2
+
+
+def test_persisted_append_only(ts_env):
+    metrics.registry.counter("persist.rows").inc(2)
+    timeseries.sample_now(now=5000.0)
+    timeseries.sample_now(now=5001.0)
+    path = timeseries.persist_path()
+    assert path and os.path.exists(path)
+    loaded = timeseries.load_persisted()
+    assert [s["ts"] for s in loaded] == [5000.0, 5001.0]
+    assert loaded[1]["metrics"]["persist.rows"]["value"] == 2.0
+
+
+def test_sampler_thread_lifecycle(ts_env):
+    metrics.registry.counter("live.rows").inc(1)
+    timeseries.start(period=0.05)
+    assert timeseries.running()
+    deadline = time.time() + 10
+    while time.time() < deadline and not timeseries.samples():
+        time.sleep(0.02)
+    assert timeseries.samples(), "sampler never sampled"
+    timeseries.stop()
+    assert not timeseries.running()
+    assert not any(
+        t.name == "rsdl-ts-sampler" for t in threading.enumerate()
+    )
+
+
+def test_start_noop_when_metrics_off(ts_env):
+    metrics.disable()
+    timeseries.start(period=0.05)
+    assert not timeseries.running()
+
+
+_ZERO_OVERHEAD_SCRIPT = r"""
+import os, sys, threading
+for k in ("RSDL_METRICS", "RSDL_OBS_PORT", "RSDL_TS", "RSDL_METRICS_DIR",
+          "RSDL_EVENTS_DIR", "RSDL_TRACE", "RSDL_AUDIT"):
+    os.environ.pop(k, None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ray_shuffling_data_loader_tpu import runtime
+ctx = runtime.init(num_workers=1)
+fut = runtime.submit(len, [1, 2, 3])
+assert fut.result(timeout=120) == 3
+# No temporal-plane module was ever imported (no import cost) ...
+for mod in ("timeseries", "events", "stragglers", "obs_server"):
+    name = "ray_shuffling_data_loader_tpu.telemetry." + mod
+    assert name not in sys.modules, name
+# ... no sampler thread ...
+assert not any(
+    t.name == "rsdl-ts-sampler" for t in threading.enumerate()
+)
+# ... and no event/task spool dirs in the session.
+for sub in ("events", os.path.join("metrics", "tasks"),
+            os.path.join("metrics", "ts")):
+    assert not os.path.isdir(os.path.join(ctx.runtime_dir, sub)), sub
+runtime.shutdown()
+print("ZERO-OVERHEAD-OK")
+"""
+
+
+def test_zero_overhead_when_disabled():
+    """ISSUE 7 acceptance: with RSDL_OBS_PORT/RSDL_METRICS unset there
+    is no sampler thread, no event files, and no import cost — proven
+    in a fresh interpreter (this test process has long since imported
+    the modules)."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("RSDL_")
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", _ZERO_OVERHEAD_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "ZERO-OVERHEAD-OK" in proc.stdout

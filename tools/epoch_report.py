@@ -22,6 +22,21 @@ then computes per epoch:
   (``cause=upstream|staging``) and the epoch CSV's admission-throttle
   column.
 
+The temporal plane (ISSUE 7) joins in when its artifacts are given:
+
+* ``--events <file|dir>`` — the structured NDJSON event log
+  (``$RSDL_RUNTIME_DIR/events`` / ``RSDL_EVENTS_DIR``): per-epoch
+  retry/recovery event counts land on the epoch rows and the notable
+  events (retries, failovers, spills, producer deaths) are listed
+  with timestamps — "what happened when throughput dipped";
+* ``--task-records <file|dir>`` — the straggler task-duration spool
+  (``<metrics spool>/tasks``): a per-epoch **straggler table** (per
+  stage: count, median, p99, skew ratio, slowest host, tasks flagged
+  over ``k×`` median — ``--straggler-k``, default 4);
+* ``--timeseries <file|dir>`` — the sampler's append-only NDJSON
+  (``<metrics spool>/ts/timeseries.ndjson``): sample count/span and
+  the map-rows rate envelope in the header.
+
 With ``--baseline BENCH_rXX.json`` (either a raw ``bench.py`` JSON line
 or the round-capture wrapper with a ``"parsed"`` field) the current
 run's headline numbers (``--bench``, same shapes) gate a regression
@@ -29,14 +44,19 @@ check: exit **1** when throughput drops more than ``--threshold-pct``
 (default 10) or stall% rises more than ``--stall-threshold-pts``
 (default 10) — so a CI lane can fail on a real slowdown. Exit 2 on
 usage errors, 3 when the inputs contain no per-epoch data (an empty
-report must not read as a pass).
+report must not read as a pass). The temporal artifacts follow the
+zero-coverage audit rule: an artifact that was **never produced**
+(path absent) is informational — noted, exit unaffected — but one
+that is **present yet empty** exits 3, because "the plane was on and
+recorded nothing" must not gate green.
 
 Pure stdlib, no server. Example::
 
     python bench.py --trace-out=/tmp/run.json > /tmp/bench.json
     python tools/epoch_report.py --trace /tmp/run.json \
         --epoch-csv epoch_stats.csv --bench /tmp/bench.json \
-        --baseline BENCH_r05.json
+        --baseline BENCH_r05.json --events /tmp/spool/events \
+        --task-records /tmp/spool/metrics/tasks
 """
 
 from __future__ import annotations
@@ -84,6 +104,52 @@ def _load_csv(path: Optional[str]) -> List[Dict[str, str]]:
         return []
     with open(path, newline="") as f:
         return list(csv.DictReader(f))
+
+
+def _load_ndjson(
+    path: Optional[str], prefix: str, required_key: str
+) -> Tuple[Optional[List[dict]], bool]:
+    """Records from one NDJSON file or a spool directory of
+    ``<prefix>*.ndjson`` files. Returns ``(records, present)`` —
+    ``present=False`` means the artifact was never produced (path or
+    matching files absent), which the exit-code policy treats as
+    informational rather than a failure; an empty-but-present artifact
+    returns ``([], True)``."""
+    import os
+
+    if not path:
+        return None, False
+    files: List[str] = []
+    if os.path.isdir(path):
+        files = [
+            os.path.join(path, f)
+            for f in sorted(os.listdir(path))
+            if f.startswith(prefix) and f.endswith(".ndjson")
+        ]
+        if not files:
+            return None, False
+    elif os.path.isfile(path):
+        files = [path]
+    else:
+        return None, False
+    out: List[dict] = []
+    for fpath in files:
+        try:
+            with open(fpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn append; skip
+                    if isinstance(rec, dict) and required_key in rec:
+                        out.append(rec)
+        except OSError:
+            continue
+    out.sort(key=lambda r: float(r.get("ts", 0.0)))
+    return out, True
 
 
 def _bench_fields(obj: Optional[dict]) -> Dict[str, Any]:
@@ -215,6 +281,124 @@ def collect_epochs(events: List[dict]) -> Dict[int, Dict[str, Any]]:
     return out
 
 
+# Event kinds worth listing with timestamps in the report (the routine
+# epoch/trial lifecycle markers only feed the per-epoch counts).
+_NOTABLE_EVENT_KINDS = (
+    "stage.retry", "recovery", "task.failover", "agent.evicted",
+    "store.spill", "producer.died", "epoch.failed", "trial.failed",
+    "straggler.wedged",
+)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def straggler_rows(
+    task_records: List[dict], k: float
+) -> List[Dict[str, Any]]:
+    """The per-(epoch, stage) straggler table: count, median, p99, skew
+    ratio, slowest host by mean duration, and how many tasks blew the
+    ``k×median`` budget — the post-hoc twin of the live ``/stragglers``
+    analysis (telemetry/stragglers.py)."""
+    groups: Dict[Tuple[Any, str], List[dict]] = {}
+    for rec in task_records:
+        key = (rec.get("epoch", "-"), str(rec.get("stage", "?")))
+        groups.setdefault(key, []).append(rec)
+    rows: List[Dict[str, Any]] = []
+    for (epoch, stage), recs in sorted(
+        groups.items(), key=lambda kv: (str(kv[0][0]), kv[0][1])
+    ):
+        durs = sorted(float(r.get("dur_s", 0.0)) for r in recs)
+        median = _quantile(durs, 0.5)
+        p99 = _quantile(durs, 0.99)
+        budget = k * median
+        hosts: Dict[str, List[float]] = {}
+        for r in recs:
+            hosts.setdefault(str(r.get("host", "?")), []).append(
+                float(r.get("dur_s", 0.0))
+            )
+        host_means = {h: sum(v) / len(v) for h, v in hosts.items()}
+        flagged = [
+            r for r in recs if float(r.get("dur_s", 0.0)) > budget
+        ] if median > 0 else []
+        rows.append(
+            {
+                "epoch": epoch,
+                "stage": stage,
+                "tasks": len(recs),
+                "median_s": round(median, 4),
+                "p99_s": round(p99, 4),
+                "skew": round(p99 / median, 2) if median > 0 else None,
+                "flagged": len(flagged),
+                "slowest_host": (
+                    max(host_means, key=host_means.get)
+                    if host_means else None
+                ),
+                "flagged_tasks": sorted(
+                    flagged, key=lambda r: -float(r.get("dur_s", 0.0))
+                )[:8],
+            }
+        )
+    return rows
+
+
+def _join_events(
+    epochs: Dict[int, Dict[str, Any]], event_records: List[dict]
+) -> Dict[str, Any]:
+    """Fold the event log into the per-epoch rows (retry/recovery
+    counts) and return the run-level summary (counts by kind + the
+    notable events, timestamped)."""
+    by_kind: Dict[str, int] = {}
+    notable: List[dict] = []
+    for rec in event_records:
+        kind = str(rec.get("kind", "unknown"))
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        epoch = rec.get("epoch")
+        if epoch is not None:
+            try:
+                row = epochs.setdefault(
+                    int(epoch), {"epoch": int(epoch)}
+                )
+            except (TypeError, ValueError):
+                row = None
+            if row is not None:
+                if kind == "stage.retry":
+                    row["retries"] = row.get("retries", 0) + 1
+                elif kind in ("recovery", "task.failover"):
+                    row["recoveries"] = row.get("recoveries", 0) + 1
+        if kind in _NOTABLE_EVENT_KINDS:
+            notable.append(rec)
+    return {"by_kind": by_kind, "notable": notable[-40:]}
+
+
+def _timeseries_summary(samples: List[dict]) -> Dict[str, Any]:
+    """Header-level envelope of the sampler history: sample count,
+    span, and the map-rows rate min/mean/max (the dip the events
+    explain)."""
+    out: Dict[str, Any] = {"samples": len(samples)}
+    if not samples:
+        return out
+    ts0 = float(samples[0].get("ts", 0.0))
+    ts1 = float(samples[-1].get("ts", 0.0))
+    out["span_s"] = round(ts1 - ts0, 1)
+    rates = []
+    for s in samples:
+        entry = (s.get("metrics") or {}).get("shuffle.map_rows")
+        if entry and "rate" in entry:
+            rates.append(float(entry["rate"]))
+    if rates:
+        out["map_rows_rate"] = {
+            "min": round(min(rates), 2),
+            "mean": round(sum(rates) / len(rates), 2),
+            "max": round(max(rates), 2),
+        }
+    return out
+
+
 def build_report(
     events: List[dict],
     epoch_rows: List[Dict[str, str]],
@@ -223,6 +407,10 @@ def build_report(
     baseline: Optional[dict],
     threshold_pct: float,
     stall_threshold_pts: float,
+    event_records: Optional[List[dict]] = None,
+    task_records: Optional[List[dict]] = None,
+    ts_samples: Optional[List[dict]] = None,
+    straggler_k: float = 4.0,
 ) -> Dict[str, Any]:
     epochs = collect_epochs(events)
 
@@ -255,6 +443,12 @@ def build_report(
     base = _bench_fields(baseline)
     if cur:
         header.update(cur)
+    events_summary = None
+    if event_records is not None:
+        events_summary = _join_events(epochs, event_records)
+        header["events_by_kind"] = events_summary["by_kind"]
+    if ts_samples is not None:
+        header["timeseries"] = _timeseries_summary(ts_samples)
     if trial_rows:
         t = trial_rows[0]
         for k in ("duration", "num_rows", "num_epochs", "row_throughput"):
@@ -298,7 +492,12 @@ def build_report(
                     f"{bstall} (threshold {stall_threshold_pts} pts)"
                 )
     header["regressions"] = regressions
-    return {"header": header, "epochs": rows}
+    report: Dict[str, Any] = {"header": header, "epochs": rows}
+    if events_summary is not None:
+        report["events"] = events_summary["notable"]
+    if task_records is not None:
+        report["stragglers"] = straggler_rows(task_records, straggler_k)
+    return report
 
 
 # ---------------------------------------------------------------------------
@@ -319,7 +518,12 @@ def _fmt(value: Any, width: int = 0) -> str:
 _COLUMNS = [
     "epoch", "wall_s", "map_s", "reduce_s", "deliver_s", "consume_s",
     "overlap_s", "idle_s", "critical_path", "stall_upstream_s",
-    "stall_staging_s", "throttle_s", "epoch_s",
+    "stall_staging_s", "throttle_s", "epoch_s", "retries", "recoveries",
+]
+
+_STRAGGLER_COLUMNS = [
+    "epoch", "stage", "tasks", "median_s", "p99_s", "skew", "flagged",
+    "slowest_host",
 ]
 
 
@@ -350,6 +554,59 @@ def render(report: Dict[str, Any]) -> str:
             lines.append(
                 "  ".join(_fmt(r.get(c), widths[c]) for c in columns)
             )
+    straggler_table = report.get("stragglers")
+    if straggler_table is not None:
+        lines.append("")
+        lines.append("straggler table (per epoch/stage)")
+        if not straggler_table:
+            lines.append("  (no task records)")
+        else:
+            widths = {
+                c: max(
+                    len(c),
+                    *(len(_fmt(r.get(c))) for r in straggler_table),
+                )
+                for c in _STRAGGLER_COLUMNS
+            }
+            lines.append(
+                "  ".join(c.rjust(widths[c]) for c in _STRAGGLER_COLUMNS)
+            )
+            lines.append(
+                "  ".join("-" * widths[c] for c in _STRAGGLER_COLUMNS)
+            )
+            for r in straggler_table:
+                lines.append(
+                    "  ".join(
+                        _fmt(r.get(c), widths[c])
+                        for c in _STRAGGLER_COLUMNS
+                    )
+                )
+                for t in r.get("flagged_tasks", []):
+                    lines.append(
+                        f"    STRAGGLER: host={t.get('host')} "
+                        f"pid={t.get('pid')} dur={_fmt(t.get('dur_s'))}s "
+                        f"(median {_fmt(r.get('median_s'))}s)"
+                    )
+    notable = report.get("events")
+    if notable:
+        lines.append("")
+        lines.append("notable events")
+        import time as _time
+
+        for rec in notable:
+            stamp = _time.strftime(
+                "%H:%M:%S", _time.localtime(float(rec.get("ts", 0.0)))
+            )
+            detail = " ".join(
+                f"{k}={rec[k]}"
+                for k in ("epoch", "stage", "attempt", "counter",
+                          "error", "rank", "agent", "nbytes", "pid",
+                          "age_s")
+                if k in rec
+            )
+            lines.append(
+                f"  {stamp}  {rec.get('kind', '?'):<18} {detail}"[:118]
+            )
     for msg in report["header"].get("regressions", []):
         lines.append(f"REGRESSION: {msg}")
     return "\n".join(lines)
@@ -374,6 +631,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         "to gate regressions against",
     )
     parser.add_argument(
+        "--events",
+        help="structured event-log NDJSON (file, or the events spool "
+        "dir of events-*.ndjson) to join per epoch",
+    )
+    parser.add_argument(
+        "--task-records",
+        help="straggler task-duration NDJSON (file, or the "
+        "<metrics spool>/tasks dir of tasks-*.ndjson) for the "
+        "per-epoch straggler table",
+    )
+    parser.add_argument(
+        "--timeseries",
+        help="timeseries sampler NDJSON (file, or the dir holding "
+        "ts/timeseries.ndjson) for the header rate envelope",
+    )
+    parser.add_argument(
+        "--straggler-k", type=float, default=4.0,
+        help="straggler budget: flag tasks slower than K x the "
+        "(epoch, stage) median (default 4)",
+    )
+    parser.add_argument(
         "--threshold-pct", type=float, default=10.0,
         help="max tolerated throughput drop vs baseline (%%, default 10)",
     )
@@ -386,13 +664,55 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="emit the report as JSON instead of a table",
     )
     args = parser.parse_args(argv)
-    if not any((args.trace, args.epoch_csv, args.bench)):
+    if not any((args.trace, args.epoch_csv, args.bench, args.events,
+                args.task_records, args.timeseries)):
         parser.print_usage(sys.stderr)
         print(
-            "epoch_report: need at least one of --trace/--epoch-csv/--bench",
+            "epoch_report: need at least one of --trace/--epoch-csv/"
+            "--bench/--events/--task-records/--timeseries",
             file=sys.stderr,
         )
         return 2
+    # The temporal artifacts distinguish "never produced" (absent path:
+    # the plane was off — informational) from "present but empty" (the
+    # plane was on and recorded nothing: exit 3, the zero-coverage
+    # rule). Resolve a --timeseries DIR to its ts/timeseries.ndjson.
+    ts_path = args.timeseries
+    if ts_path and not ts_path.endswith(".ndjson"):
+        import os as _os
+
+        for candidate in (
+            _os.path.join(ts_path, "ts", "timeseries.ndjson"),
+            _os.path.join(ts_path, "timeseries.ndjson"),
+        ):
+            if _os.path.exists(candidate):
+                ts_path = candidate
+                break
+    absent_notes: List[str] = []
+    empty_present: List[str] = []
+
+    def _temporal(path, prefix, required_key, label):
+        records, present = _load_ndjson(path, prefix, required_key)
+        if path and not present:
+            absent_notes.append(
+                f"note: no {label} present at {path} (plane off?) — "
+                "informational"
+            )
+            return None
+        if present and not records:
+            empty_present.append(
+                f"{label} at {path} is present but empty — the plane "
+                "was on and recorded nothing"
+            )
+        return records
+
+    event_records = _temporal(args.events, "events-", "kind", "events")
+    task_records = _temporal(
+        args.task_records, "tasks-", "dur_s", "task records"
+    )
+    ts_samples = _temporal(
+        ts_path, "timeseries", "metrics", "timeseries"
+    )
     try:
         events: List[dict] = []
         if args.trace:
@@ -407,6 +727,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             _load_json(args.baseline),
             args.threshold_pct,
             args.stall_threshold_pts,
+            event_records=event_records,
+            task_records=task_records,
+            ts_samples=ts_samples,
+            straggler_k=args.straggler_k,
         )
     except (OSError, ValueError) as exc:
         print(f"epoch_report: {exc}", file=sys.stderr)
@@ -415,9 +739,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(report, indent=2, default=str))
     else:
         print(render(report))
+    for note in absent_notes:
+        print(f"epoch_report: {note}", file=sys.stderr)
     if report["header"].get("regressions"):
         return 1
-    if not report["epochs"] and not _bench_fields(bench):
+    if empty_present:
+        for msg in empty_present:
+            print(f"epoch_report: {msg}", file=sys.stderr)
+        return 3
+    has_temporal = bool(event_records or task_records or ts_samples)
+    if (
+        not report["epochs"]
+        and not _bench_fields(bench)
+        and not has_temporal
+    ):
         # Nothing per-epoch AND no headline numbers: the inputs carried
         # zero signal — a gate must not go green on that.
         print(

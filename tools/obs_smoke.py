@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Exit-code-gated smoke for the temporal obs plane (ISSUE 7, CI).
+
+Starts a small multi-epoch shuffle with the obs endpoint up and, while
+it is MID-FLIGHT, asserts the acceptance surface end to end:
+
+1. ``/timeseries?name=rsdl_shuffle_map_rows`` serves a non-empty rate
+   series (the sampler is running, counter deltas became rates);
+2. ``tools/rsdl_top.py --once --json`` renders a frame from the live
+   endpoint (exit 0, parseable);
+3. after completion, ``/events`` carries the full epoch lifecycle
+   (``epoch.start``/``epoch.done`` per epoch, one ``trial.done``).
+
+Run from the repo root (``run_ci_tests.sh`` obs lane)::
+
+    RSDL_METRICS=1 python tools/obs_smoke.py
+
+Exits non-zero on any miss — the exit code IS the gate.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+
+def main() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    os.environ.setdefault("RSDL_METRICS", "1")
+    os.environ["RSDL_OBS_PORT"] = str(port)
+    # Sample fast so a short CI shuffle yields several ring entries.
+    os.environ.setdefault("RSDL_TS_PERIOD_S", "0.2")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import (
+        BatchConsumer,
+        shuffle,
+    )
+
+    data_dir = tempfile.mkdtemp(prefix="rsdl-obs-smoke-")
+    files = [
+        generate_file(i, i * 2048, 2048, 1, data_dir)[0] for i in range(2)
+    ]
+    runtime.init(num_workers=2)
+
+    class _Consumer(BatchConsumer):
+        def __init__(self):
+            self.done = threading.Event()
+
+        def consume(self, rank, epoch, batches):
+            time.sleep(0.2)  # keep the run observably mid-flight
+
+        def producer_done(self, rank, epoch):
+            if epoch == 2:
+                self.done.set()
+
+        def wait_until_ready(self, epoch):
+            pass
+
+        def wait_until_all_epochs_done(self):
+            assert self.done.wait(timeout=180)
+
+    errors = []
+
+    def _run():
+        try:
+            shuffle(
+                files, _Consumer(), num_epochs=3, num_reducers=2,
+                num_trainers=1, seed=7,
+            )
+        except BaseException as exc:
+            errors.append(exc)
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+
+    base = f"http://127.0.0.1:{port}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    deadline = time.time() + 120
+    rate_seen = top_out = None
+    while time.time() < deadline:
+        ts = get("/timeseries?name=rsdl_shuffle_map_rows")
+        series = ts.get("series") or {}
+        rates = [
+            p for pts in series.values() for p in pts if p.get("rate")
+        ]
+        if rates and top_out is None:
+            rate_seen = rates[-1]
+            top_out = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "tools", "rsdl_top.py"),
+                    "--url", base, "--once", "--json",
+                ],
+                capture_output=True,
+                text=True,
+            )
+            break
+        time.sleep(0.2)
+    assert rate_seen, (
+        "no non-empty rsdl_shuffle_map_rows rate series mid-flight"
+    )
+    assert top_out is not None and top_out.returncode == 0, (
+        top_out and top_out.stderr
+    )
+    frame = json.loads(top_out.stdout)
+    assert frame["status"] is not None
+    thread.join(timeout=180)
+    assert not thread.is_alive() and not errors, errors
+    kinds = get("/events")["by_kind"]
+    assert kinds.get("epoch.start", 0) >= 3, kinds
+    assert kinds.get("epoch.done", 0) >= 3, kinds
+    assert kinds.get("trial.done") == 1, kinds
+    print(
+        "temporal-obs smoke ok: rate=%.1f rows/s, events=%s"
+        % (rate_seen["rate"], kinds)
+    )
+    runtime.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

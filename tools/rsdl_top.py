@@ -1,0 +1,338 @@
+#!/usr/bin/env python
+"""rsdl_top: live terminal dashboard for a running shuffle.
+
+``top`` for the shuffle plane — polls the obs endpoint
+(``RSDL_OBS_PORT``, :mod:`telemetry.obs_server`) and renders one
+refreshing screen: epoch-window state, per-stage throughput sparklines
+(from ``/timeseries`` rate series), queue depths, store residency,
+recovery counters, stall attribution, the straggler/skew table, and
+the latest structured events. Pure stdlib, no curses — ANSI clear +
+redraw, so it works over any ssh session.
+
+Usage::
+
+    RSDL_METRICS=1 RSDL_OBS_PORT=9100 python bench.py ... &
+    python tools/rsdl_top.py                    # live, 2 s refresh
+    python tools/rsdl_top.py --once             # one frame (CI smoke)
+    python tools/rsdl_top.py --once --json      # machine-readable frame
+    python tools/rsdl_top.py --url http://host:9100 --interval 5
+
+Exit codes: 0 on a rendered frame, 1 when the endpoint is unreachable
+(so ``--once`` doubles as an is-the-obs-plane-up gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+# The rate series the throughput panel shows, in display order.
+THROUGHPUT_SERIES = (
+    ("map rows/s", "rsdl_shuffle_map_rows"),
+    ("reduce rows/s", "rsdl_shuffle_reduce_rows"),
+    ("h2d B/s", "rsdl_h2d_bytes"),
+)
+
+
+def _get_json(base: str, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def sparkline(values: List[float], width: int = 40) -> str:
+    """Unicode block sparkline of the trailing ``width`` values,
+    normalized to the window's own max (an all-zero window renders
+    flat)."""
+    if not values:
+        return ""
+    values = values[-width:]
+    peak = max(values)
+    if peak <= 0:
+        return SPARK_CHARS[0] * len(values)
+    out = []
+    for v in values:
+        idx = int(round((len(SPARK_CHARS) - 1) * max(0.0, v) / peak))
+        out.append(SPARK_CHARS[idx])
+    return "".join(out)
+
+
+def _fmt_bytes(num: Optional[float]) -> str:
+    if num is None:
+        return "-"
+    num = float(num)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(num) < 1024.0:
+            return f"{num:.1f}{unit}"
+        num /= 1024.0
+    return f"{num:.1f}PiB"
+
+
+def _fmt(value: Any) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.4g}"
+    return str(value)
+
+
+# ---------------------------------------------------------------------------
+# Frame collection
+# ---------------------------------------------------------------------------
+
+
+def collect(base: str, window_s: float) -> Dict[str, Any]:
+    """One dashboard frame's worth of endpoint data. Individual pages
+    degrade to an ``error`` entry (the dashboard renders what it got)
+    — only a fully unreachable endpoint raises."""
+    frame: Dict[str, Any] = {"ts": time.time(), "url": base}
+    # /status is the must-have page: let its failure propagate (the
+    # caller maps it to exit 1).
+    frame["status"] = _get_json(base, "/status")
+    for key, path in (
+        ("healthz", "/healthz"),
+        ("timeseries", f"/timeseries?window={window_s:g}"),
+        ("events", "/events?limit=12"),
+        ("stragglers", "/stragglers"),
+    ):
+        try:
+            frame[key] = _get_json(base, path)
+        except Exception as exc:
+            frame[key] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return frame
+
+
+def _series_points(frame: dict, name: str) -> List[dict]:
+    series = (frame.get("timeseries") or {}).get("series") or {}
+    for key, points in series.items():
+        base = key.split("{", 1)[0]
+        if name in (key, base) or name == _prom_alias(base):
+            return points
+    return []
+
+
+def _prom_alias(base: str) -> str:
+    import re
+
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", base)
+    return out if out.startswith("rsdl_") else "rsdl_" + out
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def render(frame: Dict[str, Any]) -> str:
+    status = frame.get("status") or {}
+    healthz = frame.get("healthz") or {}
+    lines: List[str] = []
+    shuffle = (status.get("providers") or {}).get("shuffle") or {}
+    epoch_window = status.get("in_flight_epochs") or []
+    lines.append(
+        f"rsdl_top  {time.strftime('%H:%M:%S', time.localtime(frame['ts']))}"
+        f"  {frame['url']}"
+        f"  up={healthz.get('ok', '?')}"
+        f"  uptime={_fmt(healthz.get('uptime_s'))}s"
+        f"  trial_running={shuffle.get('running', '-')}"
+    )
+    epochs = shuffle.get("epochs") or {}
+    parts = []
+    for e in sorted(epochs, key=lambda x: int(x)):
+        st = epochs[e]
+        parts.append(
+            f"e{e}:{st.get('state', '?')}"
+            f"({st.get('delivered_reducers', 0)}"
+            f"/{shuffle.get('num_reducers', '?')})"
+        )
+    lines.append(
+        "epochs   in-flight=" + (str(epoch_window) if epoch_window else "[]")
+        + ("  " + " ".join(parts) if parts else "")
+    )
+
+    # Throughput sparklines from /timeseries rate series.
+    lines.append("")
+    lines.append("throughput (rate over the window)")
+    for label, name in THROUGHPUT_SERIES:
+        points = _series_points(frame, name)
+        rates = [float(p.get("rate", 0.0)) for p in points if "rate" in p]
+        cur = rates[-1] if rates else None
+        lines.append(
+            f"  {label:>14}  {sparkline(rates):40s}  "
+            f"{_fmt(cur) if cur is not None else '-'}"
+        )
+
+    # Queue depths + store residency.
+    depths = status.get("queue_depths") or {}
+    total = depths.get("queue.depth.total")
+    lines.append("")
+    lines.append(
+        f"queue    total={_fmt(total)}  "
+        + "  ".join(
+            f"{k.split('{', 1)[1].rstrip('}')}: {int(v)}"
+            for k, v in sorted(depths.items())
+            if k != "queue.depth.total"
+        )[:100]
+    )
+    store = status.get("store") or {}
+    store_bytes = store.get("total_bytes") or store.get("shm_bytes")
+    lines.append(
+        "store    "
+        f"objects={_fmt(store.get('objects'))}  "
+        f"bytes={_fmt_bytes(store_bytes)}  "
+        f"spill={_fmt_bytes(store.get('spill_bytes'))}"
+    )
+
+    # Recovery + stall attribution.
+    recovery = status.get("recovery") or {}
+    lines.append(
+        "recovery "
+        + (
+            "  ".join(
+                f"{k.replace('recovery.', '')}={int(v)}"
+                for k, v in sorted(recovery.items())
+            )
+            if recovery
+            else "(none)"
+        )
+    )
+
+    # Stragglers.
+    stragglers = frame.get("stragglers") or {}
+    stages = stragglers.get("stages") or {}
+    lines.append("")
+    flagged_total = stragglers.get(
+        "flagged_total", len(stragglers.get("flagged") or [])
+    )
+    lines.append(
+        "stragglers  "
+        f"tasks={_fmt(stragglers.get('tasks_total'))}  "
+        f"wedged={len(stragglers.get('wedged') or [])}  "
+        f"flagged={flagged_total}"
+    )
+    if stages:
+        lines.append(
+            "  stage          n    median_s      p99_s   skew  slowest_host"
+        )
+        for stage in sorted(stages):
+            st = stages[stage]
+            lines.append(
+                f"  {stage:<12}{st.get('count', 0):>4}"
+                f"{_fmt(st.get('median_s')):>12}"
+                f"{_fmt(st.get('p99_s')):>11}"
+                f"{_fmt(st.get('skew_ratio')):>7}"
+                f"  {st.get('slowest_host') or '-'}"
+            )
+    for task in (stragglers.get("wedged") or [])[:4]:
+        lines.append(
+            f"  WEDGED: {task.get('stage')} pid={task.get('pid')} "
+            f"age={_fmt(task.get('age_s'))}s "
+            f"(budget {_fmt(task.get('budget_s'))}s)"
+        )
+    for task in (stragglers.get("flagged") or [])[:4]:
+        lines.append(
+            f"  slow: {task.get('stage')} host={task.get('host')} "
+            f"pid={task.get('pid')} dur={_fmt(task.get('dur_s'))}s"
+            + (f" epoch={task['epoch']}" if "epoch" in task else "")
+        )
+
+    # Events tail.
+    events = frame.get("events") or {}
+    lines.append("")
+    by_kind = events.get("by_kind") or {}
+    lines.append(
+        "events   "
+        + (
+            "  ".join(f"{k}={v}" for k, v in sorted(by_kind.items()))[:110]
+            if by_kind
+            else "(none)"
+        )
+    )
+    for rec in (events.get("events") or [])[-8:]:
+        ts = time.strftime(
+            "%H:%M:%S", time.localtime(float(rec.get("ts", 0.0)))
+        )
+        detail = " ".join(
+            f"{k}={rec[k]}"
+            for k in ("epoch", "stage", "schedule", "attempt", "error",
+                      "counter", "rank", "duration_s")
+            if k in rec
+        )
+        lines.append(f"  {ts}  {rec.get('kind', '?'):<18} {detail}"[:118])
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Main
+# ---------------------------------------------------------------------------
+
+
+def default_url() -> str:
+    port = os.environ.get("RSDL_OBS_PORT", "").strip() or "9100"
+    host = os.environ.get("RSDL_OBS_HOST", "").strip() or "127.0.0.1"
+    if host == "0.0.0.0":
+        host = "127.0.0.1"
+    return f"http://{host}:{port}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--url",
+        default=None,
+        help="obs endpoint base URL (default: http://$RSDL_OBS_HOST"
+        ":$RSDL_OBS_PORT, falling back to 127.0.0.1:9100)",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds (live mode; default 2)",
+    )
+    parser.add_argument(
+        "--window", type=float, default=120.0,
+        help="sparkline window in seconds (default 120)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (CI smoke / scripting)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw frame as JSON instead of the dashboard",
+    )
+    args = parser.parse_args(argv)
+    base = (args.url or default_url()).rstrip("/")
+
+    while True:
+        try:
+            frame = collect(base, args.window)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"rsdl_top: {base} unreachable: {exc}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(frame, default=str))
+        else:
+            if not args.once:
+                # ANSI clear + home; keeps the frame flicker-free enough
+                # without curses.
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(render(frame))
+        if args.once:
+            return 0
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
