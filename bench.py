@@ -1177,6 +1177,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
 
     stats = ds.stats.as_dict()
     staged_gb = stats["bytes_staged"] / 1e9
+    staged_direct_gb = stats.get("bytes_staged_direct", 0) / 1e9
     # Per-stage shuffle timings (diagnosability of the headline number):
     # wall-clock stage windows and mean task durations per epoch.
     phase = {}
@@ -1228,7 +1229,19 @@ def run_bench(platform: str, num_chips: int, tpu_error):
         "peak_h2d_gbps": round(peak_gbps, 2),
         "dataset_gb": round(dataset_bytes / 1e9, 3),
         "scaled_down": scaled_down,
+        # staged_gb counts HOST-COPIED staging bytes (the rebatch+pack
+        # amplification ISSUE 8 kills); staged_direct_gb counts bytes
+        # device_put shipped straight off mmapped packed segments with
+        # no host copy. Their sum is total H2D traffic. device_direct
+        # records whether the path actually ENGAGED (at least one batch
+        # shipped direct), not merely whether the env requested it — a
+        # non-engaging run must not read as "optimization was on".
         "staged_gb": round(staged_gb, 3),
+        "staged_direct_gb": round(staged_direct_gb, 3),
+        "batches_staged_direct": int(
+            stats.get("batches_staged_direct", 0)
+        ),
+        "device_direct": stats.get("batches_staged_direct", 0) > 0,
         "steps": num_steps,
         "step_time_s": round(step_time, 2),
         "total_s": round(total_s, 2),

@@ -118,6 +118,13 @@ class _QueueActor:
         # the producer's host (rank 0 spawns it), so a pid probe is a
         # valid liveness check.
         self._producer_pid: Optional[int] = None
+        # Delivery-granularity accounting (ISSUE 8): device-direct
+        # delivery enqueues up to three refs per reducer (head remainder
+        # / packed body / tail remainder) where the legacy path enqueued
+        # one — the lifetime item total makes the actual ref traffic
+        # visible in /status and /metrics instead of leaving queue depth
+        # as the only (ambiguous) signal.
+        self._items_enqueued = 0
 
     def register_producer(self, pid: int) -> None:
         self._producer_pid = int(pid)
@@ -184,6 +191,7 @@ class _QueueActor:
             await asyncio.wait_for(self.queues[epoch][rank].put(item), timeout)
         except asyncio.TimeoutError:
             raise Full from None
+        self._items_enqueued += 1
 
     async def put_batch(self, rank, epoch, items, timeout=None):
         # All-or-nothing: wait until the queue has room for EVERY item,
@@ -213,6 +221,7 @@ class _QueueActor:
             ):
                 for item in items:
                     queue.put_nowait(item)
+                self._items_enqueued += len(items)
                 return
             # Event-driven wait: armed (cleared) atomically with the failed
             # room check — no await separates them, so a consume landing
@@ -260,6 +269,7 @@ class _QueueActor:
 
     def put_nowait(self, rank, epoch, item):
         self.queues[epoch][rank].put_nowait(item)
+        self._items_enqueued += 1
 
     def put_nowait_batch(self, rank, epoch, items):
         if (
@@ -272,6 +282,7 @@ class _QueueActor:
             )
         for item in items:
             self.queues[epoch][rank].put_nowait(item)
+        self._items_enqueued += len(items)
 
     def get_nowait(self, rank, epoch):
         item = self.queues[epoch][rank].get_nowait()
@@ -316,6 +327,7 @@ class _QueueActor:
             "num_trainers": self.num_trainers,
             "producer_pid": self._producer_pid,
             "producer_alive": alive,
+            "items_enqueued_total": self._items_enqueued,
             "depth_total": self.size(),
             "depths": {
                 f"{epoch}/{rank}": q.qsize()
@@ -339,6 +351,7 @@ class _QueueActor:
                     )
                 ] = float(q.qsize())
         out["queue.depth.total"] = float(self.size())
+        out["queue.items_enqueued.total"] = float(self._items_enqueued)
         return out
 
 

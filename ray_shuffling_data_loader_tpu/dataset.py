@@ -31,8 +31,15 @@ from ray_shuffling_data_loader_tpu.batch_queue import (
     DEFAULT_QUEUE_NAME,
 )
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
+from ray_shuffling_data_loader_tpu.runtime.store import (
+    device_batch_rows,
+    is_device_batch,
+    iter_packed_batches,
+    logical_columns,
+)
 from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
 from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
 
 # Default reducer share of cluster cores (reference ``dataset.py:12``).
 REDUCER_CLUSTER_CORE_SHARE = 0.6
@@ -154,6 +161,7 @@ class ShufflingDataset:
         narrow_to_32: bool = False,
         cache_decoded: Optional[bool] = None,
         stats_collector=None,
+        device_layout: Optional[dict] = None,
     ):
         """``narrow_to_32``: cast 64-bit columns to 32-bit at Parquet
         decode time, inside the map tasks. Every downstream pass
@@ -161,7 +169,15 @@ class ShufflingDataset:
         cross-host fetch) then moves half the bytes. Only safe when
         values fit (int32 ids / float32 labels) — the device path
         (:class:`~.jax_dataset.JaxShufflingDataset`) turns it on because
-        it narrows to 32-bit at staging anyway."""
+        it narrows to 32-bit at staging anyway.
+
+        ``device_layout``: device-direct delivery (ROADMAP 3) — the
+        staging consumer's ``{"batch": B, "columns": [...]}`` layout.
+        Reducers then emit batch-aligned packed segments; this iterator
+        yields each packed batch as zero-copy logical column views (with
+        ``.packed`` exposing the raw ``[n_cols, B]`` staging block) and
+        routes only the boundary remainders through the carry rebatcher.
+        The yielded row stream is bit-identical to the layout-off path."""
         runtime.ensure_initialized()
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
@@ -193,6 +209,7 @@ class ShufflingDataset:
                         narrow_to_32=narrow_to_32,
                         cache_decoded=cache_decoded,
                         stats_collector=stats_collector,
+                        device_layout=device_layout,
                     )
                 except BaseException as exc:  # surfaced at iterator end
                     result.error = exc
@@ -248,6 +265,27 @@ class ShufflingDataset:
             )
         store = runtime.get_context().store
         rebatch = CarryRebatcher(self._batch_size, self._skip_batches)
+        # Staging sub-phase attribution (ISSUE 8 satellite): the carry
+        # re-cut used to hide inside the monolithic "staging" stall; its
+        # host-copy cost is now its own series. The profiler is the
+        # shared no-op when telemetry is off.
+        prof = _phases.stage_profiler(
+            "staging", epoch=self._epoch, rank=self._rank
+        )
+
+        def _recut(cb):
+            """Drive ``rebatch.feed`` so only the rebatcher's own slicing
+            work is timed — the consumer runs between ``next()`` calls,
+            outside the phase."""
+            feed = rebatch.feed(cb)
+            while True:
+                with prof.phase("rebatch"):
+                    try:
+                        out = next(feed)
+                    except StopIteration:
+                        return
+                yield out
+
         is_done = False
         consumed_rows = 0  # audit: this rank's consumed-stream offset
         while not is_done:
@@ -273,10 +311,38 @@ class ShufflingDataset:
                     # delivery thread and here breaks delivered==consumed
                     # at reconcile.
                     _audit.record_consume(
-                        self._epoch, self._rank, cb.columns, consumed_rows
+                        self._epoch, self._rank, logical_columns(cb),
+                        consumed_rows,
                     )
-                    consumed_rows += cb.num_rows
-                yield from rebatch.feed(cb)
+                    consumed_rows += (
+                        device_batch_rows(cb)
+                        if is_device_batch(cb)
+                        else cb.num_rows
+                    )
+                if (
+                    is_device_batch(cb)
+                    and cb.layout.get("batch") == self._batch_size
+                    and rebatch.buf is None
+                ):
+                    # Device-direct body: batches already cut at this
+                    # rank stream's grid (the producer proved alignment
+                    # by construction — the carry is empty exactly when
+                    # a body arrives). Yield zero-copy per-batch views;
+                    # the carry rebatcher never touches these bytes.
+                    for pb in iter_packed_batches(cb):
+                        if rebatch.to_skip > 0:
+                            rebatch.to_skip -= 1
+                            continue
+                        yield pb
+                elif is_device_batch(cb):
+                    # Alignment broken (e.g. an injected delivery fault
+                    # upstream shifted the stream): correctness first —
+                    # re-cut the logical batches through the carry
+                    # buffer like any columnar output.
+                    for pb in iter_packed_batches(cb):
+                        yield from _recut(pb)
+                else:
+                    yield from _recut(cb)
                 del cb
 
             if num_outstanding > 0:

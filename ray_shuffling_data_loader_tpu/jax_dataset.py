@@ -43,6 +43,7 @@ from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch
 from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
 
 
 def _default_device_dtype(np_dtype: np.dtype) -> jnp.dtype:
@@ -98,7 +99,14 @@ class HostToDeviceStats:
 
     def __init__(self):
         self.bytes_staged = 0
+        # Device-direct delivery: bytes handed to ``device_put`` straight
+        # off the store's mmapped packed segments — no host-side
+        # rebatch/pack copy was paid for them. ``bytes_staged`` keeps
+        # counting the HOST-COPIED staging bytes (the amplification the
+        # metric always measured); the two together are total H2D.
+        self.bytes_staged_direct = 0
         self.batches_staged = 0
+        self.batches_staged_direct = 0
         self.put_dispatch_s = 0.0
         self.stall_s = 0.0
         self.stalls = 0
@@ -130,7 +138,9 @@ class HostToDeviceStats:
     def as_dict(self) -> Dict[str, float]:
         return {
             "bytes_staged": self.bytes_staged,
+            "bytes_staged_direct": self.bytes_staged_direct,
             "batches_staged": self.batches_staged,
+            "batches_staged_direct": self.batches_staged_direct,
             "put_dispatch_s": self.put_dispatch_s,
             "stall_s": self.stall_s,
             "stalls": self.stalls,
@@ -189,6 +199,27 @@ class JaxShufflingDataset:
         cache_decoded: Optional[bool] = None,
         stats_collector=None,
     ):
+        self._spec = JaxBatchSpec(
+            feature_columns=feature_columns,
+            label_column=label_column,
+            feature_types=feature_types,
+            feature_shapes=feature_shapes,
+            label_type=label_type,
+            label_shape=label_shape,
+        ).normalize()
+        if mesh is None:
+            mesh = Mesh(np.array(jax.local_devices()), (batch_axis,))
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        # Device-direct delivery (ROADMAP 3): when every spec column is a
+        # flat 4-byte tensor and the batch divides this process's slice
+        # of the data axis, ask the shuffle to emit reducer output
+        # already in the [n_cols, batch] staging layout — the stager then
+        # ``device_put``s straight off the store's mmapped segments,
+        # killing the host-side rebatch+pack amplification. The layout
+        # request must exist BEFORE the underlying dataset construction:
+        # rank 0's constructor kicks off the multi-epoch shuffle.
+        self._device_layout = self._device_layout_request(batch_size)
         self._ds = ShufflingDataset(
             filenames,
             num_epochs,
@@ -206,22 +237,17 @@ class JaxShufflingDataset:
             narrow_to_32=True,
             cache_decoded=cache_decoded,
             stats_collector=stats_collector,
+            device_layout=self._device_layout,
         )
-        self._spec = JaxBatchSpec(
-            feature_columns=feature_columns,
-            label_column=label_column,
-            feature_types=feature_types,
-            feature_shapes=feature_shapes,
-            label_type=label_type,
-            label_shape=label_shape,
-        ).normalize()
-        if mesh is None:
-            mesh = Mesh(np.array(jax.local_devices()), (batch_axis,))
-        self.mesh = mesh
-        self.batch_axis = batch_axis
         self._prefetch_depth = max(1, prefetch_depth)
         self._unpack_cache: Dict[Any, Any] = {}
         self._packed_ok = True
+        # Device-direct: per-layout-signature eligibility cache plus the
+        # permanent fallback latch (mirrors ``_packed_ok`` — a backend
+        # that rejects the direct put degrades to host staging once,
+        # single-process only).
+        self._direct_sig_cache: Dict[Any, bool] = {}
+        self._direct_ok_flag = True
         self.stats = HostToDeviceStats()
         # Pre-resolved H2D instruments: _stage runs per batch on the
         # staging hot path; instruments are registry singletons, so hoist
@@ -235,6 +261,95 @@ class JaxShufflingDataset:
             self._h2d_bytes = None
             self._h2d_batches = None
             self._h2d_dispatch_s = None
+
+    # -- device-direct layout (ROADMAP 3 / ISSUE 8) -------------------------
+
+    def _device_layout_request(self, batch_size: int) -> Optional[Dict]:
+        """The staging layout to ask the shuffle for, or None when this
+        spec cannot take it: any explicit non-4-byte dtype, any feature
+        shape (packed rows are flat), or a batch that does not divide
+        this process's slice of the data axis (full batches must shard).
+        Columns are ordered features-then-label — the exact row order of
+        the packed block and of the on-device unpack."""
+        from ray_shuffling_data_loader_tpu.shuffle import (
+            device_direct_enabled,
+        )
+
+        if not device_direct_enabled():
+            return None
+        spec = self._spec
+        if spec.label_shape is not None or any(
+            s is not None for s in spec.feature_shapes
+        ):
+            return None
+        for t in (*spec.feature_types, spec.label_type):
+            if t is not None and np.dtype(t).itemsize != 4:
+                return None
+        if batch_size % self._local_batch_shards() != 0:
+            return None
+        return {
+            "batch": int(batch_size),
+            "columns": [*spec.feature_columns, spec.label_column],
+        }
+
+    def _direct_ok(self, cb: ColumnBatch) -> bool:
+        """Can this packed batch ship without any host conversion? The
+        layout's PREFIX columns and their ACTUAL dtypes (stamped by the
+        reducer; the reducer appends any extra dataset columns after the
+        requested prefix) must match what the spec would have produced
+        host-side — cached per distinct layout signature."""
+        lay = cb.layout or {}
+        sig = (tuple(lay.get("columns", ())), tuple(lay.get("dtypes", ())))
+        ok = self._direct_sig_cache.get(sig)
+        if ok is None:
+            spec = self._spec
+            want = [*spec.feature_columns, spec.label_column]
+            n = len(want)
+            names = list(sig[0])
+            dtypes = [np.dtype(d) for d in sig[1]]
+            ok = names[:n] == want and len(dtypes) == len(names)
+            if ok:
+                for dt, want_t in zip(
+                    dtypes[:n], (*spec.feature_types, spec.label_type)
+                ):
+                    target = np.dtype(
+                        want_t if want_t is not None
+                        else _default_device_dtype(dt)
+                    )
+                    if dt != target or dt.itemsize != 4:
+                        ok = False
+                        break
+            self._direct_sig_cache[sig] = ok
+        return ok and self._direct_ok_flag
+
+    def _stage_direct(self, cb: ColumnBatch, prof):
+        """Zero-host-copy staging: one async ``device_put`` of the
+        batch's contiguous ``[n_spec_cols, batch]`` int32 prefix block
+        straight off the store's mmapped segment (the reducer packed the
+        requested columns first; extra dataset columns sit after the
+        prefix and never ship), then the existing jitted on-device
+        unpack (row slices + bitcasts). The H2D DMA sources the mmapped
+        pages directly — no rebatch, no host pack, no intermediate
+        buffer."""
+        lay = cb.layout
+        n = len(self._spec.feature_columns) + 1
+        mat = cb.packed[:n]  # contiguous prefix view
+        sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
+        with prof.phase("device_put", nbytes=mat.nbytes):
+            if jax.process_count() > 1:
+                packed_dev = jax.make_array_from_process_local_data(
+                    sharding, mat
+                )
+            else:
+                packed_dev = jax.device_put(mat, sharding)
+        with prof.phase("sync"):
+            names = tuple(lay["columns"][: n - 1])
+            dtypes = tuple(
+                str(np.dtype(d)) for d in lay["dtypes"][:n]
+            )
+            unpack = self._get_unpack(names, dtypes[:-1], dtypes[-1])
+            features, label_arr = unpack(packed_dev)
+        return features, label_arr, mat.nbytes
 
     # -- spec application ---------------------------------------------------
 
@@ -259,18 +374,65 @@ class JaxShufflingDataset:
         device) 21 small puts per batch were ~10x slower than one big
         one. Heterogeneous shapes/dtypes fall back to per-column staging.
         """
+        prof = _phases.stage_profiler("staging")
+        # Device-direct fast path: the batch arrived as a packed block
+        # already in staging layout — ship it without touching a byte on
+        # the host.
+        if cb.packed is not None and self._direct_ok(cb):
+            t0 = time.perf_counter()
+            try:
+                features, label_arr, nbytes = self._stage_direct(cb, prof)
+            except Exception:
+                # Same contract as the packed-path fallback below: an
+                # optimization must degrade, not sink the run — but a
+                # pod-wide divergence must surface.
+                if jax.process_count() > 1:
+                    raise
+                self._direct_ok_flag = False
+                _metrics.safe_inc("h2d.direct_fallback")
+                telemetry.emit_event(
+                    "staging.fallback", path="device-direct"
+                )
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "device-direct staging failed on this backend; "
+                    "falling back to host-side staging",
+                    exc_info=True,
+                )
+            else:
+                dispatch_s = time.perf_counter() - t0
+                self.stats.put_dispatch_s += dispatch_s
+                self.stats.bytes_staged_direct += nbytes
+                self.stats.batches_staged += 1
+                self.stats.batches_staged_direct += 1
+                if self._h2d_bytes is not None:
+                    self._h2d_bytes.inc(nbytes)
+                    self._h2d_batches.inc()
+                    self._h2d_dispatch_s.observe(dispatch_s)
+                    _metrics.safe_inc("h2d.direct_bytes", float(nbytes))
+                    _metrics.safe_inc("h2d.direct_batches")
+                if self.stats.batches_staged % 8 == 0:
+                    self.stats.sample_device_memory()
+                return features, label_arr
+
         spec = self._spec
         host: Dict[str, np.ndarray] = {}
         packable = True
-        for col, dtype, shape in zip(
-            spec.feature_columns, spec.feature_types, spec.feature_shapes
-        ):
-            arr = self._device_view(cb[col], dtype, shape)
-            host[col] = arr
-            packable = packable and arr.ndim == 1 and arr.dtype.itemsize == 4
-        label = self._device_view(
-            cb[spec.label_column], spec.label_type, spec.label_shape
-        )
+        with prof.phase("pack") as ph:
+            for col, dtype, shape in zip(
+                spec.feature_columns, spec.feature_types,
+                spec.feature_shapes,
+            ):
+                arr = self._device_view(cb[col], dtype, shape)
+                host[col] = arr
+                packable = (
+                    packable and arr.ndim == 1 and arr.dtype.itemsize == 4
+                )
+            label = self._device_view(
+                cb[spec.label_column], spec.label_type, spec.label_shape
+            )
+            ph.add_bytes(sum(a.nbytes for a in host.values()) + label.nbytes)
         packable = (
             packable
             and label.ndim == 1
@@ -286,18 +448,24 @@ class JaxShufflingDataset:
         features = None
         if packable and self._packed_ok:
             try:
-                features, label_arr, nbytes = self._stage_packed(host, label)
+                features, label_arr, nbytes = self._stage_packed(
+                    host, label, prof
+                )
             except Exception:
                 # Unvalidated backend corner (e.g. a plugin that rejects
                 # the jitted unpack): the packed path is an optimization,
                 # so degrade PERMANENTLY to per-column staging rather
-                # than sinking the run — and only warn once. On a
+                # than sinking the run — and only warn once, but leave a
+                # machine-readable trail (counter + event) so a silent
+                # per-column regression can't masquerade as load. On a
                 # multi-controller pod a unilateral fallback would diverge
                 # the ranks' global programs (the others keep unpacking),
                 # so there the failure must surface instead.
                 if jax.process_count() > 1:
                     raise
                 self._packed_ok = False
+                _metrics.safe_inc("h2d.packed_fallback")
+                telemetry.emit_event("staging.fallback", path="packed")
                 import logging
 
                 logging.getLogger(__name__).warning(
@@ -311,11 +479,12 @@ class JaxShufflingDataset:
             partial = cb.num_rows < self._ds.batch_size
             features = {}
             nbytes = 0
-            for col, arr in host.items():
-                features[col] = self._put(arr, partial=partial)
-                nbytes += arr.nbytes
-            label_arr = self._put(label, partial=partial)
-            nbytes += label.nbytes
+            with prof.phase("device_put"):
+                for col, arr in host.items():
+                    features[col] = self._put(arr, partial=partial)
+                    nbytes += arr.nbytes
+                label_arr = self._put(label, partial=partial)
+                nbytes += label.nbytes
         dispatch_s = time.perf_counter() - t0
         self.stats.put_dispatch_s += dispatch_s
         self.stats.bytes_staged += nbytes
@@ -328,7 +497,9 @@ class JaxShufflingDataset:
             self.stats.sample_device_memory()
         return features, label_arr
 
-    def _stage_packed(self, host: Dict[str, np.ndarray], label: np.ndarray):
+    def _stage_packed(
+        self, host: Dict[str, np.ndarray], label: np.ndarray, prof=None
+    ):
         """One transfer for the whole batch: bit-pack all 4-byte columns
         as int32 rows of a ``[n_cols+1, batch]`` buffer (float rows are
         bitcast back on device).
@@ -338,25 +509,31 @@ class JaxShufflingDataset:
         call per batch per process — the same single-transfer economics
         as the single-chip path (a pod previously paid ``n_cols+1``
         per-column assemblies per batch per host)."""
+        if prof is None:
+            prof = _phases.stage_profiler("staging")
         names = tuple(host)
         batch = label.shape[0]
-        packed = np.empty((len(names) + 1, batch), np.int32)
-        for i, name in enumerate(names):
-            packed[i] = host[name].view(np.int32)
-        packed[-1] = label.view(np.int32)
+        with prof.phase("pack") as ph:
+            packed = np.empty((len(names) + 1, batch), np.int32)
+            for i, name in enumerate(names):
+                packed[i] = host[name].view(np.int32)
+            packed[-1] = label.view(np.int32)
+            ph.add_bytes(packed.nbytes)
         sharding = NamedSharding(self.mesh, P(None, self.batch_axis))
-        if jax.process_count() > 1:
-            packed_dev = jax.make_array_from_process_local_data(
-                sharding, packed
+        with prof.phase("device_put", nbytes=packed.nbytes):
+            if jax.process_count() > 1:
+                packed_dev = jax.make_array_from_process_local_data(
+                    sharding, packed
+                )
+            else:
+                packed_dev = jax.device_put(packed, sharding)
+        with prof.phase("sync"):
+            unpack = self._get_unpack(
+                names,
+                tuple(str(host[n].dtype) for n in names),
+                str(label.dtype),
             )
-        else:
-            packed_dev = jax.device_put(packed, sharding)
-        unpack = self._get_unpack(
-            names,
-            tuple(str(host[n].dtype) for n in names),
-            str(label.dtype),
-        )
-        features, label_arr = unpack(packed_dev)
+            features, label_arr = unpack(packed_dev)
         return features, label_arr, packed.nbytes
 
     def _get_unpack(self, names, dtypes, label_dtype):

@@ -136,6 +136,7 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rsdl_take.argtypes = [p, p, p, c_i64, c_i64, c_i64, c_int]
     lib.rsdl_take.restype = c_int
     lib.rsdl_take_multi.argtypes = [p, p, c_i64, p, p, c_i64, c_i64, c_int]
+    lib.rsdl_take_multi.restype = c_int
     lib.rsdl_cast_i64_i32.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.argtypes = [p, p, c_i64, c_int]
     lib.rsdl_cast_i64_i32_checked.restype = c_int
@@ -170,7 +171,7 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             if candidate and os.path.exists(candidate):
                 try:
                     lib = _declare(ctypes.CDLL(candidate))
-                    if lib.rsdl_abi_version() == 4:
+                    if lib.rsdl_abi_version() == 5:
                         _lib = lib
                         break
                 except (OSError, AttributeError):
@@ -381,6 +382,12 @@ def take_multi(
     The reduce-stage hot path: `parts` are one column's partitions from all
     mappers, `idx` the epoch permutation over their concatenated rows.
     ``out`` lands the gather directly in a pre-allocated destination.
+
+    Bounds are checked INSIDE the fused kernel (free per row, like
+    take/scatter): the old Python ``idx.min()/idx.max()`` pre-scan cost
+    two full single-threaded passes per call on this — the hottest —
+    kernel (ROADMAP 2b residual). The numpy fallback paths still
+    pre-validate (they need the answer to pick sparse vs concat anyway).
     """
     if not parts:
         raise ValueError("need at least one part to concatenate")
@@ -398,7 +405,9 @@ def take_multi(
     )
     total = sum(len(p) for p in parts)
     idx_arr = np.asarray(idx)
-    in_bounds = _check_bounds(idx_arr, total)
+    is_int_idx = (
+        len(idx_arr) != 0 and np.issubdtype(idx_arr.dtype, np.integer)
+    )
     # Strategy: the fused kernel skips materializing the concat but pays a
     # per-row part lookup; a DENSE gather (idx covers ~all rows, the
     # reduce path) only wins fused when threads amortize that — on few
@@ -412,36 +421,47 @@ def take_multi(
         p.dtype == parts[0].dtype and p.shape[1:] == parts[0].shape[1:]
         for p in parts
     )
-    sparse = (
-        compat and len(parts) > 1 and in_bounds and 2 * len(idx_arr) < total
-    )
+    maybe_sparse = compat and len(parts) > 1 and 2 * len(idx_arr) < total
     threads = _resolve_threads(n_threads)
     if (
-        lib is None
-        or row_bytes is None
-        or not same
-        or len(parts) == 1
-        or (threads < 4 and not sparse)
-        or not in_bounds
+        lib is not None
+        and row_bytes is not None
+        and same
+        and len(parts) > 1
+        and (threads >= 4 or maybe_sparse)
+        and is_int_idx
     ):
-        if sparse:
-            return _take_multi_sparse(parts, idx_arr, out)
-        base = parts[0] if len(parts) == 1 else np.concatenate(parts)
-        return take(base, idx, out=out, n_threads=n_threads)
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
-    offsets = np.zeros(len(parts) + 1, dtype=np.int64)
-    np.cumsum([len(p) for p in parts], out=offsets[1:])
-    ptrs = (ctypes.c_void_p * len(parts))(*[p.ctypes.data for p in parts])
-    shape = (len(idx), *parts[0].shape[1:])
-    if not _out_ok(out, shape, parts[0].dtype):
-        out = np.empty(shape, dtype=parts[0].dtype)
-    # rsdl_take_multi dispatches typed inner loops for widths 1/2/4/8
-    # internally (the old separate take_multi8 entry point is gone).
-    lib.rsdl_take_multi(
-        ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx),
-        len(idx), row_bytes, threads,
-    )
-    return out
+        idx_c = np.ascontiguousarray(idx_arr, dtype=np.int64)
+        offsets = np.zeros(len(parts) + 1, dtype=np.int64)
+        np.cumsum([len(p) for p in parts], out=offsets[1:])
+        ptrs = (ctypes.c_void_p * len(parts))(*[p.ctypes.data for p in parts])
+        shape = (len(idx_c), *parts[0].shape[1:])
+        if not _out_ok(out, shape, parts[0].dtype):
+            out = np.empty(shape, dtype=parts[0].dtype)
+        # rsdl_take_multi dispatches typed inner loops for widths 1/2/4/8
+        # internally; rc != 0 means an index fell outside [0, total) and
+        # the slow path below re-derives exact numpy semantics.
+        rc = lib.rsdl_take_multi(
+            ptrs, _ptr(offsets), len(parts), _ptr(out), _ptr(idx_c),
+            len(idx_c), row_bytes, threads,
+        )
+        if rc == 0:
+            return out
+        try:
+            _check_bounds(idx_arr, total)  # IndexError if truly OOB
+        except IndexError:
+            # Restore the fresh-segment invariant of direct-to-store
+            # destinations before surfacing the error (error-path only).
+            out[...] = 0
+            raise
+        # Negative indices: numpy wraparound semantics via the concat.
+        np.take(np.concatenate(parts), idx_arr, axis=0, out=out)
+        return out
+    in_bounds = _check_bounds(idx_arr, total)  # raises when truly OOB
+    if maybe_sparse and in_bounds:
+        return _take_multi_sparse(parts, idx_arr, out)
+    base = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    return take(base, idx, out=out, n_threads=n_threads)
 
 
 def narrow_i64_checked(

@@ -93,14 +93,23 @@ void gather_bytes(const uint8_t* src, uint8_t* dst, const int64_t* idx,
 }
 
 // Typed concat+gather inner loop for rsdl_take_multi (plain indexed
-// load/store instead of a per-row variable-size memcpy).
+// load/store instead of a per-row variable-size memcpy). Bounds are
+// checked INLINE against the concat's total row count — like
+// gather_typed, the compare is well-predicted and free next to the
+// random part lookup, where the old Python idx.min()/idx.max() pre-scan
+// cost two full single-threaded passes per call (ROADMAP 2b residual).
 template <typename T>
 void take_multi_typed(const void** parts, const int64_t* row_offsets,
                       int64_t n_parts, T* out, const int64_t* idx,
-                      int64_t n, int n_threads) {
+                      int64_t n, int n_threads, std::atomic<int>* err) {
+  int64_t n_total = row_offsets[n_parts];
   parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
     for (int64_t i = begin; i < end; ++i) {
       int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_total)) {
+        err->store(1, std::memory_order_relaxed);
+        return;
+      }
       const int64_t* hi =
           std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
       int64_t p = hi - row_offsets - 1;
@@ -188,32 +197,41 @@ int rsdl_take(const void* src, void* dst, const int64_t* idx, int64_t n,
 // loop (a plain indexed load/store — take_multi_typed above); after
 // 32-bit decode narrowing EVERY column is 4 bytes wide, and the per-row
 // variable-size memcpy was the measured hot spot of the whole reduce
-// stage (BENCHLOG 2026-08-03).
-void rsdl_take_multi(const void** parts, const int64_t* row_offsets,
-                     int64_t n_parts, void* dst, const int64_t* idx,
-                     int64_t n, int64_t itemsize, int n_threads) {
+// stage (BENCHLOG 2026-08-03). Returns 0, or 1 if any index fell
+// outside [0, row_offsets[n_parts]) — dst contents are then unspecified
+// and the wrapper re-derives exact numpy semantics off the hot path
+// (the same contract as rsdl_take/rsdl_scatter).
+int rsdl_take_multi(const void** parts, const int64_t* row_offsets,
+                    int64_t n_parts, void* dst, const int64_t* idx,
+                    int64_t n, int64_t itemsize, int n_threads) {
+  std::atomic<int> err{0};
   switch (itemsize) {
     case 1:
       take_multi_typed(parts, row_offsets, n_parts,
-                       static_cast<uint8_t*>(dst), idx, n, n_threads);
-      return;
+                       static_cast<uint8_t*>(dst), idx, n, n_threads, &err);
+      return err.load();
     case 2:
       take_multi_typed(parts, row_offsets, n_parts,
-                       static_cast<uint16_t*>(dst), idx, n, n_threads);
-      return;
+                       static_cast<uint16_t*>(dst), idx, n, n_threads, &err);
+      return err.load();
     case 4:
       take_multi_typed(parts, row_offsets, n_parts,
-                       static_cast<uint32_t*>(dst), idx, n, n_threads);
-      return;
+                       static_cast<uint32_t*>(dst), idx, n, n_threads, &err);
+      return err.load();
     case 8:
       take_multi_typed(parts, row_offsets, n_parts,
-                       static_cast<uint64_t*>(dst), idx, n, n_threads);
-      return;
+                       static_cast<uint64_t*>(dst), idx, n, n_threads, &err);
+      return err.load();
   }
-  parallel_for(n, n_threads, [=](int64_t begin, int64_t end) {
+  int64_t n_total = row_offsets[n_parts];
+  parallel_for(n, n_threads, [=, &err](int64_t begin, int64_t end) {
     uint8_t* out = static_cast<uint8_t*>(dst);
     for (int64_t i = begin; i < end; ++i) {
       int64_t j = idx[i];
+      if (static_cast<uint64_t>(j) >= static_cast<uint64_t>(n_total)) {
+        err.store(1, std::memory_order_relaxed);
+        return;
+      }
       // Branchless-ish upper_bound over typically small n_parts.
       const int64_t* hi =
           std::upper_bound(row_offsets + 1, row_offsets + n_parts + 1, j);
@@ -223,6 +241,7 @@ void rsdl_take_multi(const void** parts, const int64_t* row_offsets,
                   src + (j - row_offsets[p]) * itemsize, itemsize);
     }
   });
+  return err.load();
 }
 
 // Narrowing casts used at HBM staging time (TPU wants 32-bit; disk schema
@@ -486,6 +505,6 @@ void rsdl_group_rows(const void* src, void* dst, const int32_t* assignment,
   }
 }
 
-int rsdl_abi_version() { return 4; }
+int rsdl_abi_version() { return 5; }
 
 }  // extern "C"

@@ -216,7 +216,7 @@ class StoreServer:
             batch = map_segment_file(path, object_id).slice(
                 int(rows[0]), int(rows[1])
             )
-            data = serialize_columns(batch.columns)
+            data = serialize_columns(batch.columns, layout=batch.layout)
         self.served_count += 1
         self.served_bytes += len(data)
         return data
@@ -267,7 +267,9 @@ class StoreServer:
             batch = map_segment_file(path, object_id).slice(
                 int(rows[0]), int(rows[1])
             )
-            total, bufs = serialize_columns_vectored(batch.columns)
+            total, bufs = serialize_columns_vectored(
+                batch.columns, layout=batch.layout
+            )
             keepalive = batch
         if cached is None:
             if len(self._map_cache) >= self._map_cache_cap:

@@ -212,11 +212,21 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
-def _plan_layout(spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]):
+def _plan_layout(
+    spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]],
+    layout: Optional[dict] = None,
+):
     """The single source of truth for the segment format: per-column meta,
     payload start, and total size for a ``{name: (shape, dtype)}`` spec.
     Used by the disk write path (``create_columns``) and the DCN wire path
-    (``serialize_columns``) so the two can never drift."""
+    (``serialize_columns``) so the two can never drift.
+
+    ``layout`` is an optional JSON-safe descriptor carried in the meta
+    blob — device-direct delivery stamps reducer outputs with their
+    staging layout (``{"kind": "device-batch", "batch": B, "columns":
+    [...], "dtypes": [...]}``) so every reader of the segment, local mmap
+    or cross-host fetch alike, knows the bytes are already in the
+    [n_cols, batch]-packed form ``jax.device_put`` stages directly."""
     meta: List[dict] = []
     offset = 0
     for name, (shape, dtype) in spec.items():
@@ -234,7 +244,10 @@ def _plan_layout(spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]):
         )
         offset += nbytes
     payload_bytes = _align(offset)
-    meta_blob = json.dumps({"columns": meta}).encode()
+    head: Dict[str, object] = {"columns": meta}
+    if layout is not None:
+        head["layout"] = layout
+    meta_blob = json.dumps(head).encode()
     payload_start = _align(_HEADER.size + len(meta_blob))
     total = payload_start + payload_bytes
     return meta, meta_blob, payload_start, total
@@ -271,9 +284,23 @@ class ColumnBatch(Mapping[str, np.ndarray]):
     constructed directly). Mapping protocol yields column name -> ndarray.
     """
 
-    def __init__(self, columns: Dict[str, np.ndarray], _keepalive=None):
+    def __init__(
+        self,
+        columns: Dict[str, np.ndarray],
+        _keepalive=None,
+        layout: Optional[dict] = None,
+        packed: Optional[np.ndarray] = None,
+    ):
         self._columns = columns
         self._keepalive = _keepalive
+        # Device-direct delivery (ISSUE 8): ``layout`` is the segment's
+        # staging-layout descriptor; ``packed`` is the contiguous
+        # ``[n_cols, batch]`` int32 block backing a single batch's
+        # logical column views (set only on per-batch views produced by
+        # :func:`iter_packed_batches` — the buffer ``jax.device_put``
+        # can stage with zero host-side copies).
+        self.layout = layout
+        self.packed = packed
         lengths = {len(v) for v in columns.values()}
         if len(lengths) > 1:
             raise ValueError(f"ragged columns: {lengths}")
@@ -340,10 +367,13 @@ class ColumnBatch(Mapping[str, np.ndarray]):
         )
 
     def slice(self, start: int, stop: int) -> "ColumnBatch":
-        """Zero-copy row slice."""
+        """Zero-copy row slice. A device-batch segment slices along its
+        batch axis, so the layout descriptor stays valid and rides the
+        view."""
         return ColumnBatch(
             {k: v[start:stop] for k, v in self._columns.items()},
             _keepalive=self._keepalive,
+            layout=self.layout,
         )
 
     def to_pandas(self):
@@ -368,6 +398,107 @@ class ColumnBatch(Mapping[str, np.ndarray]):
         return ColumnBatch(
             {k: np.concatenate([b[k] for b in batches]) for k in keys}
         )
+
+
+# ---------------------------------------------------------------------------
+# Device-batch (packed) segment layout (ISSUE 8: device-direct delivery)
+# ---------------------------------------------------------------------------
+# A reducer that knows the trainer's staging layout emits its batch-
+# aligned rows as ONE column named PACKED_COLUMN of shape
+# ``[n_batches, n_cols, batch]`` int32: batch ``b`` is the contiguous
+# ``[n_cols, batch]`` block the JAX stager ships to the device with a
+# single ``device_put`` straight off the mmapped segment (float columns
+# ride as int32 bit patterns and are bitcast back on device — the same
+# wire trick the legacy host-side pack used). The ``layout`` descriptor
+# in the segment meta names the logical columns, their true dtypes, and
+# the batch size, so every consumer — local mmap, legacy pickle fetch,
+# or the striped zero-copy TCP plane — can reconstruct zero-copy logical
+# column views without a repack.
+
+PACKED_COLUMN = "__packed__"
+DEVICE_BATCH_KIND = "device-batch"
+
+
+def is_device_batch(cb: "ColumnBatch") -> bool:
+    """Does this batch hold a packed device-layout body segment?"""
+    return (
+        cb.layout is not None
+        and cb.layout.get("kind") == DEVICE_BATCH_KIND
+        and PACKED_COLUMN in cb
+    )
+
+
+def device_batch_rows(cb: "ColumnBatch") -> int:
+    """Logical row count of a packed segment (batches x batch size)."""
+    mat = cb[PACKED_COLUMN]
+    return int(mat.shape[0]) * int(mat.shape[2])
+
+
+def iter_packed_batches(cb: "ColumnBatch") -> Iterator["ColumnBatch"]:
+    """Split a packed device-batch segment into per-batch views.
+
+    Each yielded batch is an ordinary :class:`ColumnBatch` whose logical
+    columns are ZERO-COPY views into the segment (row ``i`` of the block,
+    bit-viewed back to its true dtype), with ``.packed`` set to the
+    contiguous ``[n_cols, batch]`` int32 block for direct staging."""
+    lay = cb.layout or {}
+    mat = cb[PACKED_COLUMN]
+    names = lay["columns"]
+    dtypes = [np.dtype(d) for d in lay["dtypes"]]
+    for b in range(mat.shape[0]):
+        block = mat[b]
+        cols = {
+            name: block[i].view(dt)
+            for i, (name, dt) in enumerate(zip(names, dtypes))
+        }
+        yield ColumnBatch(
+            cols, _keepalive=cb._keepalive, layout=lay, packed=block
+        )
+
+
+class _LazyLogicalColumns(Mapping[str, np.ndarray]):
+    """Logical column access over a whole packed segment without
+    materializing every column: column ``name`` is the flattened
+    ``mat[:, i, :]`` plane (one contiguous copy of just that column,
+    built on first access). Audit digests read only the key column, so
+    this keeps the audit path O(key bytes), not O(segment bytes)."""
+
+    def __init__(self, cb: "ColumnBatch"):
+        self._mat = cb[PACKED_COLUMN]
+        lay = cb.layout or {}
+        self._names = list(lay["columns"])
+        self._dtypes = [np.dtype(d) for d in lay["dtypes"]]
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        out = self._cache.get(name)
+        if out is None:
+            try:
+                i = self._names.index(name)
+            except ValueError:
+                raise KeyError(name) from None
+            plane = self._mat[:, i, :]  # (n_batches, B), rows contiguous
+            out = plane.reshape(-1).view(self._dtypes[i])
+            self._cache[name] = out
+        return out
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def __contains__(self, name: object) -> bool:
+        return name in self._names
+
+
+def logical_columns(cb: "ColumnBatch") -> Mapping[str, np.ndarray]:
+    """Column-name -> 1-D logical array view of any batch: the identity
+    for ordinary columnar batches, a lazy per-column flatten for packed
+    device-batch segments (only accessed columns are materialized)."""
+    if is_device_batch(cb):
+        return _LazyLogicalColumns(cb)
+    return cb.columns
 
 
 class PendingColumns:
@@ -477,16 +608,19 @@ def map_segment_file(path: str, object_id: str = "?") -> ColumnBatch:
             offset=payload_start + m["offset"],
         ).reshape(m["shape"])
         cols[m["name"]] = arr
-    return ColumnBatch(cols, _keepalive=mm)
+    return ColumnBatch(cols, _keepalive=mm, layout=meta.get("layout"))
 
 
-def serialize_columns(columns: Mapping[str, np.ndarray]) -> bytes:
+def serialize_columns(
+    columns: Mapping[str, np.ndarray], layout: Optional[dict] = None
+) -> bytes:
     """Serialize columns into the segment wire/disk format (used by the
     cluster StoreServer to ship a ref's row window without the rest of the
-    segment)."""
+    segment). ``layout`` rides in the meta blob, so a fetched copy of a
+    device-batch segment lands on the reader already in staging layout."""
     cols = {k: np.ascontiguousarray(v) for k, v in columns.items()}
     meta, meta_blob, payload_start, total = _plan_layout(
-        {k: (v.shape, v.dtype) for k, v in cols.items()}
+        {k: (v.shape, v.dtype) for k, v in cols.items()}, layout=layout
     )
     out = bytearray(total)
     out[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
@@ -502,7 +636,7 @@ _PAD64 = bytes(_ALIGN)
 
 
 def serialize_columns_vectored(
-    columns: Mapping[str, np.ndarray],
+    columns: Mapping[str, np.ndarray], layout: Optional[dict] = None
 ) -> Tuple[int, List]:
     """``(total_bytes, buffers)`` for the segment wire/disk format WITHOUT
     materializing the payload: the buffers are the source column views
@@ -518,7 +652,7 @@ def serialize_columns_vectored(
         for k, v in columns.items()
     }
     meta, meta_blob, payload_start, total = _plan_layout(
-        {k: (v.shape, v.dtype) for k, v in cols.items()}
+        {k: (v.shape, v.dtype) for k, v in cols.items()}, layout=layout
     )
     head = bytearray(payload_start)
     head[: _HEADER.size] = _HEADER.pack(_MAGIC, len(meta_blob))
@@ -667,7 +801,9 @@ class ObjectStore:
         return None
 
     def create_columns(
-        self, spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]]
+        self,
+        spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]],
+        layout: Optional[dict] = None,
     ) -> "PendingColumns":
         """Allocate an unpublished segment and return writable column views.
 
@@ -676,11 +812,14 @@ class ObjectStore:
         of building host arrays and copying them in via :meth:`put_columns`
         — one full memory pass saved per stage. Fill the views, then
         ``seal()`` (one ref) or ``publish_slices()`` (hardlinked row-window
-        refs).
+        refs). ``layout`` stamps the segment with a staging-layout
+        descriptor (see :func:`_plan_layout`).
         """
         if faults.enabled():
             faults.fire("store.put")
-        meta, meta_blob, payload_start, total = _plan_layout(spec)
+        meta, meta_blob, payload_start, total = _plan_layout(
+            spec, layout=layout
+        )
 
         object_id = self._new_object_id()
         path = os.path.join(self._placement_dir(total), object_id)

@@ -519,6 +519,241 @@ def shuffle_plan(
     return refs
 
 
+# ---------------------------------------------------------------------------
+# Device-direct reducer output (ROADMAP 3 / ISSUE 8)
+# ---------------------------------------------------------------------------
+# When the consumer is the JAX staging path, it tells the shuffle its
+# staging layout — the ordered 4-byte columns (features then label) and
+# the training batch size B. The reduce stage then writes its permuted
+# rows DIRECTLY into that layout: the rank stream's batch grid is fixed
+# (batch k covers rank-stream rows [kB, (k+1)B)), so a reducer whose
+# rows occupy rank-stream interval [start, start+total) splits into
+#
+#   head  — rows [start, ceil(start/B)·B): the tail of a batch the
+#           previous reducer began (plain columnar remainder);
+#   body  — the m whole batches inside the interval, emitted as ONE
+#           packed segment of shape [m, n_cols, B] int32 (each batch a
+#           contiguous [n_cols, B] block, float columns as bit
+#           patterns) — exactly what one ``jax.device_put`` stages with
+#           no host-side rebatch/pack copy;
+#   tail  — the leftover rows carried into the next reducer's batch.
+#
+# The delivered row stream is bit-identical to the legacy columnar path
+# (the grid is where the consumer's carry rebatcher cut anyway); only
+# the straddling boundary batches (~1 per reducer) still take the
+# host-copy path. One layout pass replaces reduce-then-rebatch-then-
+# pack — the staged_gb ≈ 4.8x dataset_gb amplification every BENCH
+# point showed.
+
+
+class _PackedOutput:
+    """Batch-aligned device-layout destination for one reduce task.
+
+    Packs EVERY column of the reducer output — the consumer's requested
+    staging columns first (the contiguous prefix one ``device_put``
+    ships), any remaining dataset columns after — so the delivered
+    stream keeps the same column set as the legacy path: boundary
+    remainders concat cleanly with legacy segments in the consumer's
+    carry buffer, and audit digests can fold any key column."""
+
+    def __init__(self, store, layout: dict, start: int, total: int,
+                 names: List[str], col_dtypes: Dict[str, "np.dtype"]):
+        from ray_shuffling_data_loader_tpu.runtime.store import (
+            DEVICE_BATCH_KIND,
+            PACKED_COLUMN,
+        )
+
+        self.B = B = int(layout["batch"])
+        self.names = names = list(names)
+        self.dtypes = [np.dtype(col_dtypes[n]) for n in names]
+        self.ncols = len(names)
+        self.total = int(total)
+        self.h = h = min(total, (-int(start)) % B)
+        self.m = m = (total - h) // B
+        self.t = total - h - m * B
+        self._store = store
+        self._pendings: list = []
+        # Three sequential segment allocations: a failure on a later one
+        # (ENOSPC, injected store.put fault) must reclaim the earlier
+        # unpublished tmp files — no caller holds a reference to abort
+        # until __init__ returns.
+        try:
+            self.head = self._remainder(h)
+            if m:
+                descriptor = {
+                    "kind": DEVICE_BATCH_KIND,
+                    "batch": B,
+                    "columns": names,
+                    "dtypes": [d.str for d in self.dtypes],
+                }
+                self.body = store.create_columns(
+                    {
+                        PACKED_COLUMN: (
+                            (m, self.ncols, B), np.dtype(np.int32)
+                        )
+                    },
+                    layout=descriptor,
+                )
+                self._pendings.append(self.body)
+                self.mat = self.body.columns[PACKED_COLUMN]
+            else:  # pragma: no cover - engagement requires m >= 1
+                self.body = None
+                self.mat = None
+            self.tail = self._remainder(self.t)
+        except BaseException:
+            self.abort()
+            raise
+
+    def _remainder(self, rows: int):
+        if rows <= 0:
+            return None
+        p = self._store.create_columns(
+            {n: ((rows,), d) for n, d in zip(self.names, self.dtypes)}
+        )
+        self._pendings.append(p)
+        return p
+
+    def chunks(self):
+        """``(lo, hi, {name: writable view})`` destinations in output-row
+        order. Body views are rows of the packed block bit-viewed back to
+        the column dtype — a take/gather into them lands bytes already in
+        staging layout."""
+        if self.head is not None:
+            yield 0, self.h, self.head.columns
+        for b in range(self.m):
+            lo = self.h + b * self.B
+            views = {
+                n: self.mat[b, i].view(dt)
+                for i, (n, dt) in enumerate(zip(self.names, self.dtypes))
+            }
+            yield lo, lo + self.B, views
+        if self.tail is not None:
+            lo = self.h + self.m * self.B
+            yield lo, self.total, self.tail.columns
+
+    def scatter(self, dest: np.ndarray, cols) -> None:
+        """Scatter rows whose reducer-output positions are ``dest`` (a
+        slice of the inverted epoch permutation — unique indices by
+        construction) from ``cols`` into head/body/tail. The overlapped
+        reduce's placement op: the threaded scatter kernel releases the
+        GIL, so window N packs on every core while windows N+1..N+depth
+        are still in flight over DCN."""
+        from ray_shuffling_data_loader_tpu import native
+
+        B = self.B
+        body_lo, body_hi = self.h, self.h + self.m * B
+
+        def _sub(name, sel):
+            src = cols[name]
+            return src if sel is None else src[sel]
+
+        if self.head is not None:
+            mask = dest < body_lo
+            if mask.any():
+                sel = None if mask.all() else mask
+                idx = dest if sel is None else dest[sel]
+                for n in self.names:
+                    native.scatter(_sub(n, sel), idx, self.head.columns[n])
+        if self.m:
+            mask = (dest >= body_lo) & (dest < body_hi)
+            if mask.any():
+                sel = None if mask.all() else mask
+                rel = (dest if sel is None else dest[sel]) - body_lo
+                # Flat packed position of logical row r for column i:
+                # (r // B) * (n_cols * B) + i * B + (r % B); the constant
+                # i*B term rides as a base-offset view so ONE position
+                # array serves every column through the same threaded
+                # scatter kernel.
+                pos = (rel // B) * (self.ncols * B) + rel % B
+                flat = self.mat.reshape(-1)
+                for i, n in enumerate(self.names):
+                    src = _sub(n, sel)
+                    if src.dtype != np.int32:
+                        src = src.view(np.int32)
+                    native.scatter(src, pos, flat[i * B:])
+        if self.tail is not None:
+            mask = dest >= body_hi
+            if mask.any():
+                sel = None if mask.all() else mask
+                idx = (dest if sel is None else dest[sel]) - body_hi
+                for n in self.names:
+                    native.scatter(_sub(n, sel), idx, self.tail.columns[n])
+
+    def key_column(self, name: str) -> np.ndarray:
+        """The logical values of one column across head+body+tail (the
+        audit digest input; body planes flatten through one contiguous
+        copy of just that column)."""
+        i = self.names.index(name)
+        dt = self.dtypes[i]
+        pieces = []
+        if self.head is not None:
+            pieces.append(self.head.columns[name])
+        if self.m:
+            pieces.append(self.mat[:, i, :].reshape(-1).view(dt))
+        if self.tail is not None:
+            pieces.append(self.tail.columns[name])
+        if not pieces:
+            return np.empty(0, dt)
+        return pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+
+    def record_audit(self, epoch: int, reduce_index: int) -> None:
+        key = _audit.key_column_name()
+        cols = {key: self.key_column(key)} if key in self.names else {}
+        _audit.record_reduce(epoch, reduce_index, cols)
+
+    def seal(self) -> List[ObjectRef]:
+        """Publish head/body/tail (skipping absent pieces) in delivery
+        order."""
+        refs = []
+        for p in (self.head, self.body, self.tail):
+            if p is not None:
+                refs.append(p.seal())
+        return refs
+
+    def abort(self) -> None:
+        for p in self._pendings:
+            p.abort()
+
+
+def _packed_output(store, pack, total: int, template) -> Optional[_PackedOutput]:
+    """A :class:`_PackedOutput` when device-direct packing can engage for
+    this reducer — the task got a layout, every reducer column is a flat
+    4-byte column with the requested columns present, and the interval
+    holds at least one whole aligned batch — else None (the legacy
+    columnar segment is emitted; refs are self-describing, so consumers
+    handle a mixed stream)."""
+    if pack is None or total <= 0 or template is None:
+        return None
+    start, layout = pack
+    try:
+        B = int(layout["batch"])
+        req = list(layout["columns"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if B <= 0 or not req:
+        return None
+    try:
+        all_names = list(template)
+    except TypeError:
+        return None
+    if any(n not in all_names for n in req):
+        return None
+    # Requested staging columns first (the device_put prefix), every
+    # other reducer column after — the stream's column set matches the
+    # legacy path exactly.
+    names = req + [n for n in all_names if n not in req]
+    col_dtypes: Dict[str, np.dtype] = {}
+    for n in names:
+        v = template[n]
+        if v.dtype.itemsize != 4 or v.shape[1:] != ():
+            return None
+        col_dtypes[n] = v.dtype
+    h = min(total, (-int(start)) % B)
+    if (total - h) // B < 1:
+        return None
+    return _PackedOutput(store, layout, start, total, names, col_dtypes)
+
+
 def shuffle_gather_reduce(
     reduce_index: int,
     epoch: int,
@@ -526,6 +761,7 @@ def shuffle_gather_reduce(
     idx_refs: Sequence[ObjectRef],
     cache_refs: Sequence[ObjectRef],
     stats_collector=None,
+    pack=None,
 ) -> ObjectRef:
     """Reduce stage for the index schedule: ONE sparse gather straight out
     of the cached decoded file segments, replacing the materialized path's
@@ -561,11 +797,16 @@ def shuffle_gather_reduce(
             rng = _reduce_seed(seed, epoch, reduce_index)
             perm = rng.permutation(total)
         template = caches[0] if caches else None
-        pending = ctx.store.create_columns(
-            {
-                k: ((total, *template[k].shape[1:]), template[k].dtype)
-                for k in (template or {})
-            }
+        packed_out = _packed_output(ctx.store, pack, total, template)
+        pending = (
+            ctx.store.create_columns(
+                {
+                    k: ((total, *template[k].shape[1:]), template[k].dtype)
+                    for k in (template or {})
+                }
+            )
+            if packed_out is None
+            else None
         )
         try:
             # Two locality-friendly passes instead of one fully-random
@@ -577,30 +818,51 @@ def shuffle_gather_reduce(
             # full-cache random gather blows through (measured 2.2x).
             from ray_shuffling_data_loader_tpu import native
 
-            keys = list(template or {})
+            gather_keys = (
+                packed_out.names if packed_out is not None
+                else list(template or {})
+            )
             with prof.phase("gather") as ph:
                 compact = {
                     k: np.empty(
                         (total, *template[k].shape[1:]), template[k].dtype
                     )
-                    for k in keys
+                    for k in gather_keys
                 }
                 for i, (idx_i, cache) in enumerate(zip(idx_parts, caches)):
                     lo, hi = int(dst_off[i]), int(dst_off[i + 1])
                     if hi > lo:
-                        for k in keys:
+                        for k in gather_keys:
                             native.take(
                                 cache[k], idx_i, out=compact[k][lo:hi]
                             )
-                for k, dst in pending.columns.items():
-                    native.take(compact[k], perm, out=dst)
+                if packed_out is not None:
+                    # Pass 2 writes straight into the batch-aligned
+                    # device layout — the permute IS the pack.
+                    for lo, hi, views in packed_out.chunks():
+                        for k, dst in views.items():
+                            native.take(compact[k], perm[lo:hi], out=dst)
+                else:
+                    for k, dst in pending.columns.items():
+                        native.take(compact[k], perm, out=dst)
                 ph.add_bytes(2 * sum(v.nbytes for v in compact.values()))
             if _audit.enabled():
-                _audit.record_reduce(epoch, reduce_index, pending.columns)
+                if packed_out is not None:
+                    packed_out.record_audit(epoch, reduce_index)
+                else:
+                    _audit.record_reduce(
+                        epoch, reduce_index, pending.columns
+                    )
             with prof.phase("publish"):
-                out_ref = pending.seal()
+                out_ref = (
+                    packed_out.seal() if packed_out is not None
+                    else pending.seal()
+                )
         finally:
-            pending.abort()
+            if pending is not None:
+                pending.abort()
+            if packed_out is not None:
+                packed_out.abort()
         del pending
     finally:
         # Drop mmap views before the driver can free/unlink; only the idx
@@ -644,7 +906,7 @@ def _fetch_window_depth() -> int:
 
 
 def _overlapped_reduce(
-    store, part_refs, counts, reduce_index, epoch, seed, prof
+    store, part_refs, counts, reduce_index, epoch, seed, prof, pack=None
 ):
     """Reduce-side fetch/gather overlap: prefetch mapper-partition
     windows N+1..N+depth over DCN while scattering window N into the
@@ -683,6 +945,8 @@ def _overlapped_reduce(
             np.arange(total, dtype=np.int64), perm, inv
         )
     pending = None
+    packed_out = None
+    allocated = False
     try:
         for i, ref in enumerate(part_refs):
             if i + depth < len(part_refs):
@@ -693,13 +957,16 @@ def _overlapped_reduce(
             with prof.phase("window-fetch") as ph:
                 part = store.get_columns(ref)
                 ph.add_bytes(part.nbytes)
-            if pending is None:
-                pending = store.create_columns(
-                    {
-                        k: ((total, *part[k].shape[1:]), part[k].dtype)
-                        for k in part
-                    }
-                )
+            if not allocated:
+                allocated = True
+                packed_out = _packed_output(store, pack, total, part)
+                if packed_out is None:
+                    pending = store.create_columns(
+                        {
+                            k: ((total, *part[k].shape[1:]), part[k].dtype)
+                            for k in part
+                        }
+                    )
             lo, hi = int(dst_off[i]), int(dst_off[i + 1])
             if hi > lo:
                 with prof.phase("gather", nbytes=2 * part.nbytes):
@@ -710,23 +977,36 @@ def _overlapped_reduce(
                     # threads (the C call releases the GIL). dest is a
                     # permutation slice — unique indices by construction.
                     dest = inv[lo:hi]
-                    for k, dst in pending.columns.items():
-                        native.scatter(part[k], dest, dst)
+                    if packed_out is not None:
+                        # Device-direct: the window lands straight in the
+                        # batch-aligned staging layout (head/body/tail).
+                        packed_out.scatter(dest, part)
+                    else:
+                        for k, dst in pending.columns.items():
+                            native.scatter(part[k], dest, dst)
             del part
             # This window is consumed; dropping its fetched copy now
             # bounds peak local residency at ~depth windows (drop_cache
             # no-ops for local refs; the authoritative copy survives, so
             # the task stays retryable).
             store.drop_cache([ref])
-        if pending is None:
+        if pending is None and packed_out is None:
             pending = store.create_columns({})
         if _audit.enabled():
-            _audit.record_reduce(epoch, reduce_index, pending.columns)
+            if packed_out is not None:
+                packed_out.record_audit(epoch, reduce_index)
+            else:
+                _audit.record_reduce(epoch, reduce_index, pending.columns)
         with prof.phase("publish"):
-            out_ref = pending.seal()
+            out_ref = (
+                packed_out.seal() if packed_out is not None
+                else pending.seal()
+            )
     finally:
         if pending is not None:
             pending.abort()  # reclaims on failure; no-op after seal
+        if packed_out is not None:
+            packed_out.abort()
     return out_ref, total
 
 
@@ -736,6 +1016,7 @@ def shuffle_reduce(
     seed: int,
     part_refs: Sequence[ObjectRef],
     stats_collector=None,
+    pack=None,
 ) -> ObjectRef:
     """Reduce stage: concat this reducer's partition from every mapper and
     fully permute it (reference ``shuffle_reduce``, ``shuffle.py:171-200``).
@@ -748,6 +1029,11 @@ def shuffle_reduce(
     (``RSDL_REDUCE_FETCH_OVERLAP=auto|on|off``; ``auto`` engages only
     when a DCN fetch actually exists, so the single-host path keeps the
     fused native concat-take untouched).
+
+    ``pack``: device-direct delivery — ``(rank_stream_start, layout)``
+    from the driver makes the permute write straight into batch-aligned
+    staging layout (see :class:`_PackedOutput`); the task then returns a
+    short LIST of refs (head/body/tail) instead of one columnar ref.
     """
     if _faults.enabled():
         _faults.fire("task.reduce", epoch=epoch, point="entry")
@@ -780,7 +1066,8 @@ def shuffle_reduce(
         )
         if overlap:
             out_ref, total_rows = _overlapped_reduce(
-                store, part_refs, counts, reduce_index, epoch, seed, prof
+                store, part_refs, counts, reduce_index, epoch, seed, prof,
+                pack=pack,
             )
         else:
             with prof.phase("window-fetch") as ph:
@@ -794,31 +1081,72 @@ def shuffle_reduce(
             # INTO the output segment — this stage's only full data pass
             # (put_columns copy-out eliminated).
             template = parts[0] if parts else None
-            pending = ctx.store.create_columns(
-                {
-                    k: (
-                        (total_rows, *template[k].shape[1:]),
-                        template[k].dtype,
-                    )
-                    for k in (template or {})
-                }
+            packed_out = _packed_output(ctx.store, pack, total_rows, template)
+            pending = (
+                ctx.store.create_columns(
+                    {
+                        k: (
+                            (total_rows, *template[k].shape[1:]),
+                            template[k].dtype,
+                        )
+                        for k in (template or {})
+                    }
+                )
+                if packed_out is None
+                else None
             )
             try:
                 with prof.phase("gather") as ph:
-                    ColumnBatch.concat_take(parts, perm, out=pending.columns)
-                    ph.add_bytes(
-                        2 * sum(v.nbytes for v in pending.columns.values())
-                    )
+                    if packed_out is not None:
+                        # Device-direct: the SAME fused concat-take, cut
+                        # at the rank stream's batch grid so each chunk
+                        # gathers straight into its staging-layout
+                        # destination — the permute IS the pack.
+                        from ray_shuffling_data_loader_tpu import native
+
+                        live = [p for p in parts if p.num_rows > 0]
+                        col_parts = {
+                            n: [p[n] for p in live]
+                            for n in packed_out.names
+                        }
+                        moved = 0
+                        for lo, hi, views in packed_out.chunks():
+                            for n, dst in views.items():
+                                native.take_multi(
+                                    col_parts[n], perm[lo:hi], out=dst
+                                )
+                                moved += dst.nbytes
+                        ph.add_bytes(2 * moved)
+                    else:
+                        ColumnBatch.concat_take(
+                            parts, perm, out=pending.columns
+                        )
+                        ph.add_bytes(
+                            2
+                            * sum(
+                                v.nbytes
+                                for v in pending.columns.values()
+                            )
+                        )
                 if _audit.enabled():
                     # Reduce-side digest of the permuted output, while the
                     # writable views are still alive.
-                    _audit.record_reduce(
-                        epoch, reduce_index, pending.columns
-                    )
+                    if packed_out is not None:
+                        packed_out.record_audit(epoch, reduce_index)
+                    else:
+                        _audit.record_reduce(
+                            epoch, reduce_index, pending.columns
+                        )
                 with prof.phase("publish"):
-                    out_ref = pending.seal()
+                    out_ref = (
+                        packed_out.seal() if packed_out is not None
+                        else pending.seal()
+                    )
             finally:
-                pending.abort()  # reclaims on failure; no-op on seal
+                if pending is not None:
+                    pending.abort()  # reclaims on failure; no-op on seal
+                if packed_out is not None:
+                    packed_out.abort()
             del pending
     finally:
         # Input partitions are NOT freed here — the driver frees them after
@@ -1198,38 +1526,58 @@ def _index_schedule_allowed(
     return t_index <= t_mat
 
 
-def _audit_deliver(store, out_ref, epoch, reducer, rank, offsets):
+def _audit_deliver(store, out_refs, epoch, reducer, rank, offsets):
     """Delivery-side audit hook (audit-on only): digest the reducer
-    output exactly as it is about to be handed to the consumer, tracking
-    each rank's running row offset for the order-sensitive determinism
-    digest. Also the injection point for the test-only ``drop-row``
-    fault: the returned ref (with one row silently removed) REPLACES the
-    real output, so a delivery-path defect is reproducible on demand and
-    must surface as a digest mismatch at reconcile."""
+    output (one or more refs — device-direct delivery splits a reducer
+    into head/body/tail) exactly as it is about to be handed to the
+    consumer, tracking each rank's running row offset for the
+    order-sensitive determinism digest. Also the injection point for the
+    test-only ``drop-row`` fault: the returned ref list (with one row
+    silently removed from the final piece) REPLACES the real output, so
+    a delivery-path defect is reproducible on demand and must surface as
+    a digest mismatch at reconcile."""
+    from ray_shuffling_data_loader_tpu.runtime.store import (
+        device_batch_rows,
+        is_device_batch,
+        logical_columns,
+    )
+
+    def _rows(cb):
+        return device_batch_rows(cb) if is_device_batch(cb) else cb.num_rows
+
+    out_refs = list(out_refs)
     try:
-        if _audit.take_fault("drop-row", epoch):
-            cb = store.get_columns(out_ref)
-            if cb.num_rows > 0:
+        if out_refs and _audit.take_fault("drop-row", epoch):
+            cb = store.get_columns(out_refs[-1])
+            nrows = _rows(cb)
+            if nrows > 0:
+                # Republished as plain columnar (a packed piece re-packs
+                # logically) minus its last row — the consumer's mixed-
+                # stream handling delivers it unchanged otherwise.
+                cols = logical_columns(cb)
                 dropped = store.put_columns(
-                    cb.slice(0, cb.num_rows - 1).columns
+                    {k: np.asarray(cols[k])[: nrows - 1] for k in cols}
                 )
                 del cb
-                store.free(out_ref)
-                out_ref = dropped
+                store.free(out_refs[-1])
+                out_refs[-1] = dropped
             else:
                 del cb
-        cb = store.get_columns(out_ref)
-        offset = offsets.get(rank, 0)
-        _audit.record_deliver(epoch, reducer, rank, cb.columns, offset)
-        offsets[rank] = offset + cb.num_rows
-        del cb
+        for ref in out_refs:
+            cb = store.get_columns(ref)
+            offset = offsets.get(rank, 0)
+            _audit.record_deliver(
+                epoch, reducer, rank, logical_columns(cb), offset
+            )
+            offsets[rank] = offset + _rows(cb)
+            del cb
     except Exception:
         import logging
 
         logging.getLogger(__name__).warning(
             "audit: delivery digest failed", exc_info=True
         )
-    return out_ref
+    return out_refs
 
 
 def shuffle_epoch(
@@ -1243,8 +1591,16 @@ def shuffle_epoch(
     narrow_to_32: bool = False,
     decode_cache: Optional[_DecodeCache] = None,
     schedule_log: Optional[list] = None,
+    device_layout: Optional[dict] = None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
+
+    ``device_layout``: device-direct delivery (ROADMAP 3) — a
+    ``{"batch": B, "columns": [...]}`` staging layout from the consumer.
+    Once every map resolves (so per-reducer row counts are known), each
+    reduce task learns its rank-stream start offset and emits
+    batch-aligned packed bodies plus boundary remainders instead of one
+    columnar segment; the delivered row stream is bit-identical.
 
     Submits all map tasks, then all reduce tasks (each gated on its mapper
     inputs), and streams completed reducer outputs to the consumer in
@@ -1512,6 +1868,35 @@ def shuffle_epoch(
                     else (shuffle_reduce, ())
                 )
 
+                # Device-direct delivery: per-reducer rank-stream start
+                # offsets, derivable the moment every map resolved (the
+                # partition/plan window refs carry row counts). Both
+                # schedules' per-file refs are row windows, so the counts
+                # exist without opening a single segment; any unknown
+                # count (whole-segment ref) disables packing for the
+                # epoch — columnar refs are always legal.
+                pack_for: List[Optional[tuple]] = [None] * num_reducers
+                if device_layout is not None:
+                    counts_r: List[Optional[int]] = []
+                    for r in range(num_reducers):
+                        rows = [
+                            _ref_window_rows(refs[r])
+                            for refs in per_file_refs
+                        ]
+                        counts_r.append(
+                            None
+                            if any(c is None for c in rows)
+                            else int(sum(rows))
+                        )
+                    if all(c is not None for c in counts_r):
+                        acc: Dict[int, int] = {}
+                        for r in range(num_reducers):
+                            rnk = int(rank_of[r])
+                            pack_for[r] = (
+                                acc.get(rnk, 0), device_layout
+                            )
+                            acc[rnk] = acc.get(rnk, 0) + counts_r[r]
+
                 def _submit_reduce(r, refs_r):
                     return pool.submit_local_to(
                         refs_r,
@@ -1522,6 +1907,7 @@ def shuffle_epoch(
                         refs_r,
                         *extra,
                         stats_collector,
+                        pack_for[r],
                     )
 
                 reduce_futs = [
@@ -1663,7 +2049,14 @@ def shuffle_epoch(
                 # completes, preserving reducer order within a rank for
                 # determinism.
                 for r, fut in enumerate(reduce_futs):
-                    out_ref = _await_reduce(r, fut)
+                    out = _await_reduce(r, fut)
+                    # Device-direct reducers return a short LIST of refs
+                    # (head/body/tail); legacy reducers one columnar ref.
+                    out_refs = (
+                        list(out)
+                        if isinstance(out, (list, tuple))
+                        else [out]
+                    )
                     rank = int(rank_of[r])
                     if _faults.enabled():
                         # The scripted producer-stall (or kill: a dead
@@ -1671,9 +2064,9 @@ def shuffle_epoch(
                         # supervision detects on the consumer side).
                         _faults.fire("queue.producer", epoch=epoch)
                     if _audit.enabled():
-                        out_ref = _audit_deliver(
+                        out_refs = _audit_deliver(
                             runtime.get_context().store,
-                            out_ref, epoch, r, rank, audit_offsets,
+                            out_refs, epoch, r, rank, audit_offsets,
                         )
                     # The span covers the consumer handoff INCLUDING any
                     # blocking inside it (queue put_batch backpressure) — on
@@ -1682,11 +2075,12 @@ def shuffle_epoch(
                     with telemetry.trace_span(
                         "deliver", cat="queue", rank=rank, reducer=r
                     ):
-                        batch_consumer.consume(rank, epoch, [out_ref])
+                        batch_consumer.consume(rank, epoch, out_refs)
                     _status_epoch(epoch, delivered_inc=1)
                     if stats_collector is not None:
                         stats_collector.call_oneway(
-                            "consume", rank, epoch, out_ref.nbytes
+                            "consume", rank, epoch,
+                            sum(ref.nbytes for ref in out_refs),
                         )
                     if r + 1 == num_reducers or rank_of[r + 1] != rank:
                         batch_consumer.producer_done(rank, epoch)
@@ -1723,6 +2117,27 @@ def shuffle_epoch(
     return thread
 
 
+def device_direct_enabled() -> bool:
+    """The ONE parser of the ``RSDL_DEVICE_DIRECT`` kill switch (default
+    ``auto`` = honor consumer layout requests). Shared by the shuffle
+    gate, the stager's request builder, and bench reporting so the
+    disable spellings can never drift apart."""
+    return os.environ.get(
+        "RSDL_DEVICE_DIRECT", "auto"
+    ).strip().lower() not in ("off", "0", "false")
+
+
+def _device_layout_allowed(device_layout: Optional[dict]) -> Optional[dict]:
+    """The authoritative device-direct gate: honor the consumer's layout
+    request unless ``RSDL_DEVICE_DIRECT=off`` (the kill switch). Audit
+    needs no special-casing — packed segments carry every reducer column
+    (requested prefix first), so any key column the legacy path could
+    digest, the packed path digests too."""
+    if device_layout is None or not device_direct_enabled():
+        return None
+    return device_layout
+
+
 def shuffle(
     filenames: List[str],
     batch_consumer: BatchConsumer,
@@ -1735,6 +2150,7 @@ def shuffle(
     narrow_to_32: bool = False,
     cache_decoded: Optional[bool] = None,
     schedule_log: Optional[list] = None,
+    device_layout: Optional[dict] = None,
 ) -> float:
     """Shuffle the dataset every epoch; returns total wall-clock duration.
 
@@ -1752,6 +2168,11 @@ def shuffle(
 
     ``schedule_log``: optional list; each epoch appends
     ``(epoch, "index" | "mapreduce")`` — observability for tests/bench.
+
+    ``device_layout``: device-direct delivery (ROADMAP 3, see
+    :func:`shuffle_epoch`) — ``{"batch": B, "columns": [...]}`` from a
+    staging consumer; honored unless the ``RSDL_DEVICE_DIRECT`` kill
+    switch is off (:func:`_device_layout_allowed`).
     """
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
@@ -1785,6 +2206,7 @@ def shuffle(
             filenames, num_epochs - start_epoch, narrow_to_32
         )
     decode_cache = _DecodeCache(enabled=cache_decoded)
+    device_layout = _device_layout_allowed(device_layout)
     start = timeit.default_timer()
     threads = []
     try:
@@ -1820,6 +2242,7 @@ def shuffle(
                     narrow_to_32=narrow_to_32,
                     decode_cache=decode_cache,
                     schedule_log=schedule_log,
+                    device_layout=device_layout,
                 )
             )
         for t in threads:

@@ -46,6 +46,14 @@ PHASES = (
     "permute",           # epoch permutation draw (reduce)
     "gather",            # concat-take / sparse gather passes (reduce)
     "publish",           # output segment seal / slice publish (all)
+    # Staging sub-phases (stage="staging"): the old monolithic staging
+    # cost split so the device-direct win is attributable in /metrics,
+    # epoch reports, and rsdl_top (ISSUE 8 satellite).
+    "rebatch",           # carry-buffer re-cut of reducer outputs (host)
+    "pack",              # host-side [n_cols, batch] pack / dtype convert
+    "device_put",        # H2D transfer dispatch (device_put/make_array)
+    "sync",              # on-device unpack dispatch (where a backed-up
+                         # transfer queue would block the stager)
 )
 
 

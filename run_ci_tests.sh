@@ -76,6 +76,22 @@ if [ "$tier" != "slow" ]; then
     echo "epoch_report failed to flag the injected regression" >&2
     exit 1
   fi
+  # Device-direct lane (ISSUE 8): reducer outputs in staging layout
+  # forced ON across the core data-path suites — batch-aligned packed
+  # bodies + boundary remainders must be invisible to every existing
+  # consumer (bit-identical streams), reconcile exactly-once under
+  # RSDL_AUDIT (packed segments digest through their logical column
+  # views), and survive the chaos schedule (a retried reduce re-packs
+  # against the same rank-stream offsets). Exit-code gated like every
+  # other lane.
+  RSDL_DEVICE_DIRECT=on \
+    RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=4321 \
+    python -m pytest tests/test_device_direct.py \
+      tests/test_device_direct_audit.py tests/test_jax_dataset.py \
+      tests/test_dataset.py tests/test_shuffle.py \
+      -m "not slow" -q -x
   # Temporal-obs smoke (ISSUE 7), exit-code gated: against a MID-FLIGHT
   # shuffle with the obs endpoint up, /timeseries must serve a non-empty
   # rate series for rsdl_shuffle_map_rows, `rsdl_top --once --json` must

@@ -1,0 +1,434 @@
+"""Device-direct delivery (ISSUE 8): layout-descriptor round-trips
+through the store (disk + wire formats), batch-grid alignment math,
+bit-identical streams with the layout on vs off, partial-final-batch
+handling, and the staged-vs-delivered audit reconcile on the new path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu.runtime.store import (
+    DEVICE_BATCH_KIND,
+    PACKED_COLUMN,
+    ColumnBatch,
+    device_batch_rows,
+    is_device_batch,
+    iter_packed_batches,
+    logical_columns,
+    map_segment_file,
+    serialize_columns,
+    serialize_columns_vectored,
+)
+
+LABEL = "labels"
+
+
+def _descriptor(names, dtypes, batch):
+    return {
+        "kind": DEVICE_BATCH_KIND,
+        "batch": int(batch),
+        "columns": list(names),
+        "dtypes": [np.dtype(d).str for d in dtypes],
+    }
+
+
+def _packed_segment(m=3, batch=8, seed=0):
+    """A [m, n_cols, batch] packed matrix + its logical truth."""
+    rng = np.random.default_rng(seed)
+    names = ["a", "b", LABEL]
+    dtypes = [np.int32, np.int32, np.float32]
+    logical = {
+        "a": rng.integers(0, 1 << 20, m * batch).astype(np.int32),
+        "b": rng.integers(0, 1 << 20, m * batch).astype(np.int32),
+        LABEL: rng.random(m * batch).astype(np.float32),
+    }
+    mat = np.empty((m, len(names), batch), np.int32)
+    for b in range(m):
+        for i, n in enumerate(names):
+            mat[b, i] = (
+                logical[n][b * batch : (b + 1) * batch].view(np.int32)
+            )
+    return mat, logical, _descriptor(names, dtypes, batch)
+
+
+# ---------------------------------------------------------------------------
+# Layout descriptor round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_layout_roundtrip_store_publish(local_runtime):
+    """create_columns(layout=...) -> seal -> get_columns preserves the
+    descriptor, and the per-batch views reconstruct the logical columns
+    exactly (zero-copy bit views)."""
+    from ray_shuffling_data_loader_tpu import runtime
+
+    store = runtime.get_context().store
+    mat, logical, descriptor = _packed_segment()
+    pending = store.create_columns(
+        {PACKED_COLUMN: (mat.shape, np.dtype(np.int32))},
+        layout=descriptor,
+    )
+    try:
+        np.copyto(pending.columns[PACKED_COLUMN], mat)
+        ref = pending.seal()
+    finally:
+        pending.abort()
+    cb = store.get_columns(ref)
+    assert is_device_batch(cb)
+    assert cb.layout == descriptor
+    assert device_batch_rows(cb) == 3 * 8
+    # Per-batch views: contiguous staging block + logical columns.
+    rows = 0
+    for pb in iter_packed_batches(cb):
+        assert pb.packed is not None and pb.packed.flags.c_contiguous
+        assert pb.num_rows == 8
+        for name in descriptor["columns"]:
+            np.testing.assert_array_equal(
+                pb[name], logical[name][rows : rows + 8]
+            )
+        rows += 8
+    # Whole-segment logical view (the audit path).
+    cols = logical_columns(cb)
+    for name in descriptor["columns"]:
+        np.testing.assert_array_equal(cols[name], logical[name])
+    store.free(ref)
+
+
+def test_layout_roundtrip_wire_formats(tmp_path):
+    """serialize_columns(layout=...) and the vectored scatter-gather
+    serializer produce byte-identical output that map_segment_file reads
+    back with the descriptor intact — the striped zero-copy TCP plane
+    ships stripes of exactly these bytes, so byte identity here IS the
+    wire-format layout proof."""
+    mat, logical, descriptor = _packed_segment(seed=7)
+    cols = {PACKED_COLUMN: mat}
+    blob = serialize_columns(cols, layout=descriptor)
+    total, bufs = serialize_columns_vectored(cols, layout=descriptor)
+    joined = b"".join(bytes(b) for b in bufs)
+    assert total == len(blob)
+    assert joined == blob  # stripe-served bytes == legacy bytes
+    path = tmp_path / "seg"
+    path.write_bytes(blob)
+    cb = map_segment_file(str(path))
+    assert cb.layout == descriptor
+    for name in descriptor["columns"]:
+        np.testing.assert_array_equal(
+            logical_columns(cb)[name], logical[name]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Batch-grid alignment math (_PackedOutput)
+# ---------------------------------------------------------------------------
+
+
+def test_packed_output_alignment_and_chunks(local_runtime):
+    """head/body/tail partition the reducer interval against the rank
+    stream's batch grid for arbitrary (start, total, B); chunk views
+    cover [0, total) exactly once in order."""
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.shuffle import _packed_output
+
+    store = runtime.get_context().store
+    rng = np.random.default_rng(3)
+    for start, total, B in [
+        (0, 64, 8), (3, 64, 8), (5, 9, 8), (7, 23, 8), (16, 40, 8),
+        (1, 255, 16),
+    ]:
+        template = {
+            "a": np.zeros(1, np.int32), LABEL: np.zeros(1, np.float32)
+        }
+        layout = {"batch": B, "columns": ["a", LABEL]}
+        out = _packed_output(store, (start, layout), total, template)
+        h = min(total, (-start) % B)
+        m = (total - h) // B
+        if m < 1:
+            assert out is None  # remainder-only: legacy columnar path
+            continue
+        t = total - h - m * B
+        assert (out.h, out.m, out.t) == (h, m, t)
+        # Chunks tile [0, total) in order; write through the views and
+        # verify every logical row landed where its stream position says.
+        src = rng.integers(0, 1 << 20, total).astype(np.int32)
+        pos = 0
+        for lo, hi, views in out.chunks():
+            assert lo == pos
+            for name in ("a", LABEL):
+                v = views[name]
+                assert v.flags.writeable and len(v) == hi - lo
+            views["a"][...] = src[lo:hi]
+            views[LABEL][...] = src[lo:hi].astype(np.float32)
+            pos = hi
+        assert pos == total
+        refs = out.seal()
+        got_a, got_l = [], []
+        for ref in refs:
+            cb = store.get_columns(ref)
+            cols = logical_columns(cb)
+            got_a.append(np.asarray(cols["a"]))
+            got_l.append(np.asarray(cols[LABEL]))
+            del cb
+        np.testing.assert_array_equal(np.concatenate(got_a), src)
+        np.testing.assert_array_equal(
+            np.concatenate(got_l), src.astype(np.float32)
+        )
+        out.abort()
+        store.free(refs)
+
+
+def test_packed_output_scatter_matches_chunks(local_runtime):
+    """The overlapped-reduce scatter path (windowed, permuted
+    destinations) produces exactly the same segments as the fused chunk
+    path."""
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.shuffle import _packed_output
+
+    store = runtime.get_context().store
+    rng = np.random.default_rng(11)
+    start, total, B = 5, 100, 16
+    layout = {"batch": B, "columns": ["a", LABEL]}
+    template = {"a": np.zeros(1, np.int32), LABEL: np.zeros(1, np.float32)}
+    src_a = rng.integers(0, 1 << 20, total).astype(np.int32)
+    src_l = rng.random(total).astype(np.float32)
+
+    out = _packed_output(store, (start, layout), total, template)
+    perm = rng.permutation(total)
+    inv = np.empty(total, np.int64)
+    inv[perm] = np.arange(total)
+    # Feed in three source-row windows like the overlapped reduce does:
+    # window rows [lo, hi) of the concat land at output rows inv[lo:hi].
+    for lo, hi in [(0, 37), (37, 70), (70, total)]:
+        window = {"a": src_a[lo:hi], LABEL: src_l[lo:hi]}
+        out.scatter(inv[lo:hi], window)
+    refs = out.seal()
+    got_a = np.concatenate(
+        [
+            np.asarray(logical_columns(store.get_columns(r))["a"])
+            for r in refs
+        ]
+    )
+    # out[j] = concat[perm[j]] is the reduce contract; scatter used the
+    # inverse so got must equal src permuted.
+    np.testing.assert_array_equal(got_a, src_a[perm])
+    out.abort()
+    store.free(refs)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: bit-identity, partial tails, engagement
+# ---------------------------------------------------------------------------
+
+
+def _collect_stream(jax_files, queue_name, epochs=2, batch_size=512,
+                    drop_last=True, feature_columns=("key",)):
+    from ray_shuffling_data_loader_tpu.jax_dataset import (
+        JaxShufflingDataset,
+    )
+
+    ds = JaxShufflingDataset(
+        list(jax_files),
+        num_epochs=epochs,
+        num_trainers=1,
+        batch_size=batch_size,
+        rank=0,
+        feature_columns=list(feature_columns),
+        label_column=LABEL,
+        num_reducers=3,
+        seed=9,
+        drop_last=drop_last,
+        queue_name=queue_name,
+    )
+    out = []
+    for epoch in range(epochs):
+        ds.set_epoch(epoch)
+        for features, label in ds:
+            out.append(
+                (
+                    {k: np.asarray(v) for k, v in features.items()},
+                    np.asarray(label),
+                )
+            )
+    return out, ds.stats.as_dict()
+
+
+@pytest.fixture(scope="module")
+def dd_files(local_runtime, tmp_path_factory):
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+    data_dir = tmp_path_factory.mktemp("dd-data")
+    filenames, _ = generate_data(
+        num_rows=4096,
+        num_files=2,
+        num_row_groups_per_file=1,
+        max_row_group_skew=0.0,
+        data_dir=str(data_dir),
+    )
+    return filenames
+
+
+def test_stream_bit_identical_layout_on_vs_off(
+    local_runtime, dd_files, monkeypatch
+):
+    """The acceptance-criteria core: every delivered tensor is
+    bit-identical with device-direct on vs off (same seed), and the
+    direct path demonstrably engaged on the 'on' run."""
+    monkeypatch.setenv("RSDL_DEVICE_DIRECT", "off")
+    off_stream, off_stats = _collect_stream(dd_files, "q-dd-off")
+    monkeypatch.setenv("RSDL_DEVICE_DIRECT", "auto")
+    on_stream, on_stats = _collect_stream(dd_files, "q-dd-on")
+
+    assert off_stats["batches_staged_direct"] == 0
+    assert on_stats["batches_staged_direct"] > 0, (
+        "device-direct never engaged"
+    )
+    # The direct batches took no host staging copy.
+    assert on_stats["bytes_staged"] < off_stats["bytes_staged"]
+    assert on_stats["bytes_staged_direct"] > 0
+
+    assert len(on_stream) == len(off_stream)
+    for (f_on, l_on), (f_off, l_off) in zip(on_stream, off_stream):
+        assert set(f_on) == set(f_off)
+        for k in f_on:
+            np.testing.assert_array_equal(f_on[k], f_off[k])
+            assert f_on[k].dtype == f_off[k].dtype
+        np.testing.assert_array_equal(l_on, l_off)
+
+
+def test_partial_final_batch_layout_on(local_runtime, dd_files, monkeypatch):
+    """drop_last=False with the layout on: the ragged tail rides the
+    remainder (columnar) path and every key still arrives exactly once."""
+    monkeypatch.setenv("RSDL_DEVICE_DIRECT", "auto")
+    stream, stats = _collect_stream(
+        dd_files, "q-dd-tail", epochs=1, batch_size=1000, drop_last=False
+    )
+    keys = np.concatenate([f["key"] for f, _ in stream])
+    assert sorted(keys.tolist()) == list(range(4096))
+    assert stats["batches_staged_direct"] > 0
+
+
+def test_spec_subset_still_engages(local_runtime, dd_files, monkeypatch):
+    """A spec that selects only SOME dataset columns still gets the
+    direct path: the reducer packs the requested prefix first and the
+    extra columns after it (the stream keeps the full column set, so
+    remainders concat with legacy segments and audits stay whole); the
+    device_put ships only the prefix. Exactly-once proven on the label
+    stream."""
+    monkeypatch.setenv("RSDL_DEVICE_DIRECT", "auto")
+    from ray_shuffling_data_loader_tpu.jax_dataset import (
+        JaxShufflingDataset,
+    )
+
+    ds = JaxShufflingDataset(
+        list(dd_files),
+        num_epochs=1,
+        num_trainers=1,
+        batch_size=512,
+        rank=0,
+        feature_columns=["embeddings_name0"],
+        label_column="key",
+        num_reducers=3,
+        seed=4,
+        drop_last=False,
+        queue_name="q-dd-subset",
+    )
+    ds.set_epoch(0)
+    keys = []
+    for features, label in ds:
+        assert set(features) == {"embeddings_name0"}
+        keys.extend(np.asarray(label).tolist())
+    assert sorted(keys) == list(range(4096))
+    assert ds.stats.as_dict()["batches_staged_direct"] > 0
+
+
+def test_shuffle_reduce_overlapped_packed_matches_fused(
+    local_runtime, monkeypatch
+):
+    """The overlapped reduce (RSDL_REDUCE_FETCH_OVERLAP=on) with packing
+    engaged produces segment-for-segment identical output to the fused
+    path — head/body/tail refs, layout descriptors, and bytes."""
+    from ray_shuffling_data_loader_tpu import runtime as rt
+    from ray_shuffling_data_loader_tpu.shuffle import shuffle_reduce
+
+    store = rt.get_context().store
+    rng = np.random.default_rng(5)
+    part_refs = []
+    for n in (400, 300, 500):
+        pending = store.create_columns(
+            {
+                "key": ((n,), np.dtype(np.int32)),
+                LABEL: ((n,), np.dtype(np.float32)),
+            }
+        )
+        try:
+            pending.columns["key"][...] = rng.integers(
+                0, 1 << 20, n
+            ).astype(np.int32)
+            pending.columns[LABEL][...] = rng.random(n).astype(np.float32)
+            # publish_slices → refs carry row windows, which is what the
+            # driver's count derivation (and the overlap gate) needs.
+            part_refs.append(pending.publish_slices([(0, n)])[0])
+        finally:
+            pending.abort()
+    pack = (7, {"batch": 64, "columns": ["key", LABEL]})
+
+    def _logical_stream(refs):
+        keys, labels, layouts = [], [], []
+        for ref in refs:
+            cb = store.get_columns(ref)
+            cols = logical_columns(cb)
+            keys.append(np.asarray(cols["key"]))
+            labels.append(np.asarray(cols[LABEL]))
+            layouts.append(cb.layout)
+            del cb
+        return np.concatenate(keys), np.concatenate(labels), layouts
+
+    monkeypatch.setenv("RSDL_REDUCE_FETCH_OVERLAP", "off")
+    fused = shuffle_reduce(1, epoch=0, seed=2, part_refs=part_refs,
+                           pack=pack)
+    monkeypatch.setenv("RSDL_REDUCE_FETCH_OVERLAP", "on")
+    overlapped = shuffle_reduce(1, epoch=0, seed=2, part_refs=part_refs,
+                                pack=pack)
+    assert isinstance(fused, list) and len(fused) == 3  # head/body/tail
+    assert isinstance(overlapped, list) and len(overlapped) == len(fused)
+    fk, fl, f_lay = _logical_stream(fused)
+    ok, ol, o_lay = _logical_stream(overlapped)
+    np.testing.assert_array_equal(fk, ok)
+    np.testing.assert_array_equal(fl, ol)
+    assert f_lay == o_lay
+    assert any(
+        lay and lay.get("kind") == DEVICE_BATCH_KIND for lay in f_lay
+    )
+    store.free(fused)
+    store.free(overlapped)
+    store.free(part_refs)
+
+
+def test_take_multi_in_kernel_bounds(local_runtime):
+    """ISSUE 8 satellite: rsdl_take_multi bounds-checks in the kernel —
+    an out-of-range index raises IndexError with no Python pre-scan, a
+    negative index falls back to numpy wraparound semantics, and the
+    in-bounds gather is exact."""
+    from ray_shuffling_data_loader_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native kernels unavailable")
+    parts = [
+        np.arange(10, dtype=np.int32),
+        np.arange(10, 25, dtype=np.int32),
+    ]
+    concat = np.concatenate(parts)
+    idx = np.array([0, 24, 7, 13], dtype=np.int64)
+    np.testing.assert_array_equal(
+        native.take_multi(parts, idx, n_threads=4), concat[idx]
+    )
+    with pytest.raises(IndexError):
+        native.take_multi(
+            parts, np.array([0, 25], dtype=np.int64), n_threads=4
+        )
+    np.testing.assert_array_equal(
+        native.take_multi(
+            parts, np.array([-1, 3], dtype=np.int64), n_threads=4
+        ),
+        concat[[-1, 3]],
+    )
