@@ -190,6 +190,37 @@ def _attach_obs_summaries(result: dict) -> None:
                 _m.registry.gauge("events.total", kind=kind).set(count)
     except Exception:
         pass
+    # The decision plane (ISSUE 9): capacity watermarks + fired-alert
+    # counts, published as gauges FIRST (same ordering contract as the
+    # straggler block) so the aggregate() embed carries rsdl_capacity_*
+    # and rsdl_alert_* alongside the compact human dicts.
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+        cap = capacity.view()
+        capacity.publish_metrics(cap)
+        if cap.get("ops"):
+            result["capacity"] = {
+                "totals": cap.get("totals"),
+                "shm_used_frac": cap.get("shm_used_frac"),
+                "hwm_by_epoch": {
+                    epoch: {
+                        tier: cell.get("hwm_bytes", 0)
+                        for tier, cell in tiers.items()
+                    }
+                    for epoch, tiers in cap.get("epochs", {}).items()
+                },
+            }
+    except Exception:
+        pass
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import slo
+
+        fired = slo.fired_counts()
+        if fired:
+            result["alerts_fired"] = fired
+    except Exception:
+        pass
 
 
 def _error_result(platform, msg: str) -> dict:

@@ -72,22 +72,53 @@ def _default_spill_dir() -> str:
 
 _spill_event_last = 0.0
 _SPILL_EVENT_INTERVAL_S = 5.0
+_spill_lock = threading.Lock()
+_spill_pending_bytes = 0
+_spill_pending_events = 0
 
 
 def _emit_spill_event(nbytes: int) -> None:
     """Structured event-log mark that the store hit its budget and
     started spilling. Rate-limited: a budget-pinned run places *every*
     segment on disk, and one event per 5 s per process tells the story
-    without flooding the log. Metrics-gated inside emit_event."""
-    global _spill_event_last
+    without flooding the log — but the VOLUME stays exact: every call
+    increments the ``store.spill_bytes_total`` counter, and the bytes
+    of suppressed calls accumulate onto the next emitted event's
+    ``nbytes`` (with the fold count in ``events_folded``), so summing
+    the event log reproduces the true spill total. Metrics-gated
+    inside emit_event/safe_inc."""
+    global _spill_event_last, _spill_pending_bytes, _spill_pending_events
+    _metrics.safe_inc("store.spill_bytes_total", float(nbytes))
     now = time.monotonic()
-    if now - _spill_event_last < _SPILL_EVENT_INTERVAL_S:
-        return
-    _spill_event_last = now
+    with _spill_lock:
+        _spill_pending_bytes += int(nbytes)
+        _spill_pending_events += 1
+        if now - _spill_event_last < _SPILL_EVENT_INTERVAL_S:
+            return
+        _spill_event_last = now
+        pending, _spill_pending_bytes = _spill_pending_bytes, 0
+        folded, _spill_pending_events = _spill_pending_events, 0
     try:
         from ray_shuffling_data_loader_tpu import telemetry
 
-        telemetry.emit_event("store.spill", nbytes=int(nbytes))
+        telemetry.emit_event(
+            "store.spill", nbytes=int(pending), events_folded=int(folded)
+        )
+    except Exception:
+        pass
+
+
+def _ledger_note(op: str, object_id: str, nbytes: int = 0,
+                 tier: Optional[str] = None, ids=None) -> None:
+    """Capacity-ledger hook (telemetry.capacity): one cached boolean
+    when metrics are off — the module is never imported and the store
+    path pays nothing; never raises."""
+    if not _metrics.enabled():
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+        capacity.note(op, object_id, nbytes=nbytes, tier=tier, ids=ids)
     except Exception:
         pass
 
@@ -530,6 +561,10 @@ class PendingColumns:
         assert not self._published, "already published"
         os.rename(self._tmp, self._path)
         self._published = True
+        _ledger_note(
+            "create", self.object_id, self.nbytes,
+            self._store.tier_of(self._path),
+        )
         return ObjectRef(
             object_id=self.object_id,
             nbytes=self.nbytes,
@@ -575,6 +610,14 @@ class PendingColumns:
             raise
         os.unlink(self._tmp)
         self._published = True
+        # One ledger segment carrying every link id: the bytes stay
+        # resident until the LAST window's link is freed (the fold
+        # mirrors the filesystem refcount).
+        _ledger_note(
+            "create", self.object_id, self.nbytes,
+            self._store.tier_of(self._tmp),
+            ids=[r.object_id for r in refs],
+        )
         return refs
 
     def abort(self) -> None:
@@ -789,6 +832,15 @@ class ObjectStore:
         # placements between scans see each other.
         self._shm_scan_adjust += nbytes
         return self.shm_dir
+
+    def tier_of(self, path: str) -> str:
+        """Which capacity tier a segment path lives on — ``spill`` for
+        the disk spill dir, ``shm`` otherwise (the ledger vocabulary)."""
+        return (
+            "spill"
+            if os.path.dirname(path) == self.spill_dir
+            else "shm"
+        )
 
     def _find_segment(self, object_id: str) -> Optional[str]:
         """Resolve a local object id to its segment path (shm, then spill)."""
@@ -1113,6 +1165,9 @@ class ObjectStore:
                 f.write(data)
         os.rename(tmp, path)
         self._foreign.add(os.path.basename(path))
+        _ledger_note(
+            "fetch", os.path.basename(path), nbytes, self.tier_of(path)
+        )
         if t0 is not None:
             # Per-window DCN latency + bytes — the TCP plane's primary
             # observability (docs/observability.md); labels carry which
@@ -1155,8 +1210,11 @@ class ObjectStore:
                         os.unlink(cache)
                     except FileNotFoundError:
                         pass
+                    _ledger_note("delete", self._cache_name(ref))
                 self._foreign.discard(self._cache_name(ref))
                 if self.remote_free is not None:
+                    # The owner's store frees the authoritative segment
+                    # in its own process — and logs its own ledger op.
                     self.remote_free(ref)
                 continue
             path = self._find_segment(ref.object_id)
@@ -1165,6 +1223,7 @@ class ObjectStore:
                     os.unlink(path)
                 except FileNotFoundError:
                     pass
+                _ledger_note("delete", ref.object_id)
 
     def drop_cache(self, refs) -> None:
         """Release only this host's fetched copy of foreign refs — the
@@ -1184,6 +1243,7 @@ class ObjectStore:
                     os.unlink(cache)
                 except FileNotFoundError:
                     pass
+                _ledger_note("delete", self._cache_name(ref))
             self._foreign.discard(self._cache_name(ref))
 
     def exists(self, ref: ObjectRef) -> bool:
@@ -1222,6 +1282,7 @@ class ObjectStore:
         return stats
 
     def cleanup(self) -> None:
+        _ledger_note("cleanup", self.session)
         prefix = f"{self.session}-"
         for dirpath in (self.shm_dir, self.spill_dir):
             try:

@@ -186,12 +186,14 @@ def _flush_telemetry_spools() -> None:
     if telemetry.metrics.enabled():
         try:
             from ray_shuffling_data_loader_tpu.telemetry import (
+                capacity,
                 events,
                 stragglers,
             )
 
             events.safe_flush()
             stragglers.safe_flush()
+            capacity.safe_flush()
         except Exception:
             pass
 

@@ -63,12 +63,14 @@ from ray_shuffling_data_loader_tpu.telemetry import metrics  # noqa: F401
 from ray_shuffling_data_loader_tpu.telemetry import audit  # noqa: F401
 from ray_shuffling_data_loader_tpu.telemetry import export  # noqa: F401
 
-# NOTE: obs_server (the /metrics //healthz //status endpoint) and the
-# temporal plane (events / timeseries / stragglers, ISSUE 7) are NOT
-# imported here — obs_server is lazily imported by runtime.init() only
-# when RSDL_OBS_PORT is set, and the temporal modules only load on the
-# first metrics-enabled use (emit_event below / the task-done flush in
-# runtime/tasks.py), so the off-by-default path pays no import cost.
+# NOTE: obs_server (the /metrics //healthz //status endpoint), the
+# temporal plane (events / timeseries / stragglers, ISSUE 7), and the
+# decision plane (capacity / critical / slo, ISSUE 9) are NOT imported
+# here — obs_server is lazily imported by runtime.init() only when
+# RSDL_OBS_PORT is set, and the other modules only load on the first
+# metrics-enabled use (emit_event below / the task-done flush in
+# runtime/tasks.py / the store's ledger hook / the sampler tick), so
+# the off-by-default path pays no import cost.
 
 metrics_snapshot = metrics.global_snapshot
 metrics_dump = metrics.dump_json

@@ -1,0 +1,653 @@
+"""Store/memory capacity ledger: who holds the bytes, per epoch and tier.
+
+The obs plane could say *how many* bytes the store held
+(``store.shm_bytes`` / ``store.spill_bytes`` — two session-wide
+gauges) but not *whose* they were: which epoch's segments are still
+resident, how old they are, and which tier (tmpfs vs the disk spill
+dir) they live on. Those are exactly the inputs the tiered evictor
+ROADMAP item 5 describes needs — "demote cold epochs shm→disk→drop"
+starts with knowing which epochs are cold — and the signal a
+capacity-near-limit alert (:mod:`.slo`) keys on before the budget
+cliff, not after.
+
+This module is the ledger half of that story:
+
+* **Records.** The store's segment lifecycle paths
+  (``runtime/store.py``: publish via ``seal``/``publish_slices``,
+  remote-window cache materialization, ``free``/``drop_cache``,
+  session ``cleanup``) append flat ops —
+  ``{"op": "create"|"fetch"|"delete"|"transition"|"cleanup", "id",
+  "ids", "nbytes", "tier", "epoch", "ts"}`` — buffered locally and
+  flushed with the task-done spool barrier (``runtime/tasks.py``) into
+  ``<metrics spool>/capacity/ledger-<pid>.ndjson``. The epoch rides in
+  from the ambient trace context at *create* time; deletes carry only
+  the id — the fold resolves their bytes/tier/epoch from the matching
+  create, so the freeing process never needs to know what it freed
+  (driver-side frees of worker-created segments account correctly).
+  Hardlinked slice refs (``publish_slices``) record one segment with
+  all link ids; the bytes stay resident until the *last* link dies,
+  mirroring the store's filesystem refcount.
+* **Fold.** :func:`ledger` replays the records in timestamp order into
+  a per-``(epoch, tier)`` view: resident bytes/segments *now*,
+  cumulative created/fetched/freed bytes, the per-epoch **high
+  watermark**, and the oldest live segment's age (the cold-epoch
+  signal). ``transition`` moves a live segment's bytes between tiers —
+  the op the future evictor will emit when it demotes shm→spill.
+* **Host sampling.** :func:`host_sample` reads this process's RSS and
+  the shm/spill filesystems' free bytes (pure ``/proc`` + ``statvfs``)
+  — sampled by the timeseries tick alongside the fold so
+  ``rsdl_capacity_*`` gauges have history.
+* **Surfacing.** :func:`publish_metrics` → ``capacity.*`` gauges
+  (``rsdl_capacity_*`` on a scrape), the obs server serves the full
+  view at ``/capacity`` plus a ``capacity`` section in ``/status``,
+  and ``tools/epoch_report.py --capacity`` renders the post-hoc
+  residency/watermark table from the same spool.
+
+Zero-overhead contract: every entry point is gated on ``RSDL_METRICS``
+by its *caller* (one cached boolean at the store hook) — this module
+is never imported on a disabled run, and no ledger file exists.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# NOTE: no module-level telemetry imports — the fold half of this
+# module (ledger / epoch_sort_key) must stay importable by the
+# pure-stdlib ``tools/epoch_report.py`` loader without pulling the
+# package (and its numpy deps); the spool/gauge halves import
+# export/metrics lazily inside the functions that need them.
+
+TIERS = ("shm", "spill")
+
+# Ledger op vocabulary (docs/observability.md). "transition" has no
+# store emitter yet — it is the evictor's op (ROADMAP 5); the fold and
+# tests support it so the consumer exists before the producer.
+OPS = ("create", "fetch", "delete", "transition", "cleanup")
+
+_UNKNOWN_EPOCH = "-"
+
+_lock = threading.Lock()
+_records: List[dict] = []
+_atexit_registered = False
+
+# (epoch, tier) gauge label sets published last tick: a pair that
+# drops out of the view (all segments freed) must be zeroed, not left
+# showing its final residency forever.
+_published_pairs: set = set()
+
+
+def epoch_sort_key(epoch: Any) -> Tuple[int, int]:
+    """The ONE sort key for ``"-"``-keyed epoch maps (the /status
+    section, the epoch_report table — and the semantics rsdl_top
+    mirrors): numeric order, unknown-epoch bucket last."""
+    try:
+        return (0, int(epoch))
+    except (TypeError, ValueError):
+        return (1, 0)
+
+
+def spool_dir() -> Optional[str]:
+    """Ledger spool: a ``capacity/`` subdir of the metrics spool, so
+    one ``RSDL_METRICS_DIR`` override relocates the whole plane."""
+    from ray_shuffling_data_loader_tpu.telemetry import export as _export
+
+    directory = _export.spool_dir()
+    if not directory:
+        return None
+    return os.path.join(directory, "capacity")
+
+
+def _register_atexit() -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(safe_flush)
+
+
+def _ambient_epoch() -> Optional[int]:
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import trace as _trace
+
+        epoch = _trace.current_context().get("epoch")
+        return None if epoch is None else int(epoch)
+    except Exception:
+        return None
+
+
+def note(
+    op: str,
+    object_id: str,
+    nbytes: int = 0,
+    tier: Optional[str] = None,
+    ids: Optional[List[str]] = None,
+    epoch: Optional[int] = None,
+) -> None:
+    """Record one ledger op. ``create``/``fetch`` carry bytes + tier
+    (epoch defaults to the ambient trace context); ``delete`` needs
+    only the id; ``transition`` carries the new tier. Caller gates on
+    ``metrics.enabled()``; never raises."""
+    try:
+        rec: Dict[str, Any] = {
+            "ts": time.time(),
+            "op": str(op),
+            "id": str(object_id),
+            "pid": os.getpid(),
+        }
+        if nbytes:
+            rec["nbytes"] = int(nbytes)
+        if tier is not None:
+            rec["tier"] = str(tier)
+        if ids:
+            rec["ids"] = [str(i) for i in ids]
+        if op in ("create", "fetch"):
+            if epoch is None:
+                epoch = _ambient_epoch()
+            if epoch is not None:
+                rec["epoch"] = int(epoch)
+        _register_atexit()
+        with _lock:
+            _records.append(rec)
+    except Exception:
+        pass
+
+
+def flush() -> None:
+    """Append the buffered records to this process's spool file. No-op
+    without a spool dir (records stay local for same-process folds)."""
+    directory = spool_dir()
+    if not directory:
+        return
+    with _lock:
+        if not _records:
+            return
+        drained = list(_records)
+        _records.clear()
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"ledger-{os.getpid()}.ndjson")
+        with open(path, "a") as f:
+            for rec in drained:
+                f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass  # never sink the run
+
+
+def safe_flush() -> None:
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+    if not _metrics.enabled():
+        return
+    try:
+        flush()
+    except Exception:
+        pass
+
+
+# Per-file tail-read cache for the live spool (the sampler folds every
+# tick; the files are append-only) — same shape as the straggler
+# spool's cache.
+_read_cache: Dict[str, list] = {}
+_cache_lock = threading.Lock()
+
+
+def _read_file_records(fpath: str, use_cache: bool) -> List[dict]:
+    cached = None
+    if use_cache:
+        with _cache_lock:
+            cached = _read_cache.get(fpath)
+    offset = cached[0] if cached else 0
+    try:
+        size = os.path.getsize(fpath)
+        if cached and size < offset:
+            cached, offset = None, 0  # truncated/replaced: re-read
+        if cached and size == offset:
+            return list(cached[1])
+        new: List[dict] = []
+        with open(fpath) as f:
+            f.seek(offset)
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # torn tail mid-append; re-read next time
+                offset += len(line.encode())
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "op" in rec:
+                    new.append(rec)
+    except OSError:
+        return list(cached[1]) if cached else []
+    records = (cached[1] if cached else []) + new
+    if use_cache:
+        with _cache_lock:
+            _read_cache[fpath] = [offset, records]
+    return list(records)
+
+
+def load_records(path: Optional[str] = None) -> List[dict]:
+    """Every spooled ledger record plus the local buffer. ``path``
+    overrides the spool dir (post-hoc tools); a directory reads its
+    ``ledger-*.ndjson`` files, a file reads as one NDJSON."""
+    out: List[dict] = []
+    directory = path if path is not None else spool_dir()
+    files: List[str] = []
+    if directory:
+        if os.path.isdir(directory):
+            files = [
+                os.path.join(directory, f)
+                for f in sorted(os.listdir(directory))
+                if f.startswith("ledger-") and f.endswith(".ndjson")
+            ]
+        elif os.path.isfile(directory):
+            files = [directory]
+    for fpath in files:
+        out.extend(_read_file_records(fpath, use_cache=path is None))
+    if path is None:
+        with _lock:
+            out.extend(_records)
+    return out
+
+
+def reset(clear_spool: bool = False) -> None:
+    global _published_pairs, _fold_cache
+    with _lock:
+        _records.clear()
+    with _cache_lock:
+        _read_cache.clear()
+    _published_pairs = set()
+    _fold_cache = None
+    if clear_spool:
+        directory = spool_dir()
+        if directory and os.path.isdir(directory):
+            for fname in os.listdir(directory):
+                if fname.startswith("ledger-") and fname.endswith(".ndjson"):
+                    try:
+                        os.unlink(os.path.join(directory, fname))
+                    except OSError:
+                        pass
+
+
+# ---------------------------------------------------------------------------
+# Fold
+# ---------------------------------------------------------------------------
+
+
+class _Seg:
+    __slots__ = ("nbytes", "tier", "epoch", "ts", "links")
+
+    def __init__(self, nbytes, tier, epoch, ts, links):
+        self.nbytes = nbytes
+        self.tier = tier
+        self.epoch = epoch
+        self.ts = ts
+        self.links = links
+
+
+# Live-fold memo: (op count, folded view) — the sampler tick, /status,
+# and /capacity each fold per call, and the op log only appends, so an
+# unchanged count means an unchanged fold (ages are recomputed from
+# `now` at read time via the cells' oldest_ts).
+_fold_cache: Optional[Tuple[int, Dict[str, Any]]] = None
+
+
+def ledger(
+    records: Optional[List[dict]] = None, now: Optional[float] = None
+) -> Dict[str, Any]:
+    """Replay the ledger into the per-``(epoch, tier)`` view::
+
+        {"epochs": {"3": {"shm": {"resident_bytes", "segments",
+                                  "hwm_bytes", "created_bytes",
+                                  "freed_bytes", "oldest_age_s"},
+                          "spill": {...}}, ...},
+         "totals": {"shm": {...}, "spill": {...}},
+         "live_segments": N, "ops": N}
+
+    Deletes resolve bytes/tier/epoch from the matching create (the
+    freeing process need not know them); a hardlink-sliced segment
+    stays resident until its last link is deleted; ``transition``
+    moves a live segment between tiers (hwm accounted in the target);
+    ``cleanup`` drops everything live at that point. Records from
+    *unknown* epochs fold under ``"-"``. Live folds (no explicit
+    ``records``) are memoized on the op count — the log is
+    append-only, so the replay cost is paid once per new batch of ops,
+    not once per page hit."""
+    global _fold_cache
+    now = time.time() if now is None else float(now)
+    live = records is None
+    if live:
+        records = load_records()
+        if _fold_cache is not None and _fold_cache[0] == len(records):
+            return _with_ages(_fold_cache[1], now)
+    folded = _fold(sorted(records, key=lambda r: float(r.get("ts", 0.0))))
+    if live:
+        _fold_cache = (len(records), folded)
+    return _with_ages(folded, now)
+
+
+def _with_ages(folded: Dict[str, Any], now: float) -> Dict[str, Any]:
+    """A read-time copy of a fold with ``oldest_age_s`` derived from
+    each cell's ``oldest_ts`` (the only now-dependent field, kept out
+    of the memoized structure)."""
+    epochs = {}
+    for epoch, tiers in folded["epochs"].items():
+        epochs[epoch] = {}
+        for tier, cell in tiers.items():
+            cell = dict(cell)
+            oldest_ts = cell.pop("oldest_ts", None)
+            if oldest_ts is not None:
+                cell["oldest_age_s"] = round(now - oldest_ts, 3)
+            epochs[epoch][tier] = cell
+    out = dict(folded)
+    out["epochs"] = epochs
+    out["ts"] = now
+    return out
+
+
+def _fold(records: List[dict]) -> Dict[str, Any]:
+
+    segs: Dict[str, _Seg] = {}  # live segments by primary id
+    by_link: Dict[str, str] = {}  # link id -> primary id
+    resident: Dict[Tuple[str, str], int] = {}  # (epoch, tier) -> bytes
+    counts: Dict[Tuple[str, str], int] = {}
+    hwm: Dict[Tuple[str, str], int] = {}
+    created: Dict[Tuple[str, str], int] = {}
+    fetched: Dict[Tuple[str, str], int] = {}
+    freed: Dict[Tuple[str, str], int] = {}
+
+    def _epoch_key(rec) -> str:
+        e = rec.get("epoch")
+        return _UNKNOWN_EPOCH if e is None else str(e)
+
+    def _add(seg: _Seg) -> None:
+        key = (seg.epoch, seg.tier)
+        resident[key] = resident.get(key, 0) + seg.nbytes
+        counts[key] = counts.get(key, 0) + 1
+        hwm[key] = max(hwm.get(key, 0), resident[key])
+
+    def _sub(seg: _Seg) -> None:
+        key = (seg.epoch, seg.tier)
+        resident[key] = resident.get(key, 0) - seg.nbytes
+        counts[key] = counts.get(key, 0) - 1
+        freed[key] = freed.get(key, 0) + seg.nbytes
+
+    def _drop(primary: str) -> None:
+        seg = segs.pop(primary, None)
+        if seg is None:
+            return
+        for link in seg.links:
+            by_link.pop(link, None)
+        _sub(seg)
+
+    for rec in records:
+        op = rec.get("op")
+        rid = str(rec.get("id", ""))
+        if op in ("create", "fetch"):
+            tier = str(rec.get("tier") or "shm")
+            nbytes = int(rec.get("nbytes", 0))
+            seg = _Seg(
+                nbytes,
+                tier,
+                _epoch_key(rec),
+                float(rec.get("ts", 0.0)),
+                set(rec.get("ids") or [rid]),
+            )
+            if rid in segs:  # duplicate create (retried task): replace
+                _drop(rid)
+            segs[rid] = seg
+            for link in seg.links:
+                by_link[link] = rid
+            _add(seg)
+            key = (seg.epoch, seg.tier)
+            bucket = fetched if op == "fetch" else created
+            bucket[key] = bucket.get(key, 0) + nbytes
+        elif op == "delete":
+            primary = by_link.get(rid)
+            if primary is None:
+                continue  # unknown id (foreign spool slice); ignore
+            seg = segs[primary]
+            seg.links.discard(rid)
+            by_link.pop(rid, None)
+            if not seg.links:
+                segs.pop(primary, None)
+                _sub(seg)
+        elif op == "transition":
+            primary = by_link.get(rid)
+            if primary is None:
+                continue
+            seg = segs[primary]
+            new_tier = str(rec.get("tier") or seg.tier)
+            if new_tier == seg.tier:
+                continue
+            _sub(seg)
+            # A demotion is a move, not a free.
+            freed[(seg.epoch, seg.tier)] -= seg.nbytes
+            seg.tier = new_tier
+            _add(seg)
+        elif op == "cleanup":
+            for primary in list(segs):
+                _drop(primary)
+
+    oldest: Dict[Tuple[str, str], float] = {}
+    for seg in segs.values():
+        key = (seg.epoch, seg.tier)
+        oldest[key] = min(oldest.get(key, seg.ts), seg.ts)
+
+    epochs: Dict[str, Dict[str, Any]] = {}
+    totals: Dict[str, Dict[str, float]] = {
+        t: {
+            "resident_bytes": 0,
+            "segments": 0,
+            "created_bytes": 0,
+            "fetched_bytes": 0,
+            "freed_bytes": 0,
+        }
+        for t in TIERS
+    }
+    keys = (
+        set(resident) | set(created) | set(fetched) | set(freed)
+    )
+    for epoch, tier in sorted(keys):
+        cell = {
+            "resident_bytes": int(resident.get((epoch, tier), 0)),
+            "segments": int(counts.get((epoch, tier), 0)),
+            "hwm_bytes": int(hwm.get((epoch, tier), 0)),
+            "created_bytes": int(created.get((epoch, tier), 0)),
+            "fetched_bytes": int(fetched.get((epoch, tier), 0)),
+            "freed_bytes": int(freed.get((epoch, tier), 0)),
+        }
+        if (epoch, tier) in oldest:
+            cell["oldest_ts"] = oldest[(epoch, tier)]
+        epochs.setdefault(epoch, {})[tier] = cell
+        if tier in totals:
+            for field in totals[tier]:
+                totals[tier][field] += cell.get(field, 0)
+    return {
+        "epochs": epochs,
+        "totals": totals,
+        "live_segments": len(segs),
+        "ops": len(records),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host sampling
+# ---------------------------------------------------------------------------
+
+
+def _proc_rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _store_dirs() -> Tuple[Optional[str], Optional[str], Optional[int]]:
+    """(shm_dir, spill_dir, capacity_bytes) from the live runtime
+    session when one exists here, else the store module's defaults —
+    via ``sys.modules`` so a headless fold never imports the runtime."""
+    import sys as _sys
+
+    runtime = _sys.modules.get("ray_shuffling_data_loader_tpu.runtime")
+    try:
+        if runtime is not None and runtime.is_initialized():
+            store = runtime.get_context().store
+            return store.shm_dir, store.spill_dir, store.capacity_bytes
+    except Exception:
+        pass
+    store_mod = _sys.modules.get(
+        "ray_shuffling_data_loader_tpu.runtime.store"
+    )
+    if store_mod is not None:
+        try:
+            return (
+                store_mod._default_shm_dir(),
+                store_mod._default_spill_dir(),
+                None,
+            )
+        except Exception:
+            pass
+    return None, None, None
+
+
+def _fs_free_bytes(path: Optional[str]) -> Optional[int]:
+    if not path:
+        return None
+    try:
+        st = os.statvfs(path)
+        return int(st.f_bavail * st.f_frsize)
+    except OSError:
+        return None
+
+
+def host_sample() -> Dict[str, Any]:
+    """Point-in-time host numbers: this process's RSS and the shm /
+    spill filesystems' free bytes (plus the session budget when a
+    runtime session is live here). Pure /proc + statvfs."""
+    shm_dir, spill_dir, budget = _store_dirs()
+    out: Dict[str, Any] = {}
+    rss = _proc_rss_bytes()
+    if rss is not None:
+        out["rss_bytes"] = rss
+    free = _fs_free_bytes(shm_dir)
+    if free is not None:
+        out["shm_free_bytes"] = free
+    free = _fs_free_bytes(spill_dir)
+    if free is not None:
+        out["spill_free_bytes"] = free
+    if budget:
+        out["capacity_bytes"] = int(budget)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Surfacing
+# ---------------------------------------------------------------------------
+
+
+def view(
+    records: Optional[List[dict]] = None, now: Optional[float] = None
+) -> Dict[str, Any]:
+    """The full ``/capacity`` body: ledger fold + host sample + the
+    used-fraction the capacity-near-limit alert keys on."""
+    out = ledger(records=records, now=now)
+    host = host_sample()
+    out["host"] = host
+    shm_resident = out["totals"]["shm"]["resident_bytes"]
+    budget = host.get("capacity_bytes")
+    if budget:
+        out["shm_used_frac"] = round(shm_resident / budget, 4)
+    else:
+        # No explicit budget: fraction of the shm filesystem itself.
+        free = host.get("shm_free_bytes")
+        if free is not None and (shm_resident + free) > 0:
+            out["shm_used_frac"] = round(
+                shm_resident / (shm_resident + free), 4
+            )
+    return out
+
+
+def publish_metrics(full: Optional[Dict[str, Any]] = None) -> None:
+    """Fold a view into the registry as ``capacity.*`` gauges —
+    ``rsdl_capacity_*`` on a scrape, sampled into the timeseries ring
+    by the sampler tick. Gauges, not counters: the fold is a
+    recomputed level. ``(epoch, tier)`` pairs that left the view are
+    zeroed once so dead epochs don't linger at their last value."""
+    global _published_pairs
+    from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+    if not _metrics.enabled():
+        return
+    try:
+        full = view() if full is None else full
+        reg = _metrics.registry
+        pairs = set()
+        for epoch, tiers in full.get("epochs", {}).items():
+            for tier, cell in tiers.items():
+                pairs.add((epoch, tier))
+                reg.gauge(
+                    "capacity.resident_bytes", epoch=epoch, tier=tier
+                ).set(cell.get("resident_bytes", 0))
+                reg.gauge(
+                    "capacity.segments", epoch=epoch, tier=tier
+                ).set(cell.get("segments", 0))
+                reg.gauge(
+                    "capacity.hwm_bytes", epoch=epoch, tier=tier
+                ).set(cell.get("hwm_bytes", 0))
+                reg.gauge(
+                    "capacity.oldest_age_seconds", epoch=epoch, tier=tier
+                ).set(cell.get("oldest_age_s", 0.0))
+        for epoch, tier in _published_pairs - pairs:
+            for name in (
+                "capacity.resident_bytes",
+                "capacity.segments",
+                "capacity.oldest_age_seconds",
+            ):
+                reg.gauge(name, epoch=epoch, tier=tier).set(0)
+        _published_pairs = pairs
+        for tier in TIERS:
+            tot = full.get("totals", {}).get(tier) or {}
+            reg.gauge("capacity.tier_resident_bytes", tier=tier).set(
+                tot.get("resident_bytes", 0)
+            )
+        host = full.get("host") or {}
+        if "rss_bytes" in host:
+            reg.gauge("capacity.host_rss_bytes").set(host["rss_bytes"])
+        if "shm_free_bytes" in host:
+            reg.gauge("capacity.fs_free_bytes", tier="shm").set(
+                host["shm_free_bytes"]
+            )
+        if "spill_free_bytes" in host:
+            reg.gauge("capacity.fs_free_bytes", tier="spill").set(
+                host["spill_free_bytes"]
+            )
+        if "shm_used_frac" in full:
+            reg.gauge("capacity.shm_used_frac").set(full["shm_used_frac"])
+    except Exception:
+        pass
+
+
+def status_section(limit: int = 12) -> Dict[str, Any]:
+    """The trimmed view ``/status`` embeds (the full one lives at
+    ``/capacity``): totals, host numbers, and the latest epochs'
+    residency."""
+    full = view()
+    epochs = full.get("epochs", {})
+    latest = sorted(epochs, key=epoch_sort_key)[-limit:]
+    return {
+        "totals": full.get("totals"),
+        "host": full.get("host"),
+        "shm_used_frac": full.get("shm_used_frac"),
+        "live_segments": full.get("live_segments"),
+        "epochs": {e: epochs[e] for e in latest},
+    }

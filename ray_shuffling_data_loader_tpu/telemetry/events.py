@@ -67,6 +67,8 @@ KINDS = (
     "store.spill",      # the store placed a segment on disk (budget hit)
     "producer.died",    # consumer-side producer-liveness trip
     "straggler.wedged",  # the straggler detector flagged an in-flight task
+    "alert.fired",      # an SLO rule's condition held for its for_s
+    "alert.resolved",   # ... and later cleared (telemetry/slo.py)
 )
 
 # Flush when the buffer reaches this many records (plus the explicit

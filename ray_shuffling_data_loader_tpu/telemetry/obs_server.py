@@ -1,5 +1,6 @@
 """Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``,
-``/timeseries``, ``/events``, ``/stragglers``.
+``/timeseries``, ``/events``, ``/stragglers``, ``/capacity``,
+``/critical``, ``/alerts``.
 
 One stdlib ``http.server`` on a daemon thread inside the driver process,
 env-gated by ``RSDL_OBS_PORT`` — so a running shuffle can be *watched*
@@ -39,6 +40,17 @@ Endpoints:
 * ``GET /stragglers`` — the full straggler/skew analysis
   (:mod:`.stragglers`): per-stage p99/median skew, slowest-host
   attribution, flagged outliers, and live wedged-worker flags.
+* ``GET /capacity`` — the store/memory capacity ledger
+  (:mod:`.capacity`, ISSUE 9): per-(epoch, tier) resident bytes,
+  segment ages, high watermarks, host RSS + shm/spill free — the
+  tiered evictor's input.
+* ``GET /critical`` — online critical-path + stall attribution
+  (:mod:`.critical`): per-epoch busy-interval unions, sole-active
+  shares, the current critical-path stage, stall-by-cause — the same
+  interval math ``tools/epoch_report.py`` runs post-hoc.
+* ``GET /alerts`` — the SLO alert engine's state (:mod:`.slo`): every
+  rule's live state/value, active alerts, recent fire/resolve
+  transitions.
 
 **Status providers** are how subsystems publish live state without this
 module knowing about them: ``register_status_provider(name, fn)`` where
@@ -63,9 +75,12 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_shuffling_data_loader_tpu.telemetry import capacity as _capacity
+from ray_shuffling_data_loader_tpu.telemetry import critical as _critical
 from ray_shuffling_data_loader_tpu.telemetry import events as _events
 from ray_shuffling_data_loader_tpu.telemetry import export as _export
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+from ray_shuffling_data_loader_tpu.telemetry import slo as _slo
 from ray_shuffling_data_loader_tpu.telemetry import stragglers as _stragglers
 from ray_shuffling_data_loader_tpu.telemetry import timeseries as _timeseries
 
@@ -319,6 +334,17 @@ def _status_body() -> dict:
         }
     except Exception as exc:
         status["events"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    # The decision plane (ISSUE 9): capacity ledger, online critical
+    # path, active alerts — each guarded like the sections above.
+    for name, fn in (
+        ("capacity", _capacity.status_section),
+        ("critical", _critical.status_section),
+        ("alerts", _slo.status_section),
+    ):
+        try:
+            status[name] = fn()
+        except Exception as exc:
+            status[name] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     return status
 
 
@@ -439,6 +465,30 @@ def _make_handler():
                         "application/json",
                         json.dumps(
                             _stragglers.analyze(), default=str
+                        ).encode(),
+                    )
+                elif path == "/capacity":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _capacity.view(), default=str
+                        ).encode(),
+                    )
+                elif path == "/critical":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _critical.analyze(), default=str
+                        ).encode(),
+                    )
+                elif path == "/alerts":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _slo.alerts_body(), default=str
                         ).encode(),
                     )
                 else:

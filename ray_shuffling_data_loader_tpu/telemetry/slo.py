@@ -1,0 +1,501 @@
+"""Declarative SLO alert engine over the aggregated metrics registry.
+
+The obs plane could show every number but could not *say anything*:
+"this run is unhealthy" lived in the operator's head (or in a post-hoc
+report's exit code). This module closes that gap with a small,
+declarative rule engine evaluated by the timeseries sampler tick —
+the decision half the autoscaler/evictor loop (ROADMAP item 5) and a
+paging pipeline both consume.
+
+**Rules** are flat JSON objects::
+
+    {"name": "wedged_worker",          # unique; replaces a default
+     "kind": "threshold",              # threshold | rate | absence
+     "metric": "straggler.wedged_tasks",  # registry key, base name,
+                                          # or rsdl_ Prometheus alias
+     "op": ">", "value": 0,            # predicate vs the observed value
+     "window_s": 60,                   # rate: trailing ring window;
+                                       # absence: staleness bound
+     "for_s": 0,                       # condition must HOLD this long
+     "only_in_flight": false,          # evaluate only mid-trial
+     "severity": "warn"}               # free-form label
+
+* ``threshold`` — predicate over the *current aggregated value*
+  (:func:`.export.aggregate`; keys matching a base name are summed, so
+  ``stall_seconds`` covers every ``cause=`` series at once).
+* ``rate`` — predicate over the mean per-second rate across the
+  trailing ``window_s`` of the timeseries ring (:mod:`.timeseries` —
+  counter deltas already turned into rates, reset-safe).
+* ``absence`` — fires when the metric is missing from the aggregate
+  entirely, or (with ``window_s``) when the ring has no point for it
+  within the window: the "the thing that should be reporting is not"
+  predicate a dead producer or wedged spool shows up as.
+
+**Sources.** ``RSDL_SLO_RULES`` is either inline JSON (a list of rule
+objects) or a path to a JSON rules file. User rules merge over the
+**default pack** by name (same name replaces; ``"disabled": true``
+removes); the defaults ship the alerts every run wants: producer
+stalled, stall share over budget, capacity near limit, wedged worker,
+audit mismatch.
+
+**Lifecycle.** :func:`evaluate` runs each sampler tick: a rule whose
+condition holds for ``for_s`` transitions to *firing* — emitting an
+``alert.fired`` structured event (:mod:`.events`), incrementing
+``alert.fired_total{rule=}``, and raising ``alert.active{rule=}`` to 1
+(``rsdl_alert_active`` on a scrape) — and back to *resolved* (an
+``alert.resolved`` event, gauge 0) when it clears. ``/alerts``
+(:mod:`.obs_server`) serves every rule's live state plus the recent
+transition history.
+
+Zero-overhead contract: evaluated only from the sampler tick (which
+exists only when metrics are on); never imported on a disabled run.
+Pure folds — no RPCs, safe on error paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_shuffling_data_loader_tpu.telemetry import export as _export
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+from ray_shuffling_data_loader_tpu.telemetry import timeseries as _timeseries
+
+ENV_SLO_RULES = "RSDL_SLO_RULES"
+
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+# The default rule pack (docs/observability.md). Conservative windows:
+# a rule that cries wolf is worse than none. Override or disable by
+# name via RSDL_SLO_RULES.
+DEFAULT_RULES: List[Dict[str, Any]] = [
+    {
+        # No reducer produced a row for a sustained window while a
+        # trial is mid-flight: the producer plane is stalled (dead
+        # producer, wedged window, exhausted retries).
+        "name": "producer_stalled",
+        "kind": "rate",
+        "metric": "shuffle.reduce_rows",
+        "op": "==", "value": 0.0,
+        "window_s": 30.0, "for_s": 15.0,
+        "only_in_flight": True,
+        "severity": "page",
+    },
+    {
+        # Some consumer spent more than half its recent wall-clock
+        # stalled (both causes summed within each source process;
+        # "max-source" takes the worst consumer — a cluster-wide sum
+        # would scale with trainer count, not health).
+        "name": "stall_over_budget",
+        "kind": "rate",
+        "metric": "stall_seconds",
+        "fold": "max-source",
+        "op": ">", "value": 0.5,
+        "window_s": 60.0, "for_s": 10.0,
+        "only_in_flight": True,
+        "severity": "warn",
+    },
+    {
+        # The shm tier is near its session budget: the next segments
+        # spill to disk — the evictor's (ROADMAP 5) wake-up signal.
+        "name": "capacity_near_limit",
+        "kind": "threshold",
+        "metric": "capacity.shm_used_frac",
+        "op": ">", "value": 0.9,
+        "for_s": 0.0,
+        "severity": "warn",
+    },
+    {
+        # The straggler detector flags an in-flight task over its
+        # wedge budget right now.
+        "name": "wedged_worker",
+        "kind": "threshold",
+        "metric": "straggler.wedged_tasks",
+        "op": ">", "value": 0.0,
+        "for_s": 0.0,
+        "severity": "page",
+    },
+    {
+        # The exactly-once reconciler found a digest mismatch: data
+        # loss or duplication — never a warning.
+        "name": "audit_mismatch",
+        "kind": "threshold",
+        "metric": "audit.digest_mismatch",
+        "op": ">", "value": 0.0,
+        "for_s": 0.0,
+        "severity": "page",
+    },
+]
+
+_HISTORY_CAP = 64
+
+_lock = threading.Lock()
+_rules_cache: Optional[List[Dict[str, Any]]] = None
+_states: Dict[str, Dict[str, Any]] = {}
+_history: List[Dict[str, Any]] = []
+
+
+def reset() -> None:
+    """Drop rule cache, per-rule state, and history (tests and run
+    boundaries); the next evaluate re-reads ``RSDL_SLO_RULES``."""
+    global _rules_cache
+    with _lock:
+        _rules_cache = None
+        _states.clear()
+        _history.clear()
+
+
+def _load_user_rules() -> List[Dict[str, Any]]:
+    raw = os.environ.get(ENV_SLO_RULES, "").strip()
+    if not raw:
+        return []
+    try:
+        if raw.startswith("[") or raw.startswith("{"):
+            parsed = json.loads(raw)
+        else:
+            with open(raw) as f:
+                parsed = json.load(f)
+    except (OSError, ValueError):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "slo: cannot parse %s=%r; using the default rule pack only",
+            ENV_SLO_RULES, raw[:120],
+        )
+        return []
+    if isinstance(parsed, dict):
+        parsed = [parsed]
+    return [r for r in parsed if isinstance(r, dict) and r.get("name")]
+
+
+def rules() -> List[Dict[str, Any]]:
+    """The effective rule list: default pack merged (by name) with the
+    ``RSDL_SLO_RULES`` rules — user wins, ``"disabled": true`` drops."""
+    global _rules_cache
+    with _lock:
+        if _rules_cache is not None:
+            return list(_rules_cache)
+    merged: Dict[str, Dict[str, Any]] = {
+        r["name"]: dict(r) for r in DEFAULT_RULES
+    }
+    for rule in _load_user_rules():
+        merged[str(rule["name"])] = dict(rule)
+    out = [r for r in merged.values() if not r.get("disabled")]
+    with _lock:
+        _rules_cache = out
+    return list(out)
+
+
+# ---------------------------------------------------------------------------
+# Predicate evaluation
+# ---------------------------------------------------------------------------
+
+
+def _metric_matches(key: str, name: str) -> bool:
+    base = key.split("{", 1)[0]
+    if name in (key, base):
+        return True
+    # Accept the Prometheus alias so rules can use scrape names.
+    return name == _timeseries._prom_name(base)
+
+
+def _aggregate_value(
+    name: str, flat: Optional[Dict[str, float]] = None
+) -> Optional[float]:
+    """Sum of every aggregated key matching ``name`` (exact key, base
+    name, or rsdl_ alias); None when nothing matches. Per-source
+    breakdown keys are excluded — they would double-count."""
+    if flat is None:
+        try:
+            flat = _export.aggregate()
+        except Exception:
+            return None
+    total: Optional[float] = None
+    for key, value in flat.items():
+        if "source=" in key:
+            continue
+        if _metric_matches(key, name):
+            total = (total or 0.0) + float(value)
+    return total
+
+
+def _source_of(key: str) -> Optional[str]:
+    brace, close = key.find("{"), key.rfind("}")
+    if not (0 <= brace < close):
+        return None
+    for part in key[brace + 1:close].split(","):
+        k, _, v = part.partition("=")
+        if k == "source":
+            return v
+    return None
+
+
+def _window_rate(name: str, window_s: float,
+                 now: Optional[float] = None,
+                 fold: str = "sum") -> Optional[float]:
+    """Mean per-second rate of ``name`` over the trailing window of
+    the ring. ``fold="sum"`` (default): per sample, matching keys'
+    rates sum cluster-wide, then samples average. ``fold="max-source"``:
+    the same mean computed per source process, returning the WORST
+    source — the right shape for share-of-wall-clock budgets like
+    stall seconds/second, where a cluster-wide sum scales with the
+    consumer count instead of measuring any one consumer's health.
+    None when the ring holds no rated point for the metric (unknown —
+    a rule must not fire on ignorance)."""
+    per_source = fold == "max-source"
+    series = _timeseries.series(
+        name=name, window_s=window_s, now=now,
+        include_sources=per_source,
+    )
+    # {group: {ts: summed rate}} — one group ("") for the cluster sum,
+    # one per source label otherwise.
+    groups: Dict[str, Dict[float, float]] = {}
+    for key, points in series.items():
+        src = _source_of(key)
+        if per_source:
+            if src is None:
+                continue  # cluster-merged key would double-count
+        elif src is not None:
+            continue
+        by_ts = groups.setdefault(src or "", {})
+        for p in points:
+            if "rate" in p:
+                ts = float(p["ts"])
+                by_ts[ts] = by_ts.get(ts, 0.0) + float(p["rate"])
+    means = [
+        sum(by_ts.values()) / len(by_ts)
+        for by_ts in groups.values()
+        if by_ts
+    ]
+    if not means:
+        return None
+    return max(means) if per_source else means[0]
+
+
+def _metric_fresh_in_ring(name: str, window_s: float,
+                          now: Optional[float] = None) -> bool:
+    series = _timeseries.series(name=name, window_s=window_s, now=now)
+    return any(points for points in series.values())
+
+
+def _trial_in_flight() -> bool:
+    import sys as _sys
+
+    shuffle_mod = _sys.modules.get("ray_shuffling_data_loader_tpu.shuffle")
+    if shuffle_mod is None:
+        return False
+    try:
+        return bool(shuffle_mod.live_status().get("running"))
+    except Exception:
+        return False
+
+
+def _condition(
+    rule: Dict[str, Any],
+    flat: Optional[Dict[str, float]],
+    now: float,
+) -> Tuple[Optional[bool], Optional[float]]:
+    """(condition, observed value) for one rule; condition None means
+    "unknown" (no data) — treated as not-firing for threshold/rate."""
+    kind = str(rule.get("kind", "threshold"))
+    metric = str(rule.get("metric", ""))
+    op = _OPS.get(str(rule.get("op", ">")))
+    target = float(rule.get("value", 0.0))
+    if kind == "absence":
+        window_s = rule.get("window_s")
+        value = _aggregate_value(metric, flat)
+        if value is None:
+            return True, None
+        if window_s and not _metric_fresh_in_ring(
+            metric, float(window_s), now=now
+        ):
+            return True, value
+        return False, value
+    if op is None or not metric:
+        return None, None
+    if kind == "rate":
+        rate = _window_rate(
+            metric, float(rule.get("window_s", 60.0)), now=now,
+            fold=str(rule.get("fold", "sum")),
+        )
+        if rate is None:
+            return None, None
+        return op(rate, target), rate
+    value = _aggregate_value(metric, flat)
+    if value is None:
+        return None, None
+    return op(value, target), value
+
+
+# ---------------------------------------------------------------------------
+# State machine
+# ---------------------------------------------------------------------------
+
+
+def _rule_row(rule: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, Any]:
+    """The one ``/alerts`` row shape — shared by :func:`evaluate` and
+    :func:`alerts_body` so the page served mid-tick and between ticks
+    cannot drift."""
+    return {
+        "name": str(rule["name"]),
+        "kind": rule.get("kind", "threshold"),
+        "metric": rule.get("metric"),
+        "op": rule.get("op"),
+        "threshold": rule.get("value"),
+        "severity": rule.get("severity", "warn"),
+        "state": state.get("state", "ok"),
+        "active": state.get("state") == "firing",
+        "value": state.get("value"),
+        "since": state.get("since"),
+        "fired_ts": state.get("fired_ts"),
+        "resolved_ts": state.get("resolved_ts"),
+        "fired_count": state.get("fired_count", 0),
+    }
+
+
+def _emit(kind: str, rule: Dict[str, Any], state: Dict[str, Any]) -> None:
+    try:
+        from ray_shuffling_data_loader_tpu import telemetry as _t
+
+        _t.emit_event(
+            kind,
+            _flush=True,
+            rule=rule["name"],
+            severity=rule.get("severity", "warn"),
+            metric=rule.get("metric"),
+            value=state.get("value"),
+            threshold=rule.get("value"),
+        )
+    except Exception:
+        pass
+
+
+def evaluate(now: Optional[float] = None) -> Dict[str, Any]:
+    """One engine tick: evaluate every rule against the aggregated
+    registry + timeseries ring, advance the ok → pending → firing →
+    resolved state machine, emit fire/resolve events + gauges. Called
+    by the sampler tick; returns the ``/alerts`` body. Never raises."""
+    now = time.time() if now is None else float(now)
+    try:
+        flat = _export.aggregate()
+    except Exception:
+        flat = {}
+    in_flight = _trial_in_flight()
+    reg = _metrics.registry if _metrics.enabled() else None
+    rows: List[Dict[str, Any]] = []
+    for rule in rules():
+        name = str(rule["name"])
+        with _lock:
+            state = _states.setdefault(
+                name, {"state": "ok", "since": None, "fired_count": 0}
+            )
+        try:
+            if rule.get("only_in_flight") and not in_flight:
+                cond, value = False, None
+            else:
+                cond, value = _condition(rule, flat, now)
+        except Exception:
+            cond, value = None, None
+        with _lock:
+            state["value"] = value
+            for_s = float(rule.get("for_s", 0.0))
+            st = state["state"]
+            if cond:
+                if st == "ok":
+                    state["state"] = "pending"
+                    state["since"] = now
+                    st = "pending"
+                if st == "pending" and now - state["since"] >= for_s:
+                    state["state"] = "firing"
+                    state["fired_ts"] = now
+                    state["fired_count"] += 1
+                    _history.append(
+                        {"ts": now, "rule": name, "event": "fired",
+                         "value": value}
+                    )
+                    del _history[:-_HISTORY_CAP]
+                    _emit("alert.fired", rule, state)
+                    if reg is not None:
+                        reg.counter("alert.fired_total", rule=name).inc()
+            else:
+                if st == "firing":
+                    state["state"] = "ok"
+                    state["since"] = None
+                    state["resolved_ts"] = now
+                    _history.append(
+                        {"ts": now, "rule": name, "event": "resolved",
+                         "value": value}
+                    )
+                    del _history[:-_HISTORY_CAP]
+                    _emit("alert.resolved", rule, state)
+                elif st == "pending":
+                    state["state"] = "ok"
+                    state["since"] = None
+            if reg is not None:
+                reg.gauge("alert.active", rule=name).set(
+                    1.0 if state["state"] == "firing" else 0.0
+                )
+            rows.append(_rule_row(rule, state))
+    with _lock:
+        history = list(_history)
+    return {
+        "ts": now,
+        "trial_in_flight": in_flight,
+        "rules": rows,
+        "active": [r["name"] for r in rows if r["active"]],
+        "history": history,
+    }
+
+
+def alerts_body() -> Dict[str, Any]:
+    """The ``/alerts`` page: the last evaluated state WITHOUT forcing
+    an evaluation (cadence belongs to the sampler tick); evaluates
+    once if the engine has never run (e.g. headless one-shot use)."""
+    with _lock:
+        evaluated = bool(_states)
+        history = list(_history)
+    if not evaluated:
+        return evaluate()
+    rows: List[Dict[str, Any]] = []
+    for rule in rules():
+        with _lock:
+            state = dict(_states.get(str(rule["name"])) or {})
+        rows.append(_rule_row(rule, state))
+    return {
+        "ts": time.time(),
+        "rules": rows,
+        "active": [r["name"] for r in rows if r["active"]],
+        "history": history,
+    }
+
+
+def fired_counts() -> Dict[str, int]:
+    """``{rule: times fired}`` over this engine's lifetime — what
+    ``bench.py`` embeds in ``telemetry_final``."""
+    with _lock:
+        return {
+            name: int(state.get("fired_count", 0))
+            for name, state in _states.items()
+            if state.get("fired_count")
+        }
+
+
+def status_section() -> Dict[str, Any]:
+    """The trimmed view ``/status`` embeds (the full one lives at
+    ``/alerts``)."""
+    body = alerts_body()
+    return {
+        "active": body["active"],
+        "fired_counts": fired_counts(),
+        "rules": len(body["rules"]),
+    }

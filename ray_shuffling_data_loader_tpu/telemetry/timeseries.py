@@ -324,8 +324,11 @@ def start(period: Optional[float] = None) -> None:
         def _loop():
             while not stop_event.wait(interval):
                 try:
-                    # Fold the latest straggler view in first so the
-                    # rsdl_straggler_* gauges have history too.
+                    # Refresh the derived-gauge planes first so the
+                    # rsdl_straggler_* / rsdl_capacity_* /
+                    # rsdl_critical_* gauges have history too (each
+                    # plane is its own import so one failure cannot
+                    # starve the others).
                     from ray_shuffling_data_loader_tpu.telemetry import (
                         stragglers as _stragglers,
                     )
@@ -334,9 +337,36 @@ def start(period: Optional[float] = None) -> None:
                 except Exception:
                     pass
                 try:
+                    from ray_shuffling_data_loader_tpu.telemetry import (
+                        capacity as _capacity,
+                    )
+
+                    _capacity.safe_flush()  # driver-side ledger ops
+                    _capacity.publish_metrics()
+                except Exception:
+                    pass
+                try:
+                    from ray_shuffling_data_loader_tpu.telemetry import (
+                        critical as _critical,
+                    )
+
+                    _critical.publish_metrics()
+                except Exception:
+                    pass
+                try:
                     sample_now()
                 except Exception:
                     pass  # telemetry must never sink anything
+                try:
+                    # The alert engine reads the ring, so it evaluates
+                    # AFTER the fresh sample (rate windows see it).
+                    from ray_shuffling_data_loader_tpu.telemetry import (
+                        slo as _slo,
+                    )
+
+                    _slo.evaluate()
+                except Exception:
+                    pass
 
         _thread = threading.Thread(
             target=_loop, name="rsdl-ts-sampler", daemon=True

@@ -183,10 +183,19 @@ def set_context(**kv: Any) -> None:
 
 def outbound_context() -> Optional[Dict[str, Any]]:
     """The context to ship with a cross-process call, or None when there
-    is nothing to ship (tracing off, or the merged context is empty) —
-    the ONE definition of what crosses task/actor/cluster boundaries."""
+    is nothing to ship (both telemetry halves off, or the merged context
+    is empty) — the ONE definition of what crosses task/actor/cluster
+    boundaries. The METRICS half needs (trial, epoch) identity too —
+    task-duration records, the event log, and the capacity ledger all
+    attribute by epoch (ISSUE 7/9) — so context ships whenever either
+    half is on; with both off this stays one cached boolean check."""
     if not enabled():
-        return None
+        from ray_shuffling_data_loader_tpu.telemetry import (
+            metrics as _metrics,
+        )
+
+        if not _metrics.enabled():
+            return None
     return current_context() or None
 
 
@@ -418,9 +427,16 @@ def propagated_span(name: str, ctx: Optional[Dict[str, Any]],
                     cat: str = "task", tid: Optional[int] = None):
     """Re-enter a remote caller's trace context and open a span — the
     receive side of cross-process propagation (task workers, actor
-    dispatch). No-op when tracing is disabled."""
+    dispatch). With tracing disabled no span opens, but a shipped
+    context is still re-entered when present (the metrics half ships
+    one for epoch attribution — see :func:`outbound_context`); with
+    nothing shipped this is a no-op."""
     if not enabled():
-        yield
+        if ctx:
+            with context(**ctx):
+                yield
+        else:
+            yield
         return
     with context(**(ctx or {})):
         with trace_span(name, cat=cat, tid=tid):

@@ -45,21 +45,30 @@ if [ "$tier" != "slow" ]; then
   # vectored-framing transport path too (ISSUE 5), not just the legacy
   # pickle frames; RSDL_TCP_STREAMS=2 keeps striping on so transport
   # fault sites exercise per-stream connections (ISSUE 6).
+  # tests/test_slo.py rides the chaos lane for its wedge-alert proof
+  # (ISSUE 9): an injected wedge fault must fire — and later resolve —
+  # the default wedged-worker alert with audit ok=true (the test arms
+  # its own deterministic RSDL_FAULTS schedule, overriding the lane's).
   RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
     RSDL_TCP_ZEROCOPY=1 RSDL_TCP_STREAMS=2 \
     RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1,transport.send/driver:reset:0.02x2" \
     RSDL_FAULTS_SEED=1234 \
     python -m pytest tests/test_chaos.py tests/test_shuffle.py \
       tests/test_batch_queue.py tests/test_dataset.py \
+      tests/test_slo.py \
       -m "not slow" -q -x
   # Observability lane (ISSUE 4): the live obs plane on — metrics
   # spool/aggregation + the RSDL_OBS_PORT scrape endpoint enabled for
   # the telemetry/obs suites (core data-path suites ride along so the
   # endpoint demonstrably doesn't perturb them; the smoke test binds
   # its own free port, so a taken lane port only warns).
+  # The decision plane (ISSUE 9) rides the obs lane: capacity-ledger
+  # accounting + zero-overhead proof, online-vs-post-hoc critical-path
+  # parity, and SLO rule-engine semantics.
   RSDL_METRICS=1 RSDL_OBS_PORT=18431 \
     python -m pytest tests/test_obs.py tests/test_telemetry.py \
       tests/test_epoch_report.py tests/test_shuffle.py \
+      tests/test_capacity.py tests/test_critical.py \
       -m "not slow" -q -x
   # Epoch critical-path report, gated BOTH ways against the committed
   # fixture pair: a clean run must exit 0 (and name the dominant
@@ -92,12 +101,14 @@ if [ "$tier" != "slow" ]; then
       tests/test_device_direct_audit.py tests/test_jax_dataset.py \
       tests/test_dataset.py tests/test_shuffle.py \
       -m "not slow" -q -x
-  # Temporal-obs smoke (ISSUE 7), exit-code gated: against a MID-FLIGHT
-  # shuffle with the obs endpoint up, /timeseries must serve a non-empty
-  # rate series for rsdl_shuffle_map_rows, `rsdl_top --once --json` must
-  # render a frame from the live endpoint, and /events must carry the
-  # full epoch lifecycle afterwards (tools/obs_smoke.py asserts all
-  # three; its exit code is the gate).
+  # Temporal + decision obs smoke (ISSUES 7/9), exit-code gated:
+  # against a MID-FLIGHT shuffle with the obs endpoint up, /timeseries
+  # must serve a non-empty rate series, `rsdl_top --once --json` must
+  # render a frame, /capacity must show live per-epoch residency,
+  # /critical must name a critical-path stage, a deliberately-tripped
+  # SLO rule must FIRE and RESOLVE on /alerts (both transitions event-
+  # logged), and /events must carry the full epoch lifecycle afterwards
+  # (tools/obs_smoke.py asserts all of it; its exit code is the gate).
   RSDL_METRICS=1 python tools/obs_smoke.py
   # TCP-plane lane (ISSUE 5/6): the two-process loopback "two-host"
   # bench at a small shape — a worker host joins over real TCP (own shm

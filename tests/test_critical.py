@@ -1,0 +1,211 @@
+"""Online critical-path tests (ISSUE 9): the shared interval math
+(merge/sole-active sweep/critical-path tie-break), the live analyzer
+over task records, the rsdl_critical_* gauges — and the PARITY proof:
+the live ``telemetry/critical.py`` verdict and the post-hoc
+``tools/epoch_report.py`` verdict must be identical on the same
+fixture intervals, because they are (by construction) the same code."""
+
+import importlib.util
+import os
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.telemetry import critical, metrics
+
+_ENV = ("RSDL_METRICS", "RSDL_METRICS_DIR", "RSDL_OBS_PORT")
+
+
+@pytest.fixture
+def crit_env(tmp_path):
+    saved = {k: os.environ.get(k) for k in _ENV}
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = str(tmp_path / "metrics-spool")
+    os.environ.pop("RSDL_OBS_PORT", None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    yield
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+
+
+@pytest.fixture(scope="module")
+def epoch_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "epoch_report_parity",
+        os.path.join(repo, "tools", "epoch_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_merge_and_totals():
+    merged = critical.merge_intervals([(3.0, 5.0), (1.0, 2.0), (4.0, 7.0)])
+    assert merged == [(1.0, 2.0), (3.0, 7.0)]
+    assert critical.intervals_total(merged) == pytest.approx(5.0)
+
+
+def test_profile_epoch_sole_shares_and_tiebreak():
+    # map [0, 10], reduce [4, 10]: map sole 4s, overlap 6s, reduce
+    # sole 0 — map is the critical path.
+    row = critical.profile_epoch(
+        {"map": [(0.0, 10.0)], "reduce": [(4.0, 10.0)]}
+    )
+    assert row["critical_path"] == "map"
+    assert row["map_sole_s"] == pytest.approx(4.0)
+    assert row["overlap_s"] == pytest.approx(6.0)
+    assert row["sole_share"]["map"] == pytest.approx(0.4)
+    # A perfect tie breaks toward the LATER pipeline stage.
+    row = critical.profile_epoch(
+        {"map": [(0.0, 1.0)], "reduce": [(2.0, 3.0)]}
+    )
+    assert row["critical_path"] == "reduce"
+    assert row["idle_s"] == pytest.approx(1.0)
+
+
+def test_intervals_from_task_records_and_analyze():
+    records = [
+        {"ts": 10.0, "dur_s": 8.0, "stage": "map", "epoch": 0},
+        {"ts": 11.0, "dur_s": 1.0, "stage": "reduce", "epoch": 0},
+        {"ts": 20.0, "dur_s": 1.0, "stage": "map", "epoch": 1},
+        {"ts": 30.0, "dur_s": 9.0, "stage": "reduce", "epoch": 1},
+        {"ts": 99.0, "dur_s": 1.0, "stage": "map"},  # no epoch: skipped
+    ]
+    analysis = critical.analyze(records=records, now=31.0)
+    rows = {r["epoch"]: r for r in analysis["epochs"]}
+    assert rows[0]["critical_path"] == "map"
+    assert rows[1]["critical_path"] == "reduce"
+    # No in-flight window registered: current = the latest epoch seen.
+    assert analysis["current"]["epoch"] == 1
+    assert analysis["current"]["critical_path"] == "reduce"
+    assert analysis["run_critical_path"] == "reduce"
+    assert analysis["tasks_total"] == 5
+
+
+def test_publish_metrics_gauges_one_hot_and_zeroing(crit_env):
+    records = [
+        {"ts": 10.0, "dur_s": 8.0, "stage": "map", "epoch": 0},
+        {"ts": 11.0, "dur_s": 1.0, "stage": "reduce", "epoch": 0},
+    ]
+    critical.publish_metrics(critical.analyze(records=records, now=12.0))
+    snap = metrics.registry.snapshot()
+    assert snap["critical.epoch"] == 0.0
+    assert snap["critical.path{stage=map}"] == 1.0
+    assert snap["critical.path{stage=reduce}"] == 0.0
+    assert snap["critical.sole_share{stage=map}"] > 0.5
+    # The next epoch has no reduce tasks: its stale gauges must zero.
+    records2 = [{"ts": 20.0, "dur_s": 2.0, "stage": "plan", "epoch": 1}]
+    critical.publish_metrics(
+        critical.analyze(records=records2, now=22.0)
+    )
+    snap = metrics.registry.snapshot()
+    assert snap["critical.path{stage=map}"] == 0.0
+    assert snap["critical.sole_share{stage=map}"] == 0.0
+    assert snap["critical.path{stage=plan}"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Online vs post-hoc parity (ISSUE 9 acceptance)
+# ---------------------------------------------------------------------------
+
+# One fixture, two encodings: the same per-(epoch, stage) busy windows
+# expressed as worker task records (the live analyzer's input) and as
+# Chrome-trace spans (the report's input). Seconds offsets; the trace
+# side scales to microseconds. Shapes chosen to exercise overlap,
+# containment, idle gaps, and a different winner per epoch.
+_FIXTURE = {
+    0: {"map": [(0.0, 6.0), (2.0, 8.0)], "reduce": [(5.0, 9.0)]},
+    1: {"map": [(0.0, 2.0)], "reduce": [(1.0, 9.5), (3.0, 4.0)]},
+    2: {"map": [(0.0, 4.0)], "reduce": [(0.0, 4.0)]},  # exact tie
+}
+
+
+def _as_task_records():
+    out = []
+    for epoch, stages in _FIXTURE.items():
+        for stage, ivs in stages.items():
+            for start, end in ivs:
+                out.append(
+                    {
+                        "ts": end,
+                        "dur_s": end - start,
+                        "stage": stage,
+                        "epoch": epoch,
+                        "host": "h",
+                        "pid": 1,
+                    }
+                )
+    return out
+
+
+def _as_trace_spans():
+    out = []
+    for epoch, stages in _FIXTURE.items():
+        for stage, ivs in stages.items():
+            for start, end in ivs:
+                out.append(
+                    {
+                        "name": stage,
+                        "ph": "X",
+                        "ts": start * 1e6,
+                        "dur": (end - start) * 1e6,
+                        "pid": 1,
+                        "tid": 1,
+                        "args": {"epoch": epoch},
+                    }
+                )
+    return out
+
+
+def test_online_matches_posthoc_verdicts(epoch_report):
+    """The acceptance bar: identical critical-path verdicts (and the
+    underlying busy/sole/overlap numbers) from the live analyzer and
+    the post-hoc report on the same fixture intervals."""
+    live = {
+        r["epoch"]: r
+        for r in critical.analyze(
+            records=_as_task_records(), now=100.0
+        )["epochs"]
+    }
+    posthoc = epoch_report.collect_epochs(_as_trace_spans())
+    assert set(live) == set(posthoc) == set(_FIXTURE)
+    for epoch in _FIXTURE:
+        lrow, prow = live[epoch], posthoc[epoch]
+        assert lrow["critical_path"] == prow["critical_path"], epoch
+        for key in ("wall_s", "idle_s", "overlap_s", "map_s",
+                    "map_sole_s", "reduce_s", "reduce_sole_s"):
+            assert lrow[key] == pytest.approx(prow[key], abs=1e-6), (
+                epoch, key,
+            )
+    # And the run-level verdict agrees too.
+    report = epoch_report.build_report(
+        _as_trace_spans(), [], [], None, None, 10.0, 10.0
+    )
+    live_run = critical.analyze(
+        records=_as_task_records(), now=100.0
+    )["run_critical_path"]
+    assert report["header"]["critical_path"] == live_run
+
+
+def test_parity_tiebreak_is_shared():
+    """The exact-tie epoch names the later stage in BOTH views — the
+    tie-break rule cannot drift because it is one function."""
+    row_live = critical.profile_epoch(_FIXTURE[2])
+    row_posthoc = critical.profile_epoch(
+        {
+            s: [(a * 1e6, b * 1e6) for a, b in ivs]
+            for s, ivs in _FIXTURE[2].items()
+        },
+        scale=1e6,
+    )
+    assert (
+        row_live["critical_path"]
+        == row_posthoc["critical_path"]
+        == "reduce"
+    )

@@ -298,6 +298,53 @@ def test_temporal_endpoints(metrics_spool):
         stragglers.reset(clear_spool=True)
 
 
+def test_decision_endpoints(metrics_spool):
+    """ISSUE 9 pages: /capacity serves the ledger fold + host sample,
+    /critical the online critical-path verdict, /alerts the rule
+    states — and /status carries all three sections."""
+    from ray_shuffling_data_loader_tpu.telemetry import (
+        capacity,
+        slo,
+        stragglers,
+    )
+
+    capacity.reset(clear_spool=True)
+    stragglers.reset(clear_spool=True)
+    slo.reset()
+    capacity.note("create", "seg-a", nbytes=4096, tier="shm", epoch=0)
+    stragglers.record_task("shuffle_map", 2.0, epoch=0)
+    stragglers.record_task("shuffle_reduce", 0.25, epoch=0)
+    port = obs_server.start(0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _, body = _get(base + "/capacity")
+        cap = json.loads(body)
+        cell = cap["epochs"]["0"]["shm"]
+        assert cell["resident_bytes"] == 4096 and cell["segments"] == 1
+        assert cap["host"].get("rss_bytes", 0) > 0
+
+        _, body = _get(base + "/critical")
+        crit = json.loads(body)
+        assert crit["current"]["epoch"] == 0
+        assert crit["current"]["critical_path"] == "map"
+
+        _, body = _get(base + "/alerts")
+        alerts = json.loads(body)
+        names = {r["name"] for r in alerts["rules"]}
+        assert "wedged_worker" in names and "audit_mismatch" in names
+
+        _, body = _get(base + "/status")
+        status = json.loads(body)
+        assert status["capacity"]["totals"]["shm"]["resident_bytes"] == 4096
+        assert status["critical"]["current"]["critical_path"] == "map"
+        assert status["alerts"]["active"] == []
+    finally:
+        obs_server.stop()
+        capacity.reset(clear_spool=True)
+        stragglers.reset(clear_spool=True)
+        slo.reset()
+
+
 def test_no_server_without_env(metrics_spool):
     ctx = runtime.init(num_workers=1)
     try:

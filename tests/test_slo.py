@@ -1,0 +1,352 @@
+"""SLO alert-engine tests (ISSUE 9): rule evaluation (threshold fire +
+resolve, for_s pending, absence, rate-over-window via the timeseries
+ring), rule-source merging (RSDL_SLO_RULES overrides/disables the
+default pack), the alert.fired/alert.resolved event + gauge surface —
+and the chaos integration: a ``wedge`` fault injected into a reduce
+task must fire (and later resolve) the default ``wedged_worker`` alert
+with exactly-once delivery intact (function-scoped runtimes, per the
+obs/chaos test convention)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import faults
+from ray_shuffling_data_loader_tpu.telemetry import (
+    events,
+    metrics,
+    slo,
+    stragglers,
+    timeseries,
+)
+
+_ENV = (
+    "RSDL_METRICS", "RSDL_METRICS_DIR", "RSDL_OBS_PORT", "RSDL_TS",
+    "RSDL_SLO_RULES", "RSDL_EVENTS_DIR",
+    "RSDL_FAULTS", "RSDL_FAULTS_SEED", "RSDL_FAULTS_WEDGE_S",
+    "RSDL_STRAGGLER_K", "RSDL_STRAGGLER_MIN_S",
+    "RSDL_AUDIT", "RSDL_AUDIT_DIR",
+)
+
+
+@pytest.fixture
+def slo_env(tmp_path):
+    saved = {k: os.environ.get(k) for k in _ENV}
+    os.environ["RSDL_METRICS"] = "1"
+    os.environ["RSDL_METRICS_DIR"] = str(tmp_path / "metrics-spool")
+    for k in _ENV[2:]:
+        os.environ.pop(k, None)
+    metrics.refresh_from_env()
+    metrics.reset()
+    timeseries.reset()
+    events.reset()
+    slo.reset()
+    yield
+    slo.reset()
+    timeseries.stop()
+    timeseries.reset()
+    events.reset()
+    stragglers.reset(clear_spool=True)
+    metrics.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    metrics.refresh_from_env()
+    faults.refresh_from_env()
+
+
+def _rule(**kv):
+    os.environ["RSDL_SLO_RULES"] = json.dumps([kv])
+    slo.reset()
+
+
+def _alert_events(kind):
+    return [r for r in events.load() if r.get("kind") == kind]
+
+
+def test_threshold_fire_and_resolve(slo_env):
+    _rule(name="trip", kind="threshold", metric="x.level", op=">",
+          value=10)
+    metrics.registry.gauge("x.level").set(5)
+    out = slo.evaluate(now=100.0)
+    assert out["active"] == []
+    metrics.registry.gauge("x.level").set(25)
+    out = slo.evaluate(now=101.0)
+    assert out["active"] == ["trip"]
+    row = next(r for r in out["rules"] if r["name"] == "trip")
+    assert row["value"] == 25.0 and row["fired_count"] == 1
+    assert metrics.registry.snapshot()["alert.active{rule=trip}"] == 1.0
+    fired = _alert_events("alert.fired")
+    assert fired and fired[-1]["rule"] == "trip"
+    assert fired[-1]["value"] == 25.0
+    # Still over: stays firing, no duplicate fire event.
+    out = slo.evaluate(now=102.0)
+    assert out["active"] == ["trip"]
+    assert len(_alert_events("alert.fired")) == 1
+    # Clears: resolves, gauge drops, resolve event lands.
+    metrics.registry.gauge("x.level").set(0)
+    out = slo.evaluate(now=103.0)
+    assert out["active"] == []
+    assert metrics.registry.snapshot()["alert.active{rule=trip}"] == 0.0
+    resolved = _alert_events("alert.resolved")
+    assert resolved and resolved[-1]["rule"] == "trip"
+    assert slo.fired_counts() == {"trip": 1}
+
+
+def test_for_s_holds_before_firing(slo_env):
+    _rule(name="slowtrip", kind="threshold", metric="x.level", op=">",
+          value=0, for_s=5.0)
+    metrics.registry.gauge("x.level").set(1)
+    assert slo.evaluate(now=100.0)["active"] == []  # pending
+    assert slo.evaluate(now=103.0)["active"] == []  # still pending
+    assert slo.evaluate(now=105.5)["active"] == ["slowtrip"]
+    # A dip back under before for_s elapses resets the clock.
+    _rule(name="slowtrip", kind="threshold", metric="x.level", op=">",
+          value=0, for_s=5.0)
+    metrics.registry.gauge("x.level").set(1)
+    slo.evaluate(now=200.0)
+    metrics.registry.gauge("x.level").set(0)
+    slo.evaluate(now=202.0)  # pending -> ok, no fire
+    metrics.registry.gauge("x.level").set(1)
+    slo.evaluate(now=203.0)
+    assert slo.evaluate(now=206.0)["active"] == []  # only 3 s held
+    assert slo.evaluate(now=208.5)["active"] == ["slowtrip"]
+
+
+def test_absence_rule(slo_env):
+    _rule(name="missing", kind="absence", metric="heartbeat.count")
+    out = slo.evaluate(now=100.0)
+    assert out["active"] == ["missing"]  # metric absent entirely
+    metrics.registry.counter("heartbeat.count").inc()
+    out = slo.evaluate(now=101.0)
+    assert out["active"] == []  # present: resolved
+    assert slo.fired_counts() == {"missing": 1}
+    assert _alert_events("alert.resolved")[-1]["rule"] == "missing"
+
+
+def test_rate_rule_over_ring_window(slo_env):
+    """A rate rule reads the sampler ring: a counter advancing slower
+    than the floor fires; speeding it back up resolves."""
+    _rule(name="slow_rows", kind="rate", metric="y.rows", op="<",
+          value=5.0, window_s=60.0)
+    counter = metrics.registry.counter("y.rows")
+    # No samples yet: unknown, must NOT fire on ignorance.
+    assert slo.evaluate(now=999.0)["active"] == []
+    counter.inc(100)
+    timeseries.sample_now(now=1000.0)
+    counter.inc(2)  # 2 rows / 2 s = 1 row/s < 5
+    timeseries.sample_now(now=1002.0)
+    out = slo.evaluate(now=1002.5)
+    assert out["active"] == ["slow_rows"]
+    counter.inc(200)  # 100 rows/s over the next step
+    timeseries.sample_now(now=1004.0)
+    # The 60 s window still averages in the slow sample; shrink via a
+    # fresh fast-only window.
+    _rule(name="slow_rows", kind="rate", metric="y.rows", op="<",
+          value=5.0, window_s=1.0)
+    out = slo.evaluate(now=1004.5)
+    assert out["active"] == []
+
+
+def test_rate_fold_max_source_normalizes_by_consumer(slo_env):
+    """A share-of-wall-clock rule with fold=max-source keys on the
+    WORST source, not the cluster sum: two consumers each 30 % stalled
+    must not trip a 50 % budget (the sum, 60 %, would)."""
+    import socket as _socket
+
+    spool = os.environ["RSDL_METRICS_DIR"]
+
+    def _write(pid, value, ts):
+        os.makedirs(spool, exist_ok=True)
+        path = os.path.join(spool, f"metrics-task-{pid}.json")
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "source": {"role": "task", "pid": pid,
+                               "host": _socket.gethostname()},
+                    "ts": ts,
+                    "metrics": {
+                        "stall_seconds{cause=upstream}": {
+                            "kind": "counter", "value": value,
+                        }
+                    },
+                },
+                f,
+            )
+
+    _write(111, 0.0, time.time())
+    _write(222, 0.0, time.time())
+    timeseries.sample_now(now=1000.0)
+    _write(111, 3.0, time.time())  # 3 s stalled over a 10 s step = 30%
+    _write(222, 3.0, time.time())
+    timeseries.sample_now(now=1010.0)
+
+    _rule(name="worst", kind="rate", metric="stall_seconds", op=">",
+          value=0.5, window_s=60.0, fold="max-source")
+    assert slo.evaluate(now=1010.5)["active"] == []
+    _rule(name="summed", kind="rate", metric="stall_seconds", op=">",
+          value=0.5, window_s=60.0)
+    assert slo.evaluate(now=1010.5)["active"] == ["summed"]
+
+
+def test_user_rules_override_and_disable_defaults(slo_env):
+    names = {r["name"] for r in slo.rules()}
+    # The default pack ships the ISSUE 9 five.
+    for expected in ("producer_stalled", "stall_over_budget",
+                     "capacity_near_limit", "wedged_worker",
+                     "audit_mismatch"):
+        assert expected in names
+    os.environ["RSDL_SLO_RULES"] = json.dumps([
+        {"name": "wedged_worker", "kind": "threshold",
+         "metric": "straggler.wedged_tasks", "op": ">", "value": 3},
+        {"name": "audit_mismatch", "disabled": True},
+        {"name": "mine", "kind": "threshold", "metric": "z", "op": ">",
+         "value": 0},
+    ])
+    slo.reset()
+    by_name = {r["name"]: r for r in slo.rules()}
+    assert by_name["wedged_worker"]["value"] == 3  # overridden
+    assert "audit_mismatch" not in by_name  # disabled
+    assert "mine" in by_name  # added
+    assert "producer_stalled" in by_name  # untouched default
+
+
+def test_base_name_sums_labeled_series(slo_env):
+    """A rule on a base name covers every labeled series of it — the
+    stall budget rule sums both causes."""
+    _rule(name="sum", kind="threshold", metric="stall_seconds", op=">",
+          value=10)
+    metrics.registry.counter("stall_seconds", cause="upstream").inc(7)
+    metrics.registry.counter("stall_seconds", cause="staging").inc(6)
+    out = slo.evaluate(now=100.0)
+    assert out["active"] == ["sum"]
+    row = next(r for r in out["rules"] if r["name"] == "sum")
+    assert row["value"] == 13.0
+
+
+def test_prom_alias_accepted(slo_env):
+    _rule(name="alias", kind="threshold", metric="rsdl_x_level",
+          op=">", value=0)
+    metrics.registry.gauge("x.level").set(1)
+    assert slo.evaluate(now=100.0)["active"] == ["alias"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos integration: a wedge fault fires (and resolves) the default
+# wedged_worker alert (ISSUE 9 acceptance)
+# ---------------------------------------------------------------------------
+
+NUM_FILES = 2
+ROWS_PER_FILE = 512
+NUM_REDUCERS = 4
+
+
+def test_chaos_wedge_fires_wedged_worker_alert(slo_env, tmp_path):
+    """Arm a deterministic ``wedge`` fault on one reduce task: while
+    it sleeps, the straggler gauges feed the default ``wedged_worker``
+    rule — the alert must FIRE live (event + gauge + /alerts state)
+    and RESOLVE after the run drains, with audit ok=true throughout."""
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+    from ray_shuffling_data_loader_tpu.telemetry import audit
+
+    os.environ["RSDL_FAULTS"] = "task.reduce/task:wedge:1x1"
+    os.environ["RSDL_FAULTS_SEED"] = "42"
+    os.environ["RSDL_FAULTS_WEDGE_S"] = "2.5"
+    faults.refresh_from_env()
+    audit.enable(spool_dir=str(tmp_path / "audit-spool"))
+    # One worker process: the x1 cap is per process, so exactly one
+    # reduce task wedges and the other three stay fast.
+    runtime.init(num_workers=1)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    files = [
+        generate_file(i, i * ROWS_PER_FILE, ROWS_PER_FILE, 1,
+                      str(data_dir))[0]
+        for i in range(NUM_FILES)
+    ]
+
+    class _Consumer(BatchConsumer):
+        def __init__(self):
+            self.done = threading.Event()
+
+        def consume(self, rank, epoch, batches):
+            pass
+
+        def producer_done(self, rank, epoch):
+            self.done.set()
+
+        def wait_until_ready(self, epoch):
+            pass
+
+        def wait_until_all_epochs_done(self):
+            assert self.done.wait(timeout=180)
+
+    errors = []
+
+    def _run():
+        try:
+            shuffle(
+                files, _Consumer(), num_epochs=1,
+                num_reducers=NUM_REDUCERS, num_trainers=1, seed=3,
+            )
+        except BaseException as exc:
+            errors.append(exc)
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    try:
+        # Drive the same refresh the sampler tick runs (stragglers
+        # publish, then engine evaluate) until the alert fires.
+        fired_state = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            stragglers.publish_metrics()
+            out = slo.evaluate()
+            if "wedged_worker" in out["active"]:
+                fired_state = next(
+                    r for r in out["rules"]
+                    if r["name"] == "wedged_worker"
+                )
+                break
+            time.sleep(0.05)
+        assert fired_state is not None, "wedged_worker never fired"
+        assert fired_state["value"] >= 1.0
+        snap = metrics.registry.snapshot()
+        assert snap["alert.active{rule=wedged_worker}"] == 1.0
+        fired = [r for r in events.load()
+                 if r.get("kind") == "alert.fired"
+                 and r.get("rule") == "wedged_worker"]
+        assert fired, "no alert.fired event"
+        thread.join(timeout=180)
+        assert not thread.is_alive()
+        assert not errors, errors
+        # The wedged task completed: the in-flight set empties, the
+        # gauge drops, and the next evaluation resolves the alert.
+        deadline = time.time() + 60
+        resolved = False
+        while time.time() < deadline:
+            stragglers.publish_metrics()
+            out = slo.evaluate()
+            if "wedged_worker" not in out["active"]:
+                resolved = True
+                break
+            time.sleep(0.05)
+        assert resolved, "wedged_worker never resolved"
+        assert [r for r in events.load()
+                if r.get("kind") == "alert.resolved"
+                and r.get("rule") == "wedged_worker"]
+        # Exactly-once held through the wedge (the chaos bar).
+        verdicts = audit.verdicts()
+        assert verdicts and all(v["ok"] for v in verdicts)
+        assert slo.fired_counts().get("wedged_worker") == 1
+    finally:
+        thread.join(timeout=5)
+        runtime.shutdown()
+        audit.disable()

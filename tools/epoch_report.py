@@ -35,7 +35,16 @@ The temporal plane (ISSUE 7) joins in when its artifacts are given:
   over ``k×`` median — ``--straggler-k``, default 4);
 * ``--timeseries <file|dir>`` — the sampler's append-only NDJSON
   (``<metrics spool>/ts/timeseries.ndjson``): sample count/span and
-  the map-rows rate envelope in the header.
+  the map-rows rate envelope in the header;
+* ``--capacity <file|dir>`` — the capacity-ledger spool
+  (``<metrics spool>/capacity``, ISSUE 9): the per-(epoch, tier)
+  residency/high-watermark table — which epochs held how many bytes
+  where, folded by the same ``telemetry/capacity.py`` ledger the live
+  ``/capacity`` endpoint serves.
+
+The interval-union / critical-path math itself is shared with the live
+``/critical`` analyzer (``telemetry/critical.py``): the online verdict
+and this report agree by construction.
 
 With ``--baseline BENCH_rXX.json`` (either a raw ``bench.py`` JSON line
 or the round-capture wrapper with a ``"parsed"`` field) the current
@@ -64,8 +73,40 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import os as _os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
+
+# The interval-union / critical-path math is SHARED with the live
+# analyzer (telemetry/critical.py serves the same decomposition at
+# /critical mid-run) — one implementation, so the online verdict and
+# this post-hoc report agree by construction (ISSUE 9). The modules
+# are loaded straight from their source files, NOT via the package:
+# the package __init__ pulls numpy-dependent modules, and this tool's
+# contract is pure stdlib (runs on an analysis box with no deps).
+# Both files keep their own telemetry imports function-local for
+# exactly this reason; the already-imported package module is reused
+# when present (same file either way).
+
+
+def _load_telemetry_module(name: str):
+    import importlib.util
+
+    full = f"ray_shuffling_data_loader_tpu.telemetry.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    path = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "ray_shuffling_data_loader_tpu", "telemetry", f"{name}.py",
+    )
+    spec = importlib.util.spec_from_file_location(f"_rsdl_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_capacity = _load_telemetry_module("capacity")
+_critical = _load_telemetry_module("critical")
 
 # Span-name -> pipeline-stage mapping (docs/observability.md vocabulary).
 # map:read is a sub-interval of map and deliver:wait-maps is bookkeeping,
@@ -172,53 +213,14 @@ def _bench_fields(obj: Optional[dict]) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
-# Interval math (microsecond Chrome-trace timestamps)
+# Interval math — delegated to telemetry/critical.py (the live /critical
+# analyzer); these thin aliases keep the tool's public surface stable.
+# Trace timestamps are microseconds; profile_epoch scales them out.
 # ---------------------------------------------------------------------------
 
-
-def _merge(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
-    out: List[Tuple[float, float]] = []
-    for start, end in sorted(intervals):
-        if out and start <= out[-1][1]:
-            if end > out[-1][1]:
-                out[-1] = (out[-1][0], end)
-        else:
-            out.append((start, end))
-    return out
-
-
-def _total(merged: List[Tuple[float, float]]) -> float:
-    return sum(end - start for start, end in merged)
-
-
-def _active_profile(
-    by_stage: Dict[str, List[Tuple[float, float]]]
-) -> Dict[str, float]:
-    """Sweep the union of all stage boundaries and integrate: per-stage
-    sole-active time, total >= 2-stages-overlap time, and any-active
-    time — the decomposition the critical-path call keys on."""
-    points = sorted(
-        {t for ivs in by_stage.values() for iv in ivs for t in iv}
-    )
-    sole = {stage: 0.0 for stage in by_stage}
-    overlap = 0.0
-    any_active = 0.0
-    for lo, hi in zip(points, points[1:]):
-        if hi <= lo:
-            continue
-        active = [
-            stage
-            for stage, ivs in by_stage.items()
-            if any(s <= lo and hi <= e for s, e in ivs)
-        ]
-        span = hi - lo
-        if len(active) == 1:
-            sole[active[0]] += span
-        elif len(active) >= 2:
-            overlap += span
-        if active:
-            any_active += span
-    return {"sole": sole, "overlap": overlap, "any": any_active}
+_merge = _critical.merge_intervals
+_total = _critical.intervals_total
+_active_profile = _critical.active_profile
 
 
 def collect_epochs(events: List[dict]) -> Dict[int, Dict[str, Any]]:
@@ -250,31 +252,10 @@ def collect_epochs(events: List[dict]) -> Dict[int, Dict[str, Any]]:
             per[cause] = per.get(cause, 0.0) + (end - start) / 1e6
     out: Dict[int, Dict[str, Any]] = {}
     for epoch, by_stage in intervals.items():
-        merged = {stage: _merge(ivs) for stage, ivs in by_stage.items()}
-        lo = min(s for ivs in merged.values() for s, _ in ivs)
-        hi = max(e for ivs in merged.values() for _, e in ivs)
-        profile = _active_profile(merged)
-        row: Dict[str, Any] = {
-            "epoch": epoch,
-            "wall_s": (hi - lo) / 1e6,
-            "idle_s": (hi - lo - profile["any"]) / 1e6,
-            "overlap_s": profile["overlap"] / 1e6,
-        }
-        for stage in STAGE_ORDER:
-            if stage in merged:
-                row[f"{stage}_s"] = _total(merged[stage]) / 1e6
-                row[f"{stage}_sole_s"] = profile["sole"][stage] / 1e6
-        # Critical path: the stage with the largest SOLE-active time —
-        # the part of the epoch it alone kept the clock running; a
-        # stage fully hidden under another's overlap cannot be the
-        # bottleneck no matter how busy it was. Ties (fully-pipelined
-        # epochs) break toward the later pipeline stage, which is the
-        # one backpressure propagates from.
-        present = [s for s in STAGE_ORDER if s in merged]
-        row["critical_path"] = max(
-            present,
-            key=lambda s: (profile["sole"][s], STAGE_ORDER.index(s)),
-        )
+        row = _critical.profile_epoch(by_stage, scale=1e6)
+        if not row:
+            continue
+        row["epoch"] = epoch
         for cause, secs in (stalls.get(epoch) or {}).items():
             row[f"stall_{cause}_s"] = secs
         out[epoch] = row
@@ -410,6 +391,7 @@ def build_report(
     event_records: Optional[List[dict]] = None,
     task_records: Optional[List[dict]] = None,
     ts_samples: Optional[List[dict]] = None,
+    capacity_records: Optional[List[dict]] = None,
     straggler_k: float = 4.0,
 ) -> Dict[str, Any]:
     epochs = collect_epochs(events)
@@ -462,14 +444,12 @@ def build_report(
         header["stage_totals_s"] = {
             s: round(v, 3) for s, v in totals.items() if v
         }
-        crit = [r["critical_path"] for r in rows if "critical_path" in r]
-        if crit:
-            # The run-level call: the stage most often on the critical
-            # path across epochs (ties toward the later stage).
-            header["critical_path"] = max(
-                set(crit),
-                key=lambda s: (crit.count(s), STAGE_ORDER.index(s)),
-            )
+        # The run-level call: the stage most often on the critical
+        # path across epochs (ties toward the later stage) — the same
+        # fold the live /critical endpoint serves.
+        run_crit = _critical.run_critical_path(rows)
+        if run_crit is not None:
+            header["critical_path"] = run_crit
 
     regressions: List[str] = []
     if base:
@@ -497,7 +477,41 @@ def build_report(
         report["events"] = events_summary["notable"]
     if task_records is not None:
         report["stragglers"] = straggler_rows(task_records, straggler_k)
+    if capacity_records is not None:
+        report["capacity"] = capacity_rows(capacity_records)
     return report
+
+
+def capacity_rows(capacity_records: List[dict]) -> List[Dict[str, Any]]:
+    """The per-(epoch, tier) residency/watermark table from the
+    capacity-ledger spool — the post-hoc twin of the live ``/capacity``
+    view (the fold is telemetry/capacity.py's, shared)."""
+    folded = _capacity.ledger(capacity_records)
+    rows: List[Dict[str, Any]] = []
+    for epoch in sorted(
+        folded.get("epochs", {}), key=_capacity.epoch_sort_key
+    ):
+        for tier, cell in sorted(folded["epochs"][epoch].items()):
+            rows.append(
+                {
+                    "epoch": epoch,
+                    "tier": tier,
+                    "resident_mb": round(
+                        cell.get("resident_bytes", 0) / 1e6, 3
+                    ),
+                    "hwm_mb": round(cell.get("hwm_bytes", 0) / 1e6, 3),
+                    "created_mb": round(
+                        cell.get("created_bytes", 0) / 1e6, 3
+                    ),
+                    "fetched_mb": round(
+                        cell.get("fetched_bytes", 0) / 1e6, 3
+                    ),
+                    "freed_mb": round(cell.get("freed_bytes", 0) / 1e6, 3),
+                    "segments": cell.get("segments", 0),
+                    "oldest_age_s": cell.get("oldest_age_s"),
+                }
+            )
+    return rows
 
 
 # ---------------------------------------------------------------------------
@@ -524,6 +538,11 @@ _COLUMNS = [
 _STRAGGLER_COLUMNS = [
     "epoch", "stage", "tasks", "median_s", "p99_s", "skew", "flagged",
     "slowest_host",
+]
+
+_CAPACITY_COLUMNS = [
+    "epoch", "tier", "resident_mb", "hwm_mb", "created_mb", "fetched_mb",
+    "freed_mb", "segments", "oldest_age_s",
 ]
 
 
@@ -587,6 +606,33 @@ def render(report: Dict[str, Any]) -> str:
                         f"pid={t.get('pid')} dur={_fmt(t.get('dur_s'))}s "
                         f"(median {_fmt(r.get('median_s'))}s)"
                     )
+    capacity_table = report.get("capacity")
+    if capacity_table is not None:
+        lines.append("")
+        lines.append("capacity ledger (per epoch/tier)")
+        if not capacity_table:
+            lines.append("  (no ledger records)")
+        else:
+            widths = {
+                c: max(
+                    len(c),
+                    *(len(_fmt(r.get(c))) for r in capacity_table),
+                )
+                for c in _CAPACITY_COLUMNS
+            }
+            lines.append(
+                "  ".join(c.rjust(widths[c]) for c in _CAPACITY_COLUMNS)
+            )
+            lines.append(
+                "  ".join("-" * widths[c] for c in _CAPACITY_COLUMNS)
+            )
+            for r in capacity_table:
+                lines.append(
+                    "  ".join(
+                        _fmt(r.get(c), widths[c])
+                        for c in _CAPACITY_COLUMNS
+                    )
+                )
     notable = report.get("events")
     if notable:
         lines.append("")
@@ -647,6 +693,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "ts/timeseries.ndjson) for the header rate envelope",
     )
     parser.add_argument(
+        "--capacity",
+        help="capacity-ledger NDJSON (file, or the <metrics spool>/"
+        "capacity dir of ledger-*.ndjson) for the per-epoch "
+        "residency/watermark table",
+    )
+    parser.add_argument(
         "--straggler-k", type=float, default=4.0,
         help="straggler budget: flag tasks slower than K x the "
         "(epoch, stage) median (default 4)",
@@ -665,11 +717,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
     if not any((args.trace, args.epoch_csv, args.bench, args.events,
-                args.task_records, args.timeseries)):
+                args.task_records, args.timeseries, args.capacity)):
         parser.print_usage(sys.stderr)
         print(
             "epoch_report: need at least one of --trace/--epoch-csv/"
-            "--bench/--events/--task-records/--timeseries",
+            "--bench/--events/--task-records/--timeseries/--capacity",
             file=sys.stderr,
         )
         return 2
@@ -679,8 +731,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     # rule). Resolve a --timeseries DIR to its ts/timeseries.ndjson.
     ts_path = args.timeseries
     if ts_path and not ts_path.endswith(".ndjson"):
-        import os as _os
-
         for candidate in (
             _os.path.join(ts_path, "ts", "timeseries.ndjson"),
             _os.path.join(ts_path, "timeseries.ndjson"),
@@ -713,6 +763,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ts_samples = _temporal(
         ts_path, "timeseries", "metrics", "timeseries"
     )
+    # A --capacity DIR may be the metrics spool itself; resolve to its
+    # capacity/ subdir of ledger-*.ndjson when present.
+    cap_path = args.capacity
+    if cap_path and _os.path.isdir(cap_path):
+        sub = _os.path.join(cap_path, "capacity")
+        if _os.path.isdir(sub):
+            cap_path = sub
+    capacity_records = _temporal(
+        cap_path, "ledger-", "op", "capacity ledger"
+    )
     try:
         events: List[dict] = []
         if args.trace:
@@ -730,6 +790,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             event_records=event_records,
             task_records=task_records,
             ts_samples=ts_samples,
+            capacity_records=capacity_records,
             straggler_k=args.straggler_k,
         )
     except (OSError, ValueError) as exc:
@@ -747,7 +808,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         for msg in empty_present:
             print(f"epoch_report: {msg}", file=sys.stderr)
         return 3
-    has_temporal = bool(event_records or task_records or ts_samples)
+    has_temporal = bool(
+        event_records or task_records or ts_samples or capacity_records
+    )
     if (
         not report["epochs"]
         and not _bench_fields(bench)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Exit-code-gated smoke for the temporal obs plane (ISSUE 7, CI).
+"""Exit-code-gated smoke for the temporal + decision obs planes (CI).
 
 Starts a small multi-epoch shuffle with the obs endpoint up and, while
 it is MID-FLIGHT, asserts the acceptance surface end to end:
@@ -8,7 +8,14 @@ it is MID-FLIGHT, asserts the acceptance surface end to end:
    series (the sampler is running, counter deltas became rates);
 2. ``tools/rsdl_top.py --once --json`` renders a frame from the live
    endpoint (exit 0, parseable);
-3. after completion, ``/events`` carries the full epoch lifecycle
+3. (ISSUE 9) ``/capacity`` shows live per-epoch residency from the
+   store's ledger hooks, ``/critical`` names a critical-path stage
+   from the task records, and ``/alerts`` lists the rule pack;
+4. (ISSUE 9) a deliberately-tripped custom SLO rule (threshold on a
+   gauge this script flips) FIRES mid-flight and RESOLVES after the
+   gauge clears — fire/resolve both visible on ``/alerts`` and as
+   ``alert.fired``/``alert.resolved`` events on ``/events``;
+5. after completion, ``/events`` carries the full epoch lifecycle
    (``epoch.start``/``epoch.done`` per epoch, one ``trial.done``).
 
 Run from the repo root (``run_ci_tests.sh`` obs lane)::
@@ -39,6 +46,12 @@ def main() -> int:
     # Sample fast so a short CI shuffle yields several ring entries.
     os.environ.setdefault("RSDL_TS_PERIOD_S", "0.2")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The deliberately-tripped rule (ISSUE 9): a threshold on a gauge
+    # this script flips mid-flight — rides alongside the default pack.
+    os.environ["RSDL_SLO_RULES"] = json.dumps([
+        {"name": "smoke_trip", "kind": "threshold",
+         "metric": "obs.smoke_trip", "op": ">", "value": 0},
+    ])
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo)
@@ -121,18 +134,63 @@ def main() -> int:
     )
     frame = json.loads(top_out.stdout)
     assert frame["status"] is not None
+
+    # Decision plane, mid-flight (ISSUE 9): the capacity ledger shows
+    # live residency from the store hooks, the critical analyzer names
+    # a stage, and the alert engine serves its rule pack.
+    cap = get("/capacity")
+    assert cap.get("ops", 0) > 0, "capacity ledger saw no store ops"
+    assert cap.get("epochs"), "no per-epoch residency in /capacity"
+    crit_deadline = time.time() + 60
+    crit_path = None
+    while time.time() < crit_deadline and crit_path is None:
+        crit = get("/critical")
+        crit_path = (crit.get("current") or {}).get("critical_path")
+        if crit_path is None:
+            time.sleep(0.2)
+    assert crit_path, "no critical-path verdict mid-flight"
+    alerts = get("/alerts")
+    rule_names = {r["name"] for r in alerts.get("rules", [])}
+    assert "wedged_worker" in rule_names, rule_names
+    assert "smoke_trip" in rule_names, rule_names
+
+    # Trip the custom rule, wait for it to FIRE on /alerts, clear the
+    # gauge, wait for it to RESOLVE — both transitions event-logged.
+    from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+    metrics.registry.gauge("obs.smoke_trip").set(1)
+    fired = _wait_alert_state(get, "smoke_trip", active=True)
+    assert fired, "smoke_trip never fired"
+    metrics.registry.gauge("obs.smoke_trip").set(0)
+    resolved = _wait_alert_state(get, "smoke_trip", active=False)
+    assert resolved, "smoke_trip never resolved"
+
     thread.join(timeout=180)
     assert not thread.is_alive() and not errors, errors
     kinds = get("/events")["by_kind"]
     assert kinds.get("epoch.start", 0) >= 3, kinds
     assert kinds.get("epoch.done", 0) >= 3, kinds
     assert kinds.get("trial.done") == 1, kinds
+    assert kinds.get("alert.fired", 0) >= 1, kinds
+    assert kinds.get("alert.resolved", 0) >= 1, kinds
     print(
-        "temporal-obs smoke ok: rate=%.1f rows/s, events=%s"
-        % (rate_seen["rate"], kinds)
+        "obs smoke ok: rate=%.1f rows/s, critical=%s, events=%s"
+        % (rate_seen["rate"], crit_path, kinds)
     )
     runtime.shutdown()
     return 0
+
+
+def _wait_alert_state(get, rule, active, timeout_s=60.0):
+    """Poll /alerts until ``rule`` reaches the wanted active state
+    (the sampler tick drives evaluation); True on success."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for row in get("/alerts").get("rules", []):
+            if row["name"] == rule and bool(row["active"]) == active:
+                return True
+        time.sleep(0.2)
+    return False
 
 
 if __name__ == "__main__":

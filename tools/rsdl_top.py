@@ -5,6 +5,9 @@
 (``RSDL_OBS_PORT``, :mod:`telemetry.obs_server`) and renders one
 refreshing screen: epoch-window state, per-stage throughput sparklines
 (from ``/timeseries`` rate series), queue depths, store residency,
+the capacity ledger (per-tier/per-epoch residency + host headroom,
+``/capacity``), the online critical-path verdict (``/critical``),
+active SLO alerts with their recent transitions (``/alerts``),
 recovery counters, stall attribution, the straggler/skew table, and
 the latest structured events. Pure stdlib, no curses — ANSI clear +
 redraw, so it works over any ssh session.
@@ -101,6 +104,9 @@ def collect(base: str, window_s: float) -> Dict[str, Any]:
         ("timeseries", f"/timeseries?window={window_s:g}"),
         ("events", "/events?limit=12"),
         ("stragglers", "/stragglers"),
+        ("capacity", "/capacity"),
+        ("critical", "/critical"),
+        ("alerts", "/alerts"),
     ):
         try:
             frame[key] = _get_json(base, path)
@@ -203,6 +209,79 @@ def render(frame: Dict[str, Any]) -> str:
             else "(none)"
         )
     )
+
+    # Capacity ledger: per-tier residency + host headroom (ISSUE 9).
+    cap = frame.get("capacity") or {}
+    totals = cap.get("totals") or {}
+    host = cap.get("host") or {}
+    shm_tot = (totals.get("shm") or {})
+    spill_tot = (totals.get("spill") or {})
+    frac = cap.get("shm_used_frac")
+    lines.append(
+        "capacity "
+        f"shm={_fmt_bytes(shm_tot.get('resident_bytes'))}"
+        f"({shm_tot.get('segments', 0)} seg)  "
+        f"spill={_fmt_bytes(spill_tot.get('resident_bytes'))}"
+        f"({spill_tot.get('segments', 0)} seg)  "
+        f"used={'-' if frac is None else f'{100 * frac:.1f}%'}  "
+        f"rss={_fmt_bytes(host.get('rss_bytes'))}  "
+        f"shm_free={_fmt_bytes(host.get('shm_free_bytes'))}"
+    )
+    epochs_cap = cap.get("epochs") or {}
+    if epochs_cap:
+        parts = []
+        # Numeric order, unknown-epoch bucket last — matches
+        # telemetry/capacity.py's epoch_sort_key (this tool stays
+        # stdlib-only, so the 2-line key is mirrored, not imported).
+        for e in sorted(
+            epochs_cap,
+            key=lambda x: (0, int(x)) if x.lstrip("-").isdigit()
+            else (1, 0),
+        )[-6:]:
+            tiers = epochs_cap[e]
+            res = sum(
+                c.get("resident_bytes", 0) for c in tiers.values()
+            )
+            parts.append(f"e{e}={_fmt_bytes(res)}")
+        lines.append("  resident by epoch: " + "  ".join(parts))
+
+    # Online critical path (shares of the current epoch's active time).
+    crit = frame.get("critical") or {}
+    current = crit.get("current") or {}
+    shares = current.get("sole_share") or {}
+    share_txt = "  ".join(
+        f"{stage}={100 * share:.0f}%"
+        for stage, share in sorted(
+            shares.items(), key=lambda kv: -kv[1]
+        )
+    )
+    lines.append(
+        "critical "
+        f"epoch={_fmt(current.get('epoch'))}  "
+        f"path={current.get('critical_path') or '-'}  "
+        f"run={crit.get('run_critical_path') or '-'}"
+        + (f"  [{share_txt}]" if share_txt else "")
+    )
+
+    # Alerts: active first, then the recent transitions.
+    alerts = frame.get("alerts") or {}
+    active = alerts.get("active") or []
+    lines.append(
+        "alerts   "
+        + (
+            "ACTIVE: " + ", ".join(active)
+            if active
+            else f"(none active, {len(alerts.get('rules') or [])} rules)"
+        )
+    )
+    for rec in (alerts.get("history") or [])[-4:]:
+        stamp = time.strftime(
+            "%H:%M:%S", time.localtime(float(rec.get("ts", 0.0)))
+        )
+        lines.append(
+            f"  {stamp}  {rec.get('event', '?'):<9} {rec.get('rule')}"
+            f"  value={_fmt(rec.get('value'))}"
+        )
 
     # Stragglers.
     stragglers = frame.get("stragglers") or {}
