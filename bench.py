@@ -221,6 +221,25 @@ def _attach_obs_summaries(result: dict) -> None:
             result["alerts_fired"] = fired
     except Exception:
         pass
+    # The elastic control plane (ISSUE 10): scale/evict/drain lifetime
+    # totals. sys.modules lookup, never an import — the plane only
+    # exists when RSDL_ELASTIC brought it up; its elastic.* counters/
+    # gauges already ride the registry into telemetry_final, the
+    # compact fields land here for humans (success AND error paths).
+    try:
+        import sys as _sys
+
+        elastic = _sys.modules.get(
+            "ray_shuffling_data_loader_tpu.runtime.elastic"
+        )
+        if elastic is not None:
+            summary = elastic.summary()
+            if summary:
+                result["scale_events"] = summary.get("scale_events", 0)
+                result["evicted_gb"] = summary.get("evicted_gb", 0.0)
+                result["drains"] = summary.get("drains", 0)
+    except Exception:
+        pass
 
 
 def _error_result(platform, msg: str) -> dict:

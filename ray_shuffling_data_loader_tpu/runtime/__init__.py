@@ -160,17 +160,35 @@ def _maybe_start_obs_server(ctx: RuntimeContext) -> None:
             logging.getLogger(__name__).warning(
                 "timeseries sampler bring-up failed", exc_info=True
             )
+    # The elastic control plane (ISSUE 10): autoscaler + tiered evictor
+    # + graceful drain, env-gated RSDL_ELASTIC=auto|off. Same
+    # zero-overhead contract as the planes above: env unset means no
+    # import, no control-loop thread, and no ledger transition records.
+    mode = os.environ.get("RSDL_ELASTIC", "").strip().lower()
+    if mode and mode not in ("off", "0", "false"):
+        try:
+            from .elastic import maybe_start as _elastic_maybe_start
+
+            _elastic_maybe_start(ctx)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "elastic control-loop bring-up failed", exc_info=True
+            )
 
 
 def _stop_obs_server() -> None:
-    """Stop the endpoint + timeseries sampler if (and only if) their
-    modules were ever loaded — shutdown must not import http.server
-    (or the temporal plane) on runs that never served."""
+    """Stop the endpoint + timeseries sampler + elastic control loop if
+    (and only if) their modules were ever loaded — shutdown must not
+    import http.server (or the temporal/elastic planes) on runs that
+    never served."""
     import sys as _sys
 
     for name in (
         "ray_shuffling_data_loader_tpu.telemetry.obs_server",
         "ray_shuffling_data_loader_tpu.telemetry.timeseries",
+        "ray_shuffling_data_loader_tpu.runtime.elastic",
     ):
         mod = _sys.modules.get(name)
         if mod is not None:
@@ -509,7 +527,13 @@ def spawn_actor(
             handle = ActorHandle(tuple(address), pid=None, name=name)
             ctx._owned_actors.append(handle)
             if name is not None:
-                ctx.cluster.register_named_actor(name, handle)
+                # Record the TARGET host on the name record: when that
+                # host drains/retires, the registry sweeps the name so
+                # lookups fail fast into the retry path instead of
+                # timing out against a dead address.
+                ctx.cluster.register_named_actor(
+                    name, handle, host_id=host_id
+                )
                 ctx._owned_names.append(name)
             return handle
     if ctx.cluster is not None:

@@ -108,10 +108,21 @@ class ClusterRegistry:
 
     # -- named actors (cross-host ray.get_actor analog) ----------------------
 
-    def register_actor(self, name: str, address, pid: Optional[int]) -> None:
+    def register_actor(
+        self,
+        name: str,
+        address,
+        pid: Optional[int],
+        host_id: Optional[str] = None,
+    ) -> None:
+        """``host_id`` records which cluster host the actor RUNS ON (not
+        who registered it) so :meth:`unregister_host` can sweep the names
+        a departing host strands."""
         if name in self._actors:
             raise ValueError(f"actor name {name!r} already registered")
-        self._actors[name] = {"address": list(address), "pid": pid}
+        self._actors[name] = {
+            "address": list(address), "pid": pid, "host_id": host_id,
+        }
 
     def unregister_actor(self, name: str) -> None:
         self._actors.pop(name, None)
@@ -136,7 +147,29 @@ class ClusterRegistry:
         }
 
     def unregister_host(self, host_id: str) -> None:
-        self._hosts.pop(host_id, None)
+        record = self._hosts.pop(host_id, None)
+        # Sweep actor names stranded on the departed host: a stale record
+        # would hand later lookups a dead address, turning every call into
+        # a full connect-timeout instead of a fast failure into the retry
+        # path. Match primarily by the record's host_id; records from
+        # older callers (no host_id) fall back to an exact address match
+        # against the host's registered service endpoints (matching by
+        # bare IP would over-sweep same-machine multi-session tests).
+        host_addrs = set()
+        if record is not None:
+            host_addrs = {
+                tuple(record["agent"]), tuple(record["store"]),
+            }
+        for name in [
+            n
+            for n, rec in self._actors.items()
+            if rec.get("host_id") == host_id
+            or (
+                rec.get("host_id") is None
+                and tuple(rec["address"]) in host_addrs
+            )
+        ]:
+            self._actors.pop(name, None)
 
     def hosts(self) -> Dict[str, Dict[str, Any]]:
         return dict(self._hosts)
@@ -315,6 +348,50 @@ class StoreServer:
 
     def exists(self, object_id: str) -> bool:
         return os.path.exists(self._path(object_id))
+
+    def list_segments(self, prefix: str) -> List[Tuple[str, int]]:
+        """``(object_id, nbytes)`` of every published segment this host
+        holds under the session prefix — the graceful drain's re-home
+        inventory (``runtime/elastic.py``)."""
+        out: Dict[str, int] = {}
+        for d in (self.shm_dir, self.spill_dir):
+            try:
+                names = os.listdir(d)
+            except FileNotFoundError:
+                continue
+            for name in names:
+                if name.startswith(prefix) and not name.endswith(".tmp"):
+                    try:
+                        out.setdefault(
+                            name, os.path.getsize(os.path.join(d, name))
+                        )
+                    except OSError:
+                        pass
+        return sorted(out.items())
+
+    def put_segment(self, object_id: str, data: bytes) -> bool:
+        """Adopt a re-homed segment into this host's shm dir (the drain
+        path's planned migration). Idempotent: an existing copy wins —
+        object ids are immutable content."""
+        if "/" in object_id or object_id.startswith("."):
+            raise ValueError(f"bad object id {object_id!r}")
+        path = os.path.join(self.shm_dir, object_id)
+        if os.path.exists(path):
+            return False
+        # ".tmp" suffix so a failed write is excluded from store_stats
+        # and from a later drain's list_segments inventory.
+        tmp = f"{path}.rehome-{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.rename(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            raise
+        return True
 
 
 # ---------------------------------------------------------------------------
@@ -582,6 +659,52 @@ class ClusterTaskFuture:
             self._waiters.discard(event)
 
 
+# -- elastic membership state (ISSUE 10) ------------------------------------
+# Draining/retired verdicts live at MODULE level, not on the scheduler
+# instance: ClusterClient rebuilds its scheduler on every membership
+# refresh, and an instance-held drain mark would silently resurrect a
+# draining host mid-drain. Addresses are unique per run (fresh ports),
+# so cross-run leakage is inert; tests call reset_membership().
+
+_membership_lock = threading.Lock()
+_draining_addrs: set = set()
+_retired_addrs: List[str] = []
+_RETIRED_CAP = 64
+_live_scheduler = None  # weakref.ref to the most recent scheduler
+
+
+def _addr_str(address) -> str:
+    try:
+        return ":".join(str(p) for p in address)
+    except TypeError:
+        return str(address)
+
+
+def reset_membership() -> None:
+    """Drop module-level drain/retire state (tests, run boundaries)."""
+    global _live_scheduler
+    with _membership_lock:
+        _draining_addrs.clear()
+        del _retired_addrs[:]
+        _live_scheduler = None
+
+
+def membership_section() -> Dict[str, Any]:
+    """The ``cluster`` section ``/status`` embeds: live agents (with
+    drain flags and in-flight counts), draining addresses, and recently
+    retired agents — read from the most recent scheduler via a weakref
+    so the obs server never holds one alive."""
+    sched = _live_scheduler() if _live_scheduler is not None else None
+    with _membership_lock:
+        draining = {_addr_str(a) for a in _draining_addrs}
+        retired = list(_retired_addrs)
+    agents = []
+    if sched is not None:
+        agents = sched.agent_rows()
+    return {"agents": agents, "draining": sorted(draining),
+            "retired": retired}
+
+
 class ClusterScheduler:
     """Round-robin task scheduler over every host's agent, with dead-agent
     failover.
@@ -593,6 +716,12 @@ class ClusterScheduler:
     dropped from the rotation and its task retried on a surviving host;
     ``on_agent_dead`` (set by the owning client) evicts the host from the
     membership table.
+
+    Elastic membership (ISSUE 10): :meth:`add_agent` admits a new host
+    mid-run; :meth:`retire_agent` marks one *draining* — dispatch skips
+    it while its in-flight tasks (tracked per agent) finish, the planned
+    half of the drain protocol ``runtime/elastic.py`` orchestrates;
+    :meth:`remove_agent` completes the retirement.
     """
 
     def __init__(
@@ -616,12 +745,23 @@ class ClusterScheduler:
         }
         self._idx = 0
         self._lock = threading.Lock()
+        self._inflight: Dict[Tuple, int] = {}  # address -> running calls
+        # Worker counts of agents admitted via add_agent, so their
+        # departure (remove_agent/_drop_agent) can give the width back
+        # — bootstrap agents' shares stay in width until a membership
+        # rebuild re-derives it from the registry.
+        self._added_widths: Dict[Tuple, int] = {}
         self.on_agent_dead = None  # Callable[[ActorHandle], None]
         # Blocking actor calls ride threads; in-flight tasks are bounded by
         # the executor width (queued beyond that, preserving order).
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_inflight, thread_name_prefix="cluster-sched"
         )
+        global _live_scheduler
+        import weakref
+
+        with _membership_lock:
+            _live_scheduler = weakref.ref(self)
 
     @property
     def agent_addresses(self) -> set:
@@ -629,12 +769,118 @@ class ClusterScheduler:
             return {a.address for a in self._agents}
 
     def _next_agent(self) -> ActorHandle:
+        with _membership_lock:
+            draining = set(_draining_addrs)
         with self._lock:
             if not self._agents:
                 raise ActorDiedError("every cluster host agent has died")
-            agent = self._agents[self._idx % len(self._agents)]
+            # Drain-aware dispatch: draining agents take no NEW tasks.
+            # If every agent is draining, keep placing anyway — a drain
+            # must degrade into failover, never into a submit hang.
+            candidates = [
+                a for a in self._agents if a.address not in draining
+            ] or self._agents
+            agent = candidates[self._idx % len(candidates)]
             self._idx += 1
             return agent
+
+    # -- elastic membership (ISSUE 10) ---------------------------------------
+
+    def add_agent(
+        self,
+        agent: ActorHandle,
+        store_address: Optional[Tuple] = None,
+        num_workers: int = 1,
+    ) -> bool:
+        """Admit a new host agent to the rotation mid-run (scale-up).
+        Idempotent by address; un-retires/un-drains a re-added agent."""
+        with _membership_lock:
+            _draining_addrs.discard(agent.address)
+        with self._lock:
+            if any(a.address == agent.address for a in self._agents):
+                return False
+            self._agents.append(agent)
+            if store_address is not None:
+                self._store_to_agent[tuple(store_address)] = agent
+            share = max(1, int(num_workers))
+            self._added_widths[tuple(agent.address)] = share
+            self.width += share
+        return True
+
+    def _find_agent(self, address) -> Optional[ActorHandle]:
+        address = tuple(address)
+        with self._lock:
+            for a in self._agents:
+                if tuple(a.address) == address:
+                    return a
+        return None
+
+    def retire_agent(self, agent_or_address) -> Optional[ActorHandle]:
+        """Mark an agent DRAINING: dispatch stops placing new tasks on
+        it while its in-flight tasks finish. This is the first step of
+        the planned-migration path (``runtime/elastic.py`` waits out the
+        in-flight window, re-homes store segments, then calls
+        :meth:`remove_agent` — or falls back to :meth:`_drop_agent`'s
+        failover machinery on a blown deadline)."""
+        address = tuple(getattr(agent_or_address, "address",
+                                agent_or_address))
+        with _membership_lock:
+            _draining_addrs.add(address)
+        return self._find_agent(address)
+
+    def remove_agent(self, agent_or_address) -> bool:
+        """Complete a retirement: drop the agent from the rotation and
+        record it retired. Unlike :meth:`_drop_agent` this is the
+        *planned* exit — no eviction counter, no task failover."""
+        address = tuple(getattr(agent_or_address, "address",
+                                agent_or_address))
+        with self._lock:
+            before = len(self._agents)
+            self._agents = [
+                a for a in self._agents if tuple(a.address) != address
+            ]
+            removed = len(self._agents) != before
+            if removed:
+                self.width = max(
+                    1, self.width - self._added_widths.pop(address, 0)
+                )
+        with _membership_lock:
+            _draining_addrs.discard(address)
+            if removed:
+                _retired_addrs.append(_addr_str(address))
+                del _retired_addrs[:-_RETIRED_CAP]
+        return removed
+
+    def in_flight_on(self, agent_or_address) -> int:
+        """Tasks currently running on one agent — the drain wait's
+        signal."""
+        address = tuple(getattr(agent_or_address, "address",
+                                agent_or_address))
+        with self._lock:
+            return self._inflight.get(address, 0)
+
+    def _inflight_adjust(self, address, delta: int) -> None:
+        with self._lock:
+            count = self._inflight.get(address, 0) + delta
+            if count > 0:
+                self._inflight[address] = count
+            else:
+                self._inflight.pop(address, None)
+
+    def agent_rows(self) -> List[Dict[str, Any]]:
+        """Per-agent membership rows for the ``/status`` cluster
+        section."""
+        with _membership_lock:
+            draining = set(_draining_addrs)
+        with self._lock:
+            return [
+                {
+                    "address": _addr_str(a.address),
+                    "draining": a.address in draining,
+                    "in_flight": self._inflight.get(a.address, 0),
+                }
+                for a in self._agents
+            ]
 
     def _drop_agent(self, agent: ActorHandle) -> None:
         with self._lock:
@@ -643,6 +889,14 @@ class ClusterScheduler:
                 a for a in self._agents if a.address != agent.address
             ]
             removed = len(self._agents) != before
+            if removed:
+                self.width = max(
+                    1,
+                    self.width
+                    - self._added_widths.pop(tuple(agent.address), 0),
+                )
+        with _membership_lock:
+            _draining_addrs.discard(agent.address)
         if not removed:
             # Concurrent submits can race to drop the same dead agent;
             # only the actual removal counts an eviction and fires the
@@ -666,6 +920,9 @@ class ClusterScheduler:
         both the rotation and the membership table. Before dropping,
         confirm with a ping on a fresh connection; an alive agent gets
         the call retried instead of its host evicted."""
+        # Per-agent in-flight accounting (covers the retry attempt too):
+        # the drain path waits on this count before retiring the host.
+        self._inflight_adjust(agent.address, +1)
         try:
             return True, agent.call("submit", fn, args, kwargs)
         except ActorDiedError:
@@ -692,6 +949,8 @@ class ClusterScheduler:
                     break
             self._drop_agent(agent)
             return False, None
+        finally:
+            self._inflight_adjust(agent.address, -1)
 
     def _run(self, fn, args, kwargs, trace_ctx=None):
         # Task bodies are idempotent pure functions over the store (map/
@@ -750,6 +1009,11 @@ class ClusterScheduler:
         agent = self._store_to_agent.get(best)
         if agent is None:
             return None
+        with _membership_lock:
+            if agent.address in _draining_addrs:
+                # A draining host may still OWN the bytes, but placement
+                # there would extend its in-flight window indefinitely.
+                return None
         with self._lock:
             live = {a.address for a in self._agents}
         return agent if agent.address in live else None
@@ -965,10 +1229,21 @@ class ClusterClient:
             self._scheduler_read_ts = 0.0
         return self.scheduler()
 
-    def register_named_actor(self, name: str, handle: ActorHandle) -> None:
+    def register_named_actor(
+        self,
+        name: str,
+        handle: ActorHandle,
+        host_id: Optional[str] = None,
+    ) -> None:
+        """``host_id`` names the cluster host the actor RUNS ON (the
+        placement target for remote spawns, this host otherwise) so the
+        registry can sweep the name when that host retires."""
+        if host_id is None:
+            host_id = self.host_id
         try:
             self.registry.call(
-                "register_actor", name, list(handle.address), handle.pid
+                "register_actor", name, list(handle.address), handle.pid,
+                host_id,
             )
         except ValueError:
             # Name taken. If the holder is dead (crashed run that never
@@ -979,7 +1254,8 @@ class ClusterClient:
                 raise
             self.registry.call("unregister_actor", name)
             self.registry.call(
-                "register_actor", name, list(handle.address), handle.pid
+                "register_actor", name, list(handle.address), handle.pid,
+                host_id,
             )
 
     def unregister_named_actor(self, name: str) -> None:

@@ -1225,6 +1225,144 @@ class ObjectStore:
                     pass
                 _ledger_note("delete", ref.object_id)
 
+    # -- tiered movement (ISSUE 10: the elastic evictor's actuators) --------
+
+    def _segment_links(self, ids) -> Dict[str, str]:
+        """``{name: path}`` for every link name of one segment that is
+        currently resolvable (shm first, then spill)."""
+        if isinstance(ids, str):
+            ids = [ids]
+        out: Dict[str, str] = {}
+        for name in ids:
+            path = self._find_segment(name)
+            if path is not None:
+                out[name] = path
+        return out
+
+    def _move_tier(self, ids, dst_dir: str, tier: str) -> int:
+        """Move ALL link names of one physical segment to ``dst_dir``
+        atomically-per-link: copy the inode once, hardlink the remaining
+        names against the copy (same filesystem), rename over nothing,
+        then unlink the sources. Readers racing the move either still
+        map the old inode (their mmap survives the unlink) or re-resolve
+        via ``_find_segment``, which checks both tiers. Returns the
+        bytes moved (0 if the segment vanished or already lives there).
+        """
+        links = self._segment_links(ids)
+        if not links:
+            return 0
+        first = next(iter(links.values()))
+        if os.path.dirname(first) == dst_dir:
+            return 0  # already on the target tier
+        os.makedirs(dst_dir, exist_ok=True)
+        names = list(links)
+        primary = names[0]
+        # ".tmp" suffix: a crashed move must not leave a file that
+        # store_stats or a drain's list_segments would mistake for a
+        # published segment.
+        tmp = os.path.join(
+            dst_dir,
+            f"{primary}.move-{os.getpid()}-{secrets.token_hex(4)}.tmp",
+        )
+        try:
+            nbytes = os.path.getsize(links[primary])
+            with open(links[primary], "rb") as src, open(tmp, "wb") as dst:
+                import shutil as _shutil
+
+                _shutil.copyfileobj(src, dst, length=1 << 20)
+            os.rename(tmp, os.path.join(dst_dir, primary))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            return 0
+        for name in names[1:]:
+            try:
+                os.link(
+                    os.path.join(dst_dir, primary),
+                    os.path.join(dst_dir, name),
+                )
+            except FileExistsError:
+                pass
+            except OSError:
+                # Partial link failure: roll the whole move back rather
+                # than strand some names on each tier.
+                for done in names[: names.index(name) + 1]:
+                    try:
+                        os.unlink(os.path.join(dst_dir, done))
+                    except FileNotFoundError:
+                        pass
+                return 0
+        for name, path in links.items():
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        # Keep the cached shm-residency estimate honest between scans:
+        # a demotion frees budgeted shm immediately, a promotion fills
+        # it (without this, a burst of promotes inside the scan TTL
+        # would each see the pre-burst residency and over-admit).
+        if tier == "spill":
+            self._shm_scan_adjust -= nbytes
+        else:
+            self._shm_scan_adjust += nbytes
+        _ledger_note("transition", primary, nbytes, tier)
+        _metrics.safe_inc(
+            "store.tier_moved_bytes_total", float(nbytes), tier=tier
+        )
+        return nbytes
+
+    def demote(self, ids) -> int:
+        """Demote one segment (every hardlinked name in ``ids``) from
+        shm to the disk spill tier — the evictor's shm-pressure
+        actuator. The segment stays readable in place (``_find_segment``
+        and the StoreServer probe both tiers); only the tier moves.
+        Emits the capacity-ledger ``transition`` op. Returns bytes
+        moved."""
+        return self._move_tier(ids, self.spill_dir, "spill")
+
+    def promote(self, ids) -> int:
+        """Promote a spilled segment back to shm — only when the move
+        fits the session budget (a promote must never trigger the very
+        pressure the evictor exists to relieve). Returns bytes moved."""
+        links = self._segment_links(ids)
+        if not links:
+            return 0
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(next(iter(links.values())))
+        except OSError:
+            return 0
+        if (
+            self.capacity_bytes is not None
+            and nbytes + self._shm_session_bytes() > self.capacity_bytes
+        ):
+            return 0
+        return self._move_tier(ids, self.shm_dir, "shm")
+
+    def drop_segments(self, ids) -> int:
+        """Unconditionally drop a segment (every link name) from
+        whichever tier holds it — the evictor's last rung. Readers that
+        later miss it raise :class:`ObjectLostError`, which the shuffle
+        driver's lineage machinery re-materializes (PR 3). Returns
+        bytes dropped."""
+        links = self._segment_links(ids)
+        if not links:
+            return 0
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(next(iter(links.values())))
+        except OSError:
+            pass
+        for name, path in links.items():
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            _ledger_note("delete", name)
+        return nbytes
+
     def drop_cache(self, refs) -> None:
         """Release only this host's fetched copy of foreign refs — the
         authoritative segments survive, so a task calling this remains

@@ -271,17 +271,28 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
 
 
 class WorkerPool:
-    """Fixed pool of spawned worker processes with a shared task queue."""
+    """Pool of spawned worker processes with a shared task queue.
+
+    Sized at construction, but elastic (ISSUE 10): :meth:`add_workers`
+    spawns more processes onto the shared queue mid-run, and
+    :meth:`retire_workers` retires workers *gracefully* — a retiring
+    worker finishes its current task, takes no more (the pill is just
+    the next queue item it dequeues), and exits cleanly; the watchdog
+    reaps clean exits without failing anyone's futures.
+    """
 
     def __init__(self, num_workers: int, env: Optional[Dict[str, str]] = None):
         self.num_workers = num_workers
         self.width = num_workers  # scheduler-duck-typed capacity surface
         ctx = mp.get_context("spawn")
+        self._mp_ctx = ctx
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()
         env = dict(env or {})
         # Workers are CPU-side shuffle executors; keep them off the TPU.
         env.setdefault("JAX_PLATFORMS", "cpu")
+        self._env = env
+        self._procs_lock = threading.Lock()
         self._procs = [
             ctx.Process(
                 target=_worker_main,
@@ -350,8 +361,22 @@ class WorkerPool:
 
         while not self._closed:
             _time.sleep(0.5)
+            with self._procs_lock:
+                procs = list(self._procs)
+            # Reap gracefully-retired workers (clean exit after a retire
+            # pill): membership shrinks without failing any futures.
+            clean = [
+                p for p in procs if not p.is_alive() and not p.exitcode
+            ]
+            if clean and not self._closed:
+                with self._procs_lock:
+                    for p in clean:
+                        if p in self._procs:
+                            p.join(timeout=0.1)
+                            self._procs.remove(p)
+                    self.num_workers = self.width = len(self._procs)
             dead = [
-                p.pid for p in self._procs if not p.is_alive() and p.exitcode
+                p.pid for p in procs if not p.is_alive() and p.exitcode
             ]
             if not dead:
                 continue
@@ -373,6 +398,69 @@ class WorkerPool:
                 fut._fulfill(
                     None, f"worker process {pid} died while running this task"
                 )
+
+    # -- elastic membership (ISSUE 10) ---------------------------------------
+
+    def add_workers(self, n: int) -> int:
+        """Spawn ``n`` more workers onto the shared task queue (the
+        single-host scale-up actuator). Returns the new pool size."""
+        if self._closed or n <= 0:
+            return self.num_workers
+        procs = [
+            self._mp_ctx.Process(
+                target=_worker_main,
+                args=(self._task_q, self._result_q, self._env),
+                daemon=True,
+            )
+            for _ in range(int(n))
+        ]
+        for p in procs:
+            p.start()
+        with self._procs_lock:
+            self._procs.extend(procs)
+            self.num_workers = self.width = len(self._procs)
+            return self.num_workers
+
+    def retire_workers(
+        self, n: int, deadline_s: float = 10.0
+    ) -> List[int]:
+        """Gracefully retire ``n`` workers (never below one): each pill
+        is consumed by SOME worker as its next queue item — it finishes
+        its current task, drains nothing further, and exits cleanly.
+        Pills queue behind already-submitted tasks, so retirement is
+        drain-aware by construction: capacity drops only after the
+        backlog ahead of the pill is done. Waits up to ``deadline_s``
+        for the exits; stragglers are reaped later by the watchdog (a
+        busy worker holding a long task is exactly who we must not
+        kill). Returns the pids that exited within the deadline."""
+        with self._procs_lock:
+            before = {p.pid for p in self._procs}
+            n = min(int(n), len(before) - 1)
+        if self._closed or n <= 0:
+            return []
+        for _ in range(n):
+            self._task_q.put(None)
+        # Membership shrink is the truth, not who reaped: the watchdog's
+        # clean-exit reaper races this loop, and a retiree it collects
+        # first must still count toward n (pid-set difference), or the
+        # call would spin out its whole deadline on a success.
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        while True:
+            with self._procs_lock:
+                done = [
+                    p
+                    for p in self._procs
+                    if not p.is_alive() and not p.exitcode
+                ]
+                for p in done:
+                    p.join(timeout=0.1)
+                    self._procs.remove(p)
+                self.num_workers = self.width = len(self._procs)
+                current = {p.pid for p in self._procs}
+            retired = sorted(before - current)
+            if len(retired) >= n or time.monotonic() >= deadline:
+                return retired
+            time.sleep(0.05)
 
     def in_flight(self) -> List[Dict[str, Any]]:
         """The live in-flight task view the straggler detector folds:
@@ -433,16 +521,18 @@ class WorkerPool:
                 stragglers.unregister_inflight_provider(self._inflight_name)
             except Exception:
                 pass
-        for _ in self._procs:
+        with self._procs_lock:
+            procs = list(self._procs)
+        for _ in procs:
             try:
                 self._task_q.put(None)
             except Exception:
                 pass
-        for p in self._procs:
+        for p in procs:
             p.join(timeout=2)
             if p.is_alive():
                 p.terminate()
-        for p in self._procs:
+        for p in procs:
             # SIGKILL stragglers: a worker that survives SIGTERM (e.g. one
             # wedged mid-syscall) would otherwise hang the interpreter's
             # multiprocessing atexit join forever.

@@ -123,6 +123,17 @@ def live_status() -> dict:
     return out
 
 
+def protected_epochs() -> set:
+    """The eviction fence (ISSUE 10): epochs still inside the in-flight
+    window — admitted but not yet fully delivered/consumed — whose
+    segments the tiered evictor must not demote or drop. Derived from
+    the same live tracker ``/status`` serves, so "in flight" here and
+    on the obs plane can never disagree. Between trials (or before the
+    first) the set is empty: everything still resident is cold by
+    definition and lineage-recoverable."""
+    return set(live_status().get("in_flight_epochs") or [])
+
+
 def _status_begin_trial(
     num_epochs: int,
     num_files: int,

@@ -65,9 +65,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 TIERS = ("shm", "spill")
 
-# Ledger op vocabulary (docs/observability.md). "transition" has no
-# store emitter yet — it is the evictor's op (ROADMAP 5); the fold and
-# tests support it so the consumer exists before the producer.
+# Ledger op vocabulary (docs/observability.md). "transition" is emitted
+# by the store's tier movers (``ObjectStore.demote``/``promote``) on
+# behalf of the elastic evictor and the graceful-drain re-home path
+# (ISSUE 10).
 OPS = ("create", "fetch", "delete", "transition", "cleanup")
 
 _UNKNOWN_EPOCH = "-"
@@ -352,7 +353,24 @@ def _with_ages(folded: Dict[str, Any], now: float) -> Dict[str, Any]:
     return out
 
 
-def _fold(records: List[dict]) -> Dict[str, Any]:
+def live_segments(
+    records: Optional[List[dict]] = None,
+) -> List[Dict[str, Any]]:
+    """Every currently-live segment with its link ids, bytes, tier,
+    epoch key, and creation ts — the tiered evictor's candidate list
+    (``runtime/elastic.py``). Sorted oldest-first. Epochs use the same
+    ``"-"``-keyed strings as the fold."""
+    records = load_records() if records is None else records
+    folded = _fold(
+        sorted(records, key=lambda r: float(r.get("ts", 0.0))),
+        want_segments=True,
+    )
+    return folded["segments"]
+
+
+def _fold(
+    records: List[dict], want_segments: bool = False
+) -> Dict[str, Any]:
 
     segs: Dict[str, _Seg] = {}  # live segments by primary id
     by_link: Dict[str, str] = {}  # link id -> primary id
@@ -470,12 +488,28 @@ def _fold(records: List[dict]) -> Dict[str, Any]:
         if tier in totals:
             for field in totals[tier]:
                 totals[tier][field] += cell.get(field, 0)
-    return {
+    out: Dict[str, Any] = {
         "epochs": epochs,
         "totals": totals,
         "live_segments": len(segs),
         "ops": len(records),
     }
+    if want_segments:
+        out["segments"] = sorted(
+            (
+                {
+                    "id": primary,
+                    "ids": sorted(seg.links),
+                    "nbytes": seg.nbytes,
+                    "tier": seg.tier,
+                    "epoch": seg.epoch,
+                    "ts": seg.ts,
+                }
+                for primary, seg in segs.items()
+            ),
+            key=lambda s: s["ts"],
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -345,6 +345,23 @@ def _status_body() -> dict:
             status[name] = fn()
         except Exception as exc:
             status[name] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    # Cluster membership (ISSUE 10): live agents with drain flags and
+    # in-flight counts, plus recently retired hosts — via sys.modules so
+    # a single-host server never imports the cluster plane.
+    import sys as _sys
+
+    cluster_mod = _sys.modules.get(
+        "ray_shuffling_data_loader_tpu.runtime.cluster"
+    )
+    if cluster_mod is not None:
+        try:
+            status["cluster"] = cluster_mod.membership_section()
+        except Exception as exc:
+            status["cluster"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:200]
+            }
+    else:
+        status["cluster"] = {"agents": [], "draining": [], "retired": []}
     return status
 
 

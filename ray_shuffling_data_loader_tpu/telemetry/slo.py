@@ -135,6 +135,30 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "for_s": 0.0,
         "severity": "page",
     },
+    {
+        # The elastic plane's shm headroom (1 - used fraction of the
+        # store budget, published by the control loop / evictor each
+        # tick — runtime/elastic.py) is nearly exhausted: the evictor
+        # is losing to the ingest rate, the next segments spill.
+        "name": "headroom_low",
+        "kind": "threshold",
+        "metric": "elastic.shm_headroom_frac",
+        "op": "<", "value": 0.1,
+        "for_s": 0.0,
+        "severity": "warn",
+    },
+    {
+        # A graceful drain (planned migration) has been waiting out a
+        # host's in-flight window longer than any healthy drain should:
+        # the host is likely wedged and the drain is about to (or
+        # should) degrade into the failover backstop.
+        "name": "drain_stuck",
+        "kind": "threshold",
+        "metric": "elastic.drain_age_seconds",
+        "op": ">", "value": 30.0,
+        "for_s": 0.0,
+        "severity": "page",
+    },
 ]
 
 _HISTORY_CAP = 64

@@ -101,6 +101,18 @@ if [ "$tier" != "slow" ]; then
       tests/test_device_direct_audit.py tests/test_jax_dataset.py \
       tests/test_dataset.py tests/test_shuffle.py \
       -m "not slow" -q -x
+  # Elastic lane (ISSUE 10): autoscaler + tiered store eviction +
+  # graceful drain, chaos-proven. The membership/drain/evict tests run
+  # under a low-prob ambient fault schedule (same xN-capped convention
+  # as the chaos lane) with audit strict + metrics on; the acceptance
+  # test — scale-up, a crash mid-drain degrading into the failover
+  # backstop, a shm→spill→drop eviction re-materialized from lineage,
+  # audit ok=true and ledger residency zero at cleanup — arms its own
+  # deterministic schedule on top. Exit-code gated.
+  RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=555 \
+    python -m pytest tests/test_elastic.py -m "not slow" -q -x
   # Temporal + decision obs smoke (ISSUES 7/9), exit-code gated:
   # against a MID-FLIGHT shuffle with the obs endpoint up, /timeseries
   # must serve a non-empty rate series, `rsdl_top --once --json` must

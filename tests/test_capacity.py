@@ -177,6 +177,105 @@ def test_store_hooks_attribute_epoch_and_tier(cap_env, tmp_path):
     assert capacity.ledger()["epochs"]["8"]["shm"]["resident_bytes"] == 0
 
 
+def test_store_demote_promote_drop_real_lifecycle(cap_env, tmp_path):
+    """ISSUE 10 satellite: the ``transition`` op now has a real
+    producer — fold an actual shm→spill→(promote)→spill→drop lifecycle
+    driven through ``ObjectStore.demote``/``promote``/``drop_segments``
+    (not synthetic records), asserting per-tier residency and high
+    watermarks stay exact at every step, including a hardlink-sliced
+    segment whose links all move together."""
+    os.environ["RSDL_SHM_DIR"] = str(tmp_path / "shm")
+    os.environ["RSDL_SPILL_DIR"] = str(tmp_path / "spill")
+    store = store_mod.ObjectStore("tiersess")
+    with trace.context(epoch=3):
+        ref = store.put_columns({"a": np.arange(256, dtype=np.int32)})
+        pending = store.create_columns({"b": ((64,), np.int32)})
+        sliced = pending.publish_slices([(0, 32), (32, 64)])
+    nbytes = ref.nbytes
+    sliced_bytes = sliced[0].nbytes
+    folded = capacity.ledger()
+    cell = folded["epochs"]["3"]["shm"]
+    assert cell["resident_bytes"] == nbytes + sliced_bytes
+    shm_hwm = cell["hwm_bytes"]
+    assert shm_hwm == nbytes + sliced_bytes
+
+    # Demote the plain segment: bytes MOVE (shm frees nothing), the
+    # file is physically on the spill tier, and reads keep working.
+    assert store.demote(ref.object_id) == nbytes
+    folded = capacity.ledger()
+    cell = folded["epochs"]["3"]["shm"]
+    assert cell["resident_bytes"] == sliced_bytes
+    assert cell["freed_bytes"] == 0  # moved, not freed
+    assert cell["hwm_bytes"] == shm_hwm  # watermark remembers the peak
+    spill = folded["epochs"]["3"]["spill"]
+    assert spill["resident_bytes"] == nbytes
+    assert spill["hwm_bytes"] == nbytes
+    assert store.tier_of(store._find_segment(ref.object_id)) == "spill"
+    assert store.get_columns(ref)["a"][11] == 11
+
+    # Promote it back: residency returns to shm, spill zeroes, and the
+    # spill watermark remembers ITS peak.
+    assert store.promote(ref.object_id) == nbytes
+    folded = capacity.ledger()
+    assert folded["epochs"]["3"]["shm"]["resident_bytes"] == (
+        nbytes + sliced_bytes
+    )
+    assert folded["epochs"]["3"]["spill"]["resident_bytes"] == 0
+    assert folded["epochs"]["3"]["spill"]["hwm_bytes"] == nbytes
+
+    # Demote the hardlink-sliced segment: every window ref must keep
+    # resolving (all links moved together), and the fold still counts
+    # the inode once.
+    link_ids = [r.object_id for r in sliced]
+    assert store.demote(link_ids) == sliced_bytes
+    for r in sliced:
+        assert store.get_columns(r).num_rows == 32
+    folded = capacity.ledger()
+    assert folded["epochs"]["3"]["spill"]["resident_bytes"] == (
+        sliced_bytes
+    )
+    assert folded["epochs"]["3"]["spill"]["segments"] == 1
+
+    # Drop rungs: demote the plain one again, then drop both. The
+    # residency reconciles to zero per tier; re-reads raise
+    # ObjectLostError (the lineage-recovery trigger).
+    assert store.demote(ref.object_id) == nbytes
+    assert store.drop_segments(ref.object_id) == nbytes
+    assert store.drop_segments(link_ids) == sliced_bytes
+    folded = capacity.ledger()
+    assert folded["epochs"]["3"]["shm"]["resident_bytes"] == 0
+    assert folded["epochs"]["3"]["spill"]["resident_bytes"] == 0
+    assert folded["live_segments"] == 0
+    with pytest.raises(store_mod.ObjectLostError):
+        store.get_columns(ref)
+    # The evictor's candidate feed agrees: nothing live remains.
+    assert capacity.live_segments() == []
+    store.cleanup()
+
+
+def test_live_segments_feed(cap_env):
+    """``capacity.live_segments`` (the evictor's candidate list): link
+    ids, tier, epoch key, oldest-first order, transition-aware."""
+    records = [
+        _rec("create", "b", 2.0, nbytes=200, tier="shm", epoch=1),
+        _rec("create", "a", 1.0, nbytes=100, tier="shm", epoch=0,
+             ids=["a1", "a2"]),
+        _rec("transition", "a1", 3.0, tier="spill"),
+        _rec("create", "c", 4.0, nbytes=50, tier="shm"),  # unknown epoch
+    ]
+    segs = capacity.live_segments(records)
+    assert [s["id"] for s in segs] == ["a", "b", "c"]
+    assert segs[0]["ids"] == ["a1", "a2"]
+    assert segs[0]["tier"] == "spill"  # the transition moved it
+    assert segs[0]["epoch"] == "0"
+    assert segs[1]["tier"] == "shm" and segs[1]["epoch"] == "1"
+    assert segs[2]["epoch"] == "-"
+    records.append(_rec("delete", "a1", 5.0))
+    records.append(_rec("delete", "a2", 6.0))
+    segs = capacity.live_segments(records)
+    assert [s["id"] for s in segs] == ["b", "c"]
+
+
 def test_spill_volume_exact_under_rate_limit(cap_env, monkeypatch):
     """The spill satellite: the 1/5s event rate limit must not drop
     byte totals — every call lands on store.spill_bytes_total, and the

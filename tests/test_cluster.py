@@ -265,6 +265,44 @@ def test_dead_host_failover(tmp_path):
     )
 
 
+def test_unregister_host_sweeps_actor_names():
+    """ISSUE 10 satellite: a host's departure (drain or eviction) must
+    sweep the actor-name records pointing at it — a stale record would
+    hand every later lookup a dead address that times out per call
+    instead of failing fast into the retry path. Records carrying the
+    departed host_id are swept; legacy records (no host_id) are swept
+    only on an exact service-address match; other hosts' names
+    survive."""
+    from ray_shuffling_data_loader_tpu.runtime.cluster import (
+        ClusterRegistry,
+    )
+
+    reg = ClusterRegistry()
+    reg.register_host(
+        "h1", ("tcp", "10.0.0.1", 700), ("tcp", "10.0.0.1", 701), 2
+    )
+    reg.register_host(
+        "h2", ("tcp", "10.0.0.2", 700), ("tcp", "10.0.0.2", 701), 2
+    )
+    # An actor placed ON h1 (host_id recorded), one on h2, one legacy
+    # record whose address IS h1's agent endpoint, and one legacy
+    # record on h1's IP but an unrelated port (a different session on
+    # the same machine — must NOT be swept).
+    reg.register_actor("q1", ("tcp", "10.0.0.1", 710), 11, host_id="h1")
+    reg.register_actor("q2", ("tcp", "10.0.0.2", 710), 12, host_id="h2")
+    reg.register_actor("legacy-agent", ("tcp", "10.0.0.1", 700), 13)
+    reg.register_actor("same-ip-other", ("tcp", "10.0.0.1", 999), 14)
+
+    reg.unregister_host("h1")
+    assert reg.lookup_actor("q1") is None
+    assert reg.lookup_actor("legacy-agent") is None
+    assert reg.lookup_actor("q2") is not None
+    assert reg.lookup_actor("same-ip-other") is not None
+    assert sorted(reg.hosts()) == ["h2"]
+    # Unregistering an unknown host is a no-op, not an error.
+    reg.unregister_host("h1")
+
+
 def test_cluster_scheduler_locality_choice(monkeypatch):
     """Unit: the scheduler places a task on the host owning the most input
     rows; no owners / unknown owner / disabled env -> no preference."""

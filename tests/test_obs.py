@@ -345,6 +345,42 @@ def test_decision_endpoints(metrics_spool):
         slo.reset()
 
 
+def test_status_cluster_membership_section(metrics_spool):
+    """ISSUE 10 satellite: /status carries a ``cluster`` membership
+    section — live agents with drain flags and in-flight counts,
+    draining addresses, recently retired hosts — driven by the
+    scheduler's elastic membership APIs."""
+    from ray_shuffling_data_loader_tpu.runtime import (
+        cluster as cluster_mod,
+    )
+
+    class FakeAgent:
+        def __init__(self, name):
+            self.address = ("tcp", name, 1)
+
+    cluster_mod.reset_membership()
+    sched = cluster_mod.ClusterScheduler(
+        [FakeAgent("a"), FakeAgent("b"), FakeAgent("c")]
+    )
+    port = obs_server.start(0)
+    try:
+        sched.retire_agent(("tcp", "b", 1))
+        sched.remove_agent(("tcp", "c", 1))
+        _, body = _get(f"http://127.0.0.1:{port}/status")
+        section = json.loads(body)["cluster"]
+        rows = {r["address"]: r for r in section["agents"]}
+        assert set(rows) == {"tcp:a:1", "tcp:b:1"}
+        assert rows["tcp:a:1"]["draining"] is False
+        assert rows["tcp:b:1"]["draining"] is True
+        assert rows["tcp:a:1"]["in_flight"] == 0
+        assert section["draining"] == ["tcp:b:1"]
+        assert section["retired"] == ["tcp:c:1"]
+    finally:
+        obs_server.stop()
+        sched.shutdown()
+        cluster_mod.reset_membership()
+
+
 def test_no_server_without_env(metrics_spool):
     ctx = runtime.init(num_workers=1)
     try:

@@ -237,6 +237,79 @@ def test_prom_alias_accepted(slo_env):
     assert slo.evaluate(now=100.0)["active"] == ["alias"]
 
 
+def test_headroom_low_and_drain_stuck_fire_and_resolve(slo_env, tmp_path):
+    """ISSUE 10 satellite: the new elastic default rules. A store
+    filled past its budget drives ``elastic.shm_headroom_frac`` under
+    the rule floor — ``headroom_low`` FIRES; a forced demotion relieves
+    the pressure and it RESOLVES. A drain whose in-flight wait has aged
+    past the deadline drives ``elastic.drain_age_seconds`` over the
+    rule bound — ``drain_stuck`` fires, and resolves when the drain
+    completes (the controller zeroes the gauge)."""
+    import types
+
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.runtime import (
+        elastic as elastic_mod,
+    )
+    from ray_shuffling_data_loader_tpu.runtime.store import ObjectStore
+    from ray_shuffling_data_loader_tpu.telemetry import capacity, trace
+
+    os.environ["RSDL_SHM_DIR"] = str(tmp_path / "shm")
+    os.environ["RSDL_SPILL_DIR"] = str(tmp_path / "spill")
+    os.environ["RSDL_STORE_CAPACITY_BYTES"] = "16384"
+    capacity.reset(clear_spool=True)
+    store = ObjectStore("slostore")
+    ctx = types.SimpleNamespace(
+        store=store, cluster=None, session=store.session,
+        scheduler=types.SimpleNamespace(width=1), runtime_dir=None,
+    )
+    ctl = elastic_mod.ElasticController(ctx)
+    try:
+        # Nearly fill the 16 KiB budget: headroom < 0.1 -> fires.
+        with trace.context(epoch=0):
+            ref = store.put_columns(
+                {"a": np.zeros(3800, np.int32)}  # ~15.2 KiB segment
+            )
+        ctl.publish_gauges()
+        out = slo.evaluate(now=100.0)
+        assert "headroom_low" in out["active"]
+        assert metrics.registry.snapshot()[
+            "alert.active{rule=headroom_low}"
+        ] == 1.0
+        # Forced demotion moves the bytes to the spill tier; headroom
+        # recovers and the alert resolves.
+        stats = ctl.evict_once(force=True)
+        assert stats["demoted"] == 1
+        ctl.publish_gauges()
+        out = slo.evaluate(now=101.0)
+        assert "headroom_low" not in out["active"]
+        resolved = [r for r in _alert_events("alert.resolved")
+                    if r.get("rule") == "headroom_low"]
+        assert resolved
+
+        # drain_stuck: an active drain aged past the rule bound (the
+        # gauge the drain wait-loop maintains) fires; completion (the
+        # controller clears its started-set and republishes) resolves.
+        ctl._drain_started[("tcp", "w", 1)] = time.monotonic() - 60.0
+        ctl.publish_gauges()
+        out = slo.evaluate(now=102.0)
+        assert "drain_stuck" in out["active"]
+        ctl._drain_started.clear()
+        ctl.publish_gauges()
+        out = slo.evaluate(now=103.0)
+        assert "drain_stuck" not in out["active"]
+        resolved = [r for r in _alert_events("alert.resolved")
+                    if r.get("rule") == "drain_stuck"]
+        assert resolved
+    finally:
+        store.cleanup()
+        capacity.reset(clear_spool=True)
+        for k in ("RSDL_SHM_DIR", "RSDL_SPILL_DIR",
+                  "RSDL_STORE_CAPACITY_BYTES"):
+            os.environ.pop(k, None)
+
+
 # ---------------------------------------------------------------------------
 # Chaos integration: a wedge fault fires (and resolves) the default
 # wedged_worker alert (ISSUE 9 acceptance)
