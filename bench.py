@@ -221,6 +221,28 @@ def _attach_obs_summaries(result: dict) -> None:
             result["alerts_fired"] = fired
     except Exception:
         pass
+    # The decode plane (ISSUE 11): row-group + pushdown counters from
+    # the cluster-wide aggregate (worker decode tasks spool them at
+    # task-done), compacted for humans next to telemetry_final.
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import (
+            export as _export,
+        )
+
+        flat = _export.aggregate()
+        decode = {
+            "rowgroups": int(flat.get("shuffle.decode_rowgroups", 0)),
+            "rows_pruned": int(
+                flat.get("shuffle.decode_rows_pruned", 0)
+            ),
+            "bytes_pruned": int(
+                flat.get("shuffle.decode_bytes_pruned", 0)
+            ),
+        }
+        if any(decode.values()):
+            result["decode"] = decode
+    except Exception:
+        pass
     # The elastic control plane (ISSUE 10): scale/evict/drain lifetime
     # totals. sys.modules lookup, never an import — the plane only
     # exists when RSDL_ELASTIC brought it up; its elastic.* counters/

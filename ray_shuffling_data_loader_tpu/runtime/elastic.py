@@ -82,7 +82,9 @@ ENV_EVICT_DROP_AGE_S = "RSDL_EVICT_DROP_AGE_S"
 
 # The live-verdict stage names that mean "the shuffle plane is the
 # bottleneck" (critical.STAGE_ORDER vocabulary minus the consumer side).
-SHUFFLE_STAGES = ("map", "plan", "reduce", "gather-reduce")
+SHUFFLE_STAGES = (
+    "map", "plan", "reduce", "gather-reduce", "selective-reduce"
+)
 
 _UNKNOWN_EPOCH = "-"
 
@@ -176,14 +178,18 @@ class ElasticController:
         driving a bare store must not read tmpfs-relative numbers)."""
         budget = getattr(self._ctx.store, "capacity_bytes", None)
         if budget:
-            resident = (
-                view.get("totals", {})
-                .get("shm", {})
-                .get("resident_bytes", 0)
-            )
-            return resident / budget
+            return self._shm_resident(view) / budget
         frac = view.get("shm_used_frac")
         return None if frac is None else float(frac)
+
+    @staticmethod
+    def _shm_resident(view: Dict[str, Any]) -> int:
+        """Bytes physically occupying shm (shm + logical cache tier) —
+        delegates to capacity's one definition so the evictor's
+        watermark math and ``shm_used_frac`` can never drift."""
+        from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+        return capacity.shm_resident_bytes(view.get("totals", {}))
 
     def _shm_budget(self, view: Dict[str, Any]) -> Optional[int]:
         budget = getattr(self._ctx.store, "capacity_bytes", None)
@@ -594,16 +600,35 @@ class ElasticController:
 
     # -- tiered evictor ------------------------------------------------------
 
+    @staticmethod
+    def _last_touch(seg: Dict[str, Any]) -> float:
+        return float(seg.get("last_touch") or seg["ts"])
+
     def _candidates(self, tier: str) -> List[Dict[str, Any]]:
         """Live ledger segments on ``tier`` eligible for eviction:
         epoch known (unknown-epoch segments are never touched — we
         cannot prove them cold) and outside the in-flight window.
-        Oldest epoch first, then oldest segment."""
+
+        Ordering is by LAST ACCESS, not creation age (ISSUE 11): the
+        coldest epoch — the one whose segments were read least recently
+        per the ledger's ``touch`` ops — evicts first, then segments
+        within it least-recently-touched first. An old epoch a resumed
+        reader is actively re-reading stays warm; under the old
+        creation-age order it was always the first casualty."""
         from ray_shuffling_data_loader_tpu.telemetry import capacity
 
         protected = self._protected_epochs()
+        live = capacity.live_segments()
+        # Epoch warmth across ALL tiers: a spill read keeps the epoch's
+        # shm segments warm too — the epoch is demonstrably in use.
+        epoch_touch: Dict[str, float] = {}
+        for seg in live:
+            key = seg["epoch"]
+            epoch_touch[key] = max(
+                epoch_touch.get(key, 0.0), self._last_touch(seg)
+            )
         out = []
-        for seg in capacity.live_segments():
+        for seg in live:
             if seg["tier"] != tier or seg["epoch"] == _UNKNOWN_EPOCH:
                 continue
             try:
@@ -613,7 +638,14 @@ class ElasticController:
             if epoch in protected:
                 continue
             out.append(seg)
-        out.sort(key=lambda s: (int(s["epoch"]), s["ts"]))
+        out.sort(
+            key=lambda s: (
+                epoch_touch.get(s["epoch"], 0.0),
+                int(s["epoch"]),
+                self._last_touch(s),
+                s["ts"],
+            )
+        )
         return out
 
     def evict_once(
@@ -651,12 +683,29 @@ class ElasticController:
             self._last_evict_ts = mono
         store = self._ctx.store
         budget = self._shm_budget(view)
-        resident = (
-            view.get("totals", {}).get("shm", {}).get("resident_bytes", 0)
-        )
+        resident = self._shm_resident(view)
         target = self.evict_low * budget if budget else None
         demoted_epochs: set = set()
+        dropped_epochs: set = set()
         if force or pressured:
+            # First rung: shed shared decode-cache segments (logical
+            # "cache" tier, ISSUE 11), coldest-last-touch first. They
+            # are the cheapest bytes to lose — lineage re-materializes
+            # them from Parquet on the next claim (the chaos-proven
+            # _recover_lost_cache path), no epoch state is at risk.
+            for seg in self._candidates("cache"):
+                if (
+                    not force
+                    and target is not None
+                    and resident <= target
+                ):
+                    break
+                freed = store.drop_segments(seg["ids"] or [seg["id"]])
+                if freed:
+                    stats["dropped"] += 1
+                    stats["dropped_bytes"] += freed
+                    resident -= freed
+                    dropped_epochs.add(seg["epoch"])
             for seg in self._candidates("shm"):
                 if (
                     not force
@@ -670,9 +719,13 @@ class ElasticController:
                     stats["demoted_bytes"] += moved
                     resident -= moved
                     demoted_epochs.add(seg["epoch"])
-        dropped_epochs: set = set()
         for seg in self._candidates("spill"):
-            if not force_drop and now - float(seg["ts"]) < self.drop_age_s:
+            # The age rung keys on last ACCESS, not creation: a spill
+            # segment a reader touched recently is demonstrably needed.
+            if (
+                not force_drop
+                and now - self._last_touch(seg) < self.drop_age_s
+            ):
                 continue
             freed = store.drop_segments(seg["ids"] or [seg["id"]])
             if freed:

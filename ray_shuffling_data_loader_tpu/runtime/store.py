@@ -540,7 +540,8 @@ class PendingColumns:
     hidden ``.tmp`` file, so readers never observe a half-written segment.
     """
 
-    def __init__(self, store, object_id, tmp_path, path, nbytes, mm, views):
+    def __init__(self, store, object_id, tmp_path, path, nbytes, mm, views,
+                 ledger_tier: Optional[str] = None):
         self._store = store
         self.object_id = object_id
         self._tmp = tmp_path
@@ -549,6 +550,9 @@ class PendingColumns:
         self._mm = mm
         self.columns: Dict[str, np.ndarray] = views
         self._published = False
+        # Logical capacity-ledger tier override (e.g. "cache" for the
+        # shared decode-cache tier, ISSUE 11); None = the physical tier.
+        self._ledger_tier = ledger_tier
 
     @property
     def num_rows(self) -> int:
@@ -563,7 +567,7 @@ class PendingColumns:
         self._published = True
         _ledger_note(
             "create", self.object_id, self.nbytes,
-            self._store.tier_of(self._path),
+            self._ledger_tier or self._store.tier_of(self._path),
         )
         return ObjectRef(
             object_id=self.object_id,
@@ -615,7 +619,7 @@ class PendingColumns:
         # mirrors the filesystem refcount).
         _ledger_note(
             "create", self.object_id, self.nbytes,
-            self._store.tier_of(self._tmp),
+            self._ledger_tier or self._store.tier_of(self._tmp),
             ids=[r.object_id for r in refs],
         )
         return refs
@@ -856,6 +860,7 @@ class ObjectStore:
         self,
         spec: Mapping[str, Tuple[Tuple[int, ...], "np.dtype"]],
         layout: Optional[dict] = None,
+        ledger_tier: Optional[str] = None,
     ) -> "PendingColumns":
         """Allocate an unpublished segment and return writable column views.
 
@@ -865,7 +870,10 @@ class ObjectStore:
         — one full memory pass saved per stage. Fill the views, then
         ``seal()`` (one ref) or ``publish_slices()`` (hardlinked row-window
         refs). ``layout`` stamps the segment with a staging-layout
-        descriptor (see :func:`_plan_layout`).
+        descriptor (see :func:`_plan_layout`). ``ledger_tier`` overrides
+        the capacity-ledger tier the publish records under (the shared
+        decode-cache tier accounts as ``cache``; physical placement is
+        unchanged).
         """
         if faults.enabled():
             faults.fire("store.put")
@@ -892,7 +900,10 @@ class ObjectStore:
                 count=int(np.prod(m["shape"], dtype=np.int64)),
                 offset=payload_start + m["offset"],
             ).reshape(m["shape"])
-        return PendingColumns(self, object_id, tmp, path, total, mm, views)
+        return PendingColumns(
+            self, object_id, tmp, path, total, mm, views,
+            ledger_tier=ledger_tier,
+        )
 
     def put_columns(self, columns: Mapping[str, np.ndarray]) -> ObjectRef:
         """Write a columnar batch as one aligned segment; return its ref.
@@ -955,9 +966,33 @@ class ObjectStore:
             ) from None
         except ValueError as exc:
             raise ObjectCorruptError(ref.object_id, str(exc)) from exc
+        # Read-tracking ledger op (ISSUE 11): every successful read
+        # stamps the segment's last access — the signal last-touch
+        # eviction orders cold epochs by. The AUTHORITATIVE id
+        # (ref.object_id, a real ledger link id) gets the touch, so a
+        # foreign window read warms the owner's segment, not just this
+        # host's cache file; the cache file's own ledger entry (keyed
+        # by its window-suffixed name from the fetch op) is touched
+        # too when it differs. Rate-limited per id inside
+        # capacity.touch; one cached boolean when metrics are off.
+        self._ledger_touch(ref.object_id)
+        base = os.path.basename(path)
+        if base != ref.object_id:
+            self._ledger_touch(base)
         if rows is not None:
             batch = batch.slice(rows[0], rows[1])
         return batch
+
+    @staticmethod
+    def _ledger_touch(object_id: str) -> None:
+        if not _metrics.enabled():
+            return
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+            capacity.touch(object_id)
+        except Exception:
+            pass
 
     def _is_foreign(self, ref: ObjectRef) -> bool:
         return (

@@ -54,7 +54,10 @@ from ray_shuffling_data_loader_tpu.runtime.tasks import (
 from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
-from ray_shuffling_data_loader_tpu.utils import arrow_decode_threads
+from ray_shuffling_data_loader_tpu.utils import (
+    arrow_decode_threads,
+    decode_rowgroup_threads,
+)
 
 
 class StageFailedError(TaskError):
@@ -130,8 +133,13 @@ def protected_epochs() -> set:
     the same live tracker ``/status`` serves, so "in flight" here and
     on the obs plane can never disagree. Between trials (or before the
     first) the set is empty: everything still resident is cold by
-    definition and lineage-recoverable."""
-    return set(live_status().get("in_flight_epochs") or [])
+    definition and lineage-recoverable — an ended trial's epochs must
+    not stay fenced forever just because delivery never marked them
+    done (a failed run's epochs park in "running" otherwise)."""
+    status = live_status()
+    if not status.get("running"):
+        return set()
+    return set(status.get("in_flight_epochs") or [])
 
 
 def _status_begin_trial(
@@ -204,14 +212,197 @@ class BatchConsumer:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Parquet decode plane (ISSUE 11): row-group execution plans, column
+# pushdown, selective row-group reads
+# ---------------------------------------------------------------------------
+# Since PR 6 the decode stage is the single-core laggard: its
+# parallelism was file-level only (one pq.read_table per mapper, all
+# columns). The decode plan here splits a file into contiguous
+# row-group ranges decoded concurrently (RSDL_DECODE_ROWGROUPS, fair-
+# share threaded via utils.decode_rowgroup_threads) and assembled into
+# ONE set of contiguous columns bit-identical to the single-shot read;
+# a projection decodes only the columns the run can ever touch
+# (pushdown), and a row-group selection decodes only the groups a
+# reducer's rows live in (the RINAS-style selective schedule). Pruned
+# rows/bytes are counted so the win is visible in /metrics.
+
+_RG_META_LOCK = threading.Lock()
+_RG_META_CACHE: Dict[str, Tuple[int, ...]] = {}
+
+
+def _open_parquet_file(filename: str):
+    """``(ParquetFile, fs, rel)`` for any local/URI dataset path."""
+    import pyarrow.parquet as pq
+
+    from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
+
+    fs, rel = parquet_filesystem(filename)
+    return pq.ParquetFile(rel, filesystem=fs, memory_map=fs is None), fs, rel
+
+
+def file_row_group_sizes(filename: str) -> List[int]:
+    """Per-row-group row counts from the Parquet footer, cached per
+    process — the selective schedule plans against every file's footer
+    each epoch, and dataset files are immutable for a run (the decode
+    cache already depends on that)."""
+    with _RG_META_LOCK:
+        hit = _RG_META_CACHE.get(filename)
+    if hit is not None:
+        return list(hit)
+    pf, _, _ = _open_parquet_file(filename)
+    meta = pf.metadata
+    sizes = tuple(
+        int(meta.row_group(g).num_rows)
+        for g in range(meta.num_row_groups)
+    )
+    with _RG_META_LOCK:
+        _RG_META_CACHE[filename] = sizes
+    return list(sizes)
+
+
+def _np_dtype_of(field) -> Optional[np.dtype]:
+    """The numpy dtype an Arrow schema field decodes to, or None when it
+    has no fixed-width numeric equivalent (the parallel assembly path
+    then declines to preallocate and falls back to single-shot)."""
+    try:
+        dt = np.dtype(field.type.to_pandas_dtype())
+    except (TypeError, NotImplementedError):
+        return None
+    return dt if dt.kind in "fiub" else None
+
+
+def _table_to_columns(table) -> Dict[str, np.ndarray]:
+    cols = {}
+    for name, col in zip(table.column_names, table.columns):
+        arr = col.to_numpy(zero_copy_only=False)
+        cols[name] = np.ascontiguousarray(arr)
+    return cols
+
+
+def _note_pruned(schema, group_rows, sel_rows, proj) -> None:
+    """Pushdown/selection observability: rows skipped by the row-group
+    selection and decoded-bytes avoided by both prunes (column widths at
+    pre-narrowing decode width). One cached boolean when metrics are
+    off; never raises."""
+    if not _metrics.enabled():
+        return
+    try:
+        total_rows = int(sum(group_rows))
+        proj_bytes = 0
+        pruned_col_bytes = 0
+        for i in range(len(schema.names)):
+            field = schema.field(i)
+            dt = _np_dtype_of(field)
+            width = dt.itemsize if dt is not None else 8
+            if proj is not None and field.name not in proj:
+                pruned_col_bytes += width
+            else:
+                proj_bytes += width
+        rows_pruned = total_rows - int(sel_rows)
+        bytes_pruned = (
+            total_rows * pruned_col_bytes + rows_pruned * proj_bytes
+        )
+        if rows_pruned > 0:
+            _metrics.safe_inc("shuffle.decode_rows_pruned", float(rows_pruned))
+        if bytes_pruned > 0:
+            _metrics.safe_inc(
+                "shuffle.decode_bytes_pruned", float(bytes_pruned)
+            )
+    except Exception:
+        pass
+
+
+def _decode_rowgroups_parallel(
+    fs, rel, schema, sel, proj, threads
+) -> Optional[Dict[str, np.ndarray]]:
+    """Decode the ``sel`` row groups with the plan's threads striped
+    across COLUMNS: each worker bulk-reads the whole selection for its
+    column subset on its own ParquetFile (Arrow readers are not shared
+    across threads; Arrow releases the GIL during decode) and converts
+    with exactly the calls the single-shot path uses — bit-identity by
+    construction, nulls and logical types included.
+
+    Why columns and not row-group ranges: a range split must assemble
+    each column contiguously across workers, and that copy is GIL-held
+    and bandwidth-bound, serializing behind the decode; finer per-group
+    reads that interleave copy with decode pay ~4 ms of scanner setup
+    PER read_row_groups call. Both shapes measured ~0.9-1.2x at 2
+    threads on the r11 host. Column striping needs ONE read per worker
+    and no cross-worker assembly at all — 1.6x measured (BENCHLOG
+    r11). Row groups remain the plan's SELECTION axis (the selective
+    schedule prunes them); columns are its parallel axis. Returns None
+    for single-column files (nothing to stripe; the caller falls back
+    to the bit-identical single-shot read)."""
+    import pyarrow.parquet as pq
+
+    names = list(proj) if proj is not None else list(schema.names)
+    if len(names) < 2:
+        return None
+    threads = min(threads, len(names))
+    parts = [names[k::threads] for k in range(threads)]
+    results: Dict[str, np.ndarray] = {}
+    errors: List[BaseException] = []
+
+    def _work(cols: List[str]) -> None:
+        try:
+            pf = pq.ParquetFile(rel, filesystem=fs, memory_map=fs is None)
+            table = pf.read_row_groups(
+                list(sel), columns=cols, use_threads=False
+            )
+            # THE single-shot conversion (shared helper, so the
+            # bit-identity-by-construction argument survives future
+            # conversion changes); one dict op per worker: GIL-atomic.
+            results.update(_table_to_columns(table))
+        except BaseException as exc:  # surfaced to the caller below
+            errors.append(exc)
+
+    workers = [
+        threading.Thread(target=_work, args=(p,), name="rsdl-decode-rg")
+        for p in parts[1:]
+    ]
+    for w in workers:
+        w.start()
+    _work(parts[0])  # the caller's thread takes the first stripe
+    for w in workers:
+        w.join()
+    if errors or set(results) != set(names):
+        return None
+    return {name: results[name] for name in names}
+
+
 def read_parquet_columns(
     filename: str,
     columns: Optional[Sequence[str]] = None,
     use_threads: bool = False,
+    row_groups: Optional[Sequence[int]] = None,
+    rowgroup_threads: int = 1,
+    prof=None,
+    count_pruned: bool = True,
 ) -> ColumnBatch:
     """Decode a Parquet file to contiguous numpy columns (Arrow C++ decode
     stays on host CPUs, per SURVEY §2b). ``columns`` restricts the decode
-    to a projection (None = all columns).
+    to a projection (None = all columns; pruned-bytes counters record
+    what the projection avoided unless ``count_pruned=False`` marks an
+    internal side read; a projected name the schema lacks raises,
+    EXCEPT the audit key — auto-appended by :func:`_pushdown_columns`,
+    and a keyless dataset must warn-and-skip, not fail the map).
+    ``row_groups`` restricts it to a row-group selection in ascending
+    order (the selective schedule's intra-file read); the result is
+    bit-identical to decoding the whole file and slicing those groups
+    out — for datasets whose decoded dtypes are selection-independent
+    (Arrow promotes a null-bearing int64 group to float64, so a
+    selection that skips every null group decodes a different dtype
+    than the whole file; the selective schedule guards this loudly).
+
+    ``rowgroup_threads > 1`` decodes the selected groups as a parallel
+    execution plan (column-striped — see
+    :func:`_decode_rowgroups_parallel` for why that beats range
+    striping; each worker on its own reader, Arrow releasing the GIL),
+    producing the same contiguous columns the single-shot read does —
+    bit-identical, and any shortfall falls back to single-shot. Size it
+    with :func:`~.utils.decode_rowgroup_threads` (the
+    ``RSDL_DECODE_ROWGROUPS`` gate + fair-share logic).
 
     ``use_threads`` defaults OFF: parallelism here normally comes from
     the worker POOL (one mapper process per file), so Arrow's per-read
@@ -219,27 +410,108 @@ def read_parquet_columns(
     default ``use_threads=True`` on a saturated host. Decode tasks that
     know their stage's concurrency pass
     :func:`~.utils.arrow_decode_threads`'s worker-local decision (which
-    also caps Arrow's pool to the task's fair share of the host).
+    also caps Arrow's pool to the task's fair share of the host); it is
+    ignored when a row-group plan runs (the plan owns its threads).
     ``memory_map`` only applies to local paths; URI inputs (gs://,
     s3://, memory://, ...) resolve through
     :func:`~.utils.parquet_filesystem` so pods can shuffle straight from
-    object storage."""
+    object storage.
+
+    ``prof``: a :func:`~.telemetry.phases.stage_profiler` — decode cost
+    lands as the ``decode:io`` (open + footer) and ``decode:arrow``
+    (decompress + decode + assembly) sub-phases."""
     import pyarrow.parquet as pq
 
     from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
 
-    fs, rel = parquet_filesystem(filename)
-    table = pq.read_table(
-        rel,
-        columns=list(columns) if columns is not None else None,
-        use_threads=use_threads,
-        memory_map=fs is None,
-        filesystem=fs,
+    if prof is None:
+        prof = _phases.stage_profiler("decode")
+    simple = (
+        columns is None and row_groups is None and rowgroup_threads <= 1
     )
-    cols = {}
-    for name, col in zip(table.column_names, table.columns):
-        arr = col.to_numpy(zero_copy_only=False)
-        cols[name] = np.ascontiguousarray(arr)
+    if simple:
+        # The legacy single-shot whole-file read, untouched.
+        with prof.phase("decode:arrow") as ph:
+            fs, rel = parquet_filesystem(filename)
+            table = pq.read_table(
+                rel,
+                columns=None,
+                use_threads=use_threads,
+                memory_map=fs is None,
+                filesystem=fs,
+            )
+            cols = _table_to_columns(table)
+            ph.add_bytes(sum(v.nbytes for v in cols.values()))
+        return ColumnBatch(cols)
+    with prof.phase("decode:io"):
+        pf, fs, rel = _open_parquet_file(filename)
+        meta = pf.metadata
+        group_rows = [
+            int(meta.row_group(g).num_rows)
+            for g in range(meta.num_row_groups)
+        ]
+        schema = pf.schema_arrow
+    proj = list(columns) if columns is not None else None
+    if proj is not None:
+        # Projected names the file's schema lacks: ONLY the audit key
+        # is tolerated-and-skipped (it is auto-appended by
+        # _pushdown_columns, and audit's contract on a keyless dataset
+        # is warn-and-skip, not a map failure). Any other missing name
+        # is a caller bug — a typo'd explicit projection must raise at
+        # the decode site, exactly as pq.read_table always did, not
+        # deliver a stream silently missing a feature.
+        have = set(schema.names)
+        missing = [c for c in proj if c not in have]
+        if missing:
+            tolerated = (
+                {_audit.key_column_name()} if _audit.enabled() else set()
+            )
+            hard = [c for c in missing if c not in tolerated]
+            if hard:
+                raise ValueError(
+                    f"projected columns not in {filename!r} schema: "
+                    f"{hard}"
+                )
+            proj = [c for c in proj if c in have]
+        if not proj:
+            raise ValueError(
+                f"projection selects no columns of {filename!r} "
+                f"(requested {list(columns)!r})"
+            )
+    sel = (
+        list(range(len(group_rows)))
+        if row_groups is None
+        else sorted(int(g) for g in row_groups)
+    )
+    sel_rows = sum(group_rows[g] for g in sel)
+    if count_pruned:
+        # ``count_pruned=False`` marks internal side reads (the
+        # selective plan's audit-key-only decode) whose "pruned"
+        # columns the run decodes elsewhere anyway — crediting them
+        # would fabricate avoided work in the headline counter.
+        _note_pruned(schema, group_rows, sel_rows, proj)
+    _metrics.safe_inc("shuffle.decode_rowgroups", float(len(sel)))
+    with prof.phase("decode:arrow") as ph:
+        cols = None
+        if rowgroup_threads > 1 and sel:
+            cols = _decode_rowgroups_parallel(
+                fs, rel, schema, sel, proj, rowgroup_threads
+            )
+        if cols is None:
+            if sel:
+                table = pf.read_row_groups(
+                    sel, columns=proj, use_threads=use_threads
+                )
+                cols = _table_to_columns(table)
+            else:
+                # Empty selection: schema-typed empty columns, so the
+                # caller's concat/dtype logic never special-cases it.
+                names = proj if proj is not None else list(schema.names)
+                cols = {}
+                for name in names:
+                    dt = _np_dtype_of(schema.field(name))
+                    cols[name] = np.empty(0, dt if dt is not None else np.int64)
+        ph.add_bytes(sum(v.nbytes for v in cols.values()))
     return ColumnBatch(cols)
 
 
@@ -287,6 +559,17 @@ def _reduce_seed(seed: int, epoch: int, reducer: int) -> np.random.Generator:
     )
 
 
+def _file_assignment(
+    seed: int, epoch: int, file_index: int, n: int, num_reducers: int
+) -> np.ndarray:
+    """The seeded per-row reducer assignment for one file — THE plan,
+    and its ONLY definition: :func:`shuffle_map`, :func:`shuffle_plan`,
+    and the selective schedule all call it, so every schedule
+    partitions the same rows to the same reducers by construction."""
+    rng = _map_seed(seed, epoch, file_index)
+    return rng.integers(num_reducers, size=n)
+
+
 def shuffle_map(
     filename: str,
     file_index: int,
@@ -298,6 +581,7 @@ def shuffle_map(
     cache_ref: Optional[ObjectRef] = None,
     publish_cache: bool = False,
     stage_tasks: int = 0,
+    columns: Optional[Sequence[str]] = None,
 ):
     """Map stage: load one file, randomly partition its rows across reducers.
 
@@ -310,6 +594,11 @@ def shuffle_map(
     concat+permute, store residency, and DCN fetches all move half the
     bytes. Integer columns are range-checked (a ValueError beats silent
     wraparound); float columns narrow lossily by design.
+
+    ``columns``: the decode projection (column pushdown, ISSUE 11) —
+    only these columns are ever decoded, partitioned, and delivered.
+    The driver passes it only when the run's full touchable set is
+    provably known (:func:`_pushdown_columns`); None = full decode.
 
     Decode caching (no reference analog — the reference re-decodes every
     file every epoch): with ``publish_cache`` the decoded (and narrowed)
@@ -331,16 +620,27 @@ def shuffle_map(
             batch = ctx.store.get_columns(cache_ref)
             ph.add_bytes(batch.nbytes)
     else:
-        # Worker-side thread decision: this host's cores, capped pool
+        # Worker-side decode plan: row-group parallelism when the fair-
+        # share gate grants this task threads (RSDL_DECODE_ROWGROUPS);
+        # otherwise Arrow's per-read pool under the same fair-share rule
         # (utils.arrow_decode_threads; stage_tasks == files this epoch).
-        with prof.phase("decode") as ph:
-            use_threads = (
-                stage_tasks > 0 and arrow_decode_threads(stage_tasks)
-            )
-            batch = read_parquet_columns(filename, use_threads=use_threads)
-            ph.add_bytes(batch.nbytes)
+        # The two never stack — a row-group plan reads each range with
+        # use_threads=False.
+        rg_threads = decode_rowgroup_threads(stage_tasks or 1)
+        use_threads = (
+            rg_threads <= 1
+            and stage_tasks > 0
+            and arrow_decode_threads(stage_tasks)
+        )
+        batch = read_parquet_columns(
+            filename,
+            columns=columns,
+            use_threads=use_threads,
+            rowgroup_threads=rg_threads,
+            prof=prof,
+        )
         if narrow_to_32:
-            with prof.phase("narrow", nbytes=batch.nbytes):
+            with prof.phase("decode:narrow", nbytes=batch.nbytes):
                 batch = ColumnBatch(
                     {
                         k: _narrow_column(k, v)
@@ -358,7 +658,16 @@ def shuffle_map(
                         {
                             k: (v.shape, v.dtype)
                             for k, v in batch.columns.items()
-                        }
+                        },
+                        # Cross-epoch shared tier (ISSUE 11): cache
+                        # segments account under the ledger's "cache"
+                        # tier so the evictor can see (and shed) them
+                        # separately from epoch state.
+                        ledger_tier=(
+                            "cache"
+                            if shared_decode_cache_enabled()
+                            else None
+                        ),
                     )
                     try:
                         for k, v in batch.columns.items():
@@ -375,8 +684,7 @@ def shuffle_map(
     # then get an empty partition) and n == 0 — the reference tolerates
     # every size too (reference ``shuffle.py:151-163``).
     n = batch.num_rows
-    rng = _map_seed(seed, epoch, file_index)
-    assignment = rng.integers(num_reducers, size=n)
+    assignment = _file_assignment(seed, epoch, file_index, n, num_reducers)
     # Stable group-by-reducer: single-pass counting scatter per column via
     # the C++ kernel (one-argsort-then-gather fallback otherwise), written
     # DIRECTLY into one shared-memory segment; per-reducer partitions are
@@ -475,8 +783,9 @@ def shuffle_plan(
     del cached  # header read only; drop the mmap view immediately
     end_read = timeit.default_timer()
     with prof.phase("plan", nbytes=8 * n):
-        rng = _map_seed(seed, epoch, file_index)
-        assignment = rng.integers(num_reducers, size=n)
+        assignment = _file_assignment(
+            seed, epoch, file_index, n, num_reducers
+        )
         # Stable argsort groups indices by reducer preserving file order —
         # the same stable grouping native.group_rows_multi applies to data.
         order = np.argsort(assignment, kind="stable")
@@ -528,6 +837,270 @@ def shuffle_plan(
     if _faults.enabled():
         _faults.fire("task.map", epoch=epoch, point="exit")
     return refs
+
+
+def _selective_reads_on() -> bool:
+    """The ONE parser of ``RSDL_SELECTIVE_READS`` (default off — the
+    RINAS-style selective schedule is a first cut, opt-in): derive
+    per-reducer intra-file row-group selections from the seeded plan so
+    an epoch reads+decodes only the row groups a window needs, with no
+    map materialization in the store at all."""
+    return os.environ.get(
+        "RSDL_SELECTIVE_READS", ""
+    ).strip().lower() in ("1", "on", "true")
+
+
+def shuffle_selective_plan(
+    filename: str,
+    file_index: int,
+    num_reducers: int,
+    epoch: int,
+    seed: int,
+    columns: Optional[Sequence[str]] = None,
+    narrow_to_32: bool = False,
+    stats_collector=None,
+) -> List[int]:
+    """Index-only map stage for the SELECTIVE schedule (RINAS,
+    PAPERS.md): draws the seeded assignment over the file's footer row
+    count — no data read, no store write — and returns the per-reducer
+    row counts the driver needs for delivery offsets and device-direct
+    packing. With audit on it additionally decodes JUST the audit key
+    column (column pushdown at its most extreme) so the map side of the
+    exactly-once digest exists for this schedule too."""
+    if _faults.enabled():
+        _faults.fire("task.map", epoch=epoch, point="entry")
+    if stats_collector is not None:
+        stats_collector.call_oneway("map_start", epoch)
+    start = timeit.default_timer()
+    wall0 = time.time()
+    runtime.ensure_initialized()
+    prof = _phases.stage_profiler("plan", epoch=epoch, file=file_index)
+    with prof.phase("decode:io"):
+        n = sum(file_row_group_sizes(filename))
+    end_read = timeit.default_timer()
+    with prof.phase("plan", nbytes=8 * n):
+        assignment = _file_assignment(
+            seed, epoch, file_index, n, num_reducers
+        )
+        counts = np.bincount(assignment, minlength=num_reducers)
+    if _audit.enabled():
+        key = _audit.key_column_name()
+        try:
+            kb = read_parquet_columns(
+                filename, columns=[key], prof=prof, count_pruned=False
+            )
+            # Digest what the data path DELIVERS: the reduce side
+            # narrows before digesting, and float narrowing changes
+            # the IEEE bits — an un-narrowed map digest would make
+            # strict audit fail a correct run with a float key.
+            cols = {
+                k: (_narrow_column(k, v) if narrow_to_32 else v)
+                for k, v in kb.columns.items()
+            }
+        except Exception:
+            cols = {}  # no key column: audit warns once and skips
+        _audit.record_map(epoch, file_index, cols, per_reducer=counts)
+    _metrics.safe_inc("shuffle.map_tasks")
+    _metrics.safe_inc("shuffle.map_rows", float(n))
+    duration = timeit.default_timer() - start
+    telemetry.record_span(
+        "map", wall0, duration, cat="shuffle",
+        epoch=epoch, file=file_index, rows=n, schedule="selective",
+    )
+    if stats_collector is not None:
+        stats_collector.call_oneway(
+            "map_done", epoch, duration, end_read - start
+        )
+    if _faults.enabled():
+        _faults.fire("task.map", epoch=epoch, point="exit")
+    return [int(c) for c in counts]
+
+
+def shuffle_selective_reduce(
+    reduce_index: int,
+    epoch: int,
+    seed: int,
+    filenames: List[str],
+    num_reducers: int,
+    narrow_to_32: bool = False,
+    columns: Optional[Sequence[str]] = None,
+    stats_collector=None,
+    pack=None,
+):
+    """Reduce stage for the selective schedule: decode ONLY the row
+    groups holding this reducer's rows (per-file selections derived
+    from the seeded plan), gather them in file order, and apply the
+    same seeded permutation as :func:`shuffle_reduce` — the output is
+    bit-identical to the materialized reducer's, with no shuffle state
+    in the store beyond the reducer outputs themselves (the RINAS
+    property: an epoch is never fully materialized).
+
+    Honesty note on pruning: a row group is skipped only when this
+    reducer drew NONE of its rows, so selections prune aggressively
+    when groups are small relative to ``rows/num_reducers`` and degrade
+    to whole-file decode when every group holds a row for every reducer
+    (documented in TUNING.md). Each file decodes under the row-group
+    plan (``RSDL_DECODE_ROWGROUPS``) and the column projection, so the
+    three decode levers compose."""
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="entry")
+    if stats_collector is not None:
+        stats_collector.call_oneway("reduce_start", epoch)
+    start = timeit.default_timer()
+    wall0 = time.time()
+    ctx = runtime.ensure_initialized()
+    prof = _phases.stage_profiler(
+        "selective-reduce", epoch=epoch, reducer=reduce_index
+    )
+    from ray_shuffling_data_loader_tpu import native
+
+    # Plan every file first (footers are process-cached): which row
+    # groups hold my rows, and where each row lands within the compact
+    # decoded selection.
+    sel_per_file: List[np.ndarray] = []
+    pos_per_file: List[np.ndarray] = []
+    counts: List[int] = []
+    with prof.phase("plan"):
+        for i, fname in enumerate(filenames):
+            sizes = np.asarray(file_row_group_sizes(fname), dtype=np.int64)
+            n = int(sizes.sum())
+            assignment = _file_assignment(seed, epoch, i, n, num_reducers)
+            # File-order positions of my rows — identical to the stable
+            # grouping's reducer window (stable argsort preserves
+            # within-group source order).
+            mine = np.flatnonzero(assignment == reduce_index)
+            offs = np.zeros(len(sizes) + 1, dtype=np.int64)
+            np.cumsum(sizes, out=offs[1:])
+            g_idx = np.searchsorted(offs, mine, side="right") - 1
+            gsel = np.unique(g_idx)
+            # Destination base of each SELECTED group in the compact
+            # decode (skipped groups collapse out).
+            base_of = np.zeros(len(sizes), dtype=np.int64)
+            acc = 0
+            for g in gsel:
+                base_of[g] = acc
+                acc += int(sizes[g])
+            pos = base_of[g_idx] + (mine - offs[g_idx])
+            sel_per_file.append(gsel)
+            pos_per_file.append(pos)
+            counts.append(len(mine))
+    dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=dst_off[1:])
+    total = int(dst_off[-1])
+    with prof.phase("permute", nbytes=8 * total):
+        rng = _reduce_seed(seed, epoch, reduce_index)
+        perm = rng.permutation(total)
+    # Pass 1: per-file selective decode + near-sequential take into the
+    # compact buffer (the same locality two-pass as the index schedule's
+    # gather-reduce; pass 2 below permutes the dense result).
+    rg_threads = decode_rowgroup_threads(num_reducers)
+    compact: Optional[Dict[str, np.ndarray]] = None
+    for i, fname in enumerate(filenames):
+        batch = read_parquet_columns(
+            fname,
+            columns=columns,
+            row_groups=[int(g) for g in sel_per_file[i]],
+            rowgroup_threads=rg_threads,
+            prof=prof,
+        )
+        if narrow_to_32:
+            with prof.phase("decode:narrow", nbytes=batch.nbytes):
+                batch = ColumnBatch(
+                    {
+                        k: _narrow_column(k, v)
+                        for k, v in batch.columns.items()
+                    }
+                )
+        if compact is None:
+            compact = {
+                k: np.empty((total, *v.shape[1:]), v.dtype)
+                for k, v in batch.columns.items()
+            }
+        else:
+            # Selection-dependent dtype promotion (Arrow decodes a
+            # null-bearing int64 group as float64; a selection that
+            # skips the null groups doesn't) would silently corrupt
+            # the gather below AND break stream identity with the
+            # materialized path — refuse loudly. First-cut limitation,
+            # documented in TUNING.md: the selective schedule needs
+            # selection-independent decoded dtypes (null-free columns).
+            for k, v in batch.columns.items():
+                if k not in compact or v.dtype != compact[k].dtype:
+                    earlier = (
+                        str(compact[k].dtype) if k in compact else "absent"
+                    )
+                    raise ValueError(
+                        "selective schedule: file "
+                        f"{filenames[i]!r} decoded column {k!r} as "
+                        f"{v.dtype} where an earlier file decoded "
+                        f"{earlier} — selection-dependent dtype "
+                        "promotion (nullable columns) is not supported; "
+                        "run with RSDL_SELECTIVE_READS=off for this "
+                        "dataset"
+                    )
+        lo, hi = int(dst_off[i]), int(dst_off[i + 1])
+        if hi > lo:
+            with prof.phase("gather") as ph:
+                pos = pos_per_file[i]
+                for k, v in batch.columns.items():
+                    native.take(v, pos, out=compact[k][lo:hi])
+                ph.add_bytes(
+                    2 * sum(compact[k][lo:hi].nbytes for k in compact)
+                )
+        del batch
+    if compact is None:
+        compact = {}
+    template = compact if compact else None
+    packed_out = _packed_output(ctx.store, pack, total, template)
+    pending = (
+        ctx.store.create_columns(
+            {
+                k: ((total, *v.shape[1:]), v.dtype)
+                for k, v in compact.items()
+            }
+        )
+        if packed_out is None
+        else None
+    )
+    try:
+        with prof.phase("gather") as ph:
+            if packed_out is not None:
+                # Pass 2 writes straight into the batch-aligned device
+                # layout — the permute IS the pack (ISSUE 8).
+                for lo, hi, views in packed_out.chunks():
+                    for k, dst in views.items():
+                        native.take(compact[k], perm[lo:hi], out=dst)
+            else:
+                for k, dst in pending.columns.items():
+                    native.take(compact[k], perm, out=dst)
+            ph.add_bytes(2 * sum(v.nbytes for v in compact.values()))
+        if _audit.enabled():
+            if packed_out is not None:
+                packed_out.record_audit(epoch, reduce_index)
+            else:
+                _audit.record_reduce(epoch, reduce_index, pending.columns)
+        with prof.phase("publish"):
+            out_ref = (
+                packed_out.seal() if packed_out is not None
+                else pending.seal()
+            )
+    finally:
+        if pending is not None:
+            pending.abort()
+        if packed_out is not None:
+            packed_out.abort()
+    _metrics.safe_inc("shuffle.reduce_tasks")
+    _metrics.safe_inc("shuffle.reduce_rows", float(total))
+    duration = timeit.default_timer() - start
+    telemetry.record_span(
+        "reduce", wall0, duration, cat="shuffle",
+        epoch=epoch, reducer=reduce_index, schedule="selective",
+    )
+    if stats_collector is not None:
+        stats_collector.call_oneway("reduce_done", epoch, duration)
+    if _faults.enabled():
+        _faults.fire("task.reduce", epoch=epoch, point="exit")
+    return out_ref
 
 
 # ---------------------------------------------------------------------------
@@ -1199,6 +1772,60 @@ class _ResolvedMapResult:
         return self._value
 
 
+# -- cross-epoch shared decode-cache tier (ISSUE 11) ------------------------
+# The per-run _DecodeCache's segments used to die with the shuffle()
+# call; with RSDL_DECODE_CACHE_SHARED on, resolved cache refs are
+# promoted into this process-level registry keyed by CONTENT identity
+# (file, projection, narrowing) so the next run over the same dataset
+# starts cache-hot — epoch 0 goes straight to the index schedule, and
+# two co-resident jobs on one driver share one decode (ISSUE 1's
+# hot-dataset sharing foundation). Entries are validated against the
+# store on every claim: a segment the evictor shed (ledger tier
+# "cache") or a session cleanup reclaimed simply re-decodes — the
+# registry can never hand out a dangling ref without the lineage
+# machinery noticing (ObjectLostError → _recover_lost_cache).
+
+_SHARED_CACHE_LOCK = threading.Lock()
+_SHARED_CACHE: Dict[tuple, ObjectRef] = {}
+
+
+def shared_decode_cache_enabled() -> bool:
+    """The ONE parser of ``RSDL_DECODE_CACHE_SHARED`` (default off —
+    the zero-overhead contract: unset means no registry entry, no
+    ledger ``cache`` tier, per-run cache semantics untouched)."""
+    return os.environ.get(
+        "RSDL_DECODE_CACHE_SHARED", ""
+    ).strip().lower() in ("1", "on", "true", "auto")
+
+
+def _shared_cache_key(
+    session: str,
+    filename: str,
+    columns: Optional[Sequence[str]],
+    narrow: bool,
+) -> tuple:
+    """Content identity of one file's decoded columns: the store
+    session (refs are session-scoped), the file, the projection, and
+    the narrowing flag — a run with a different projection or
+    narrowing must never read another run's cache."""
+    path = filename if "://" in filename else os.path.abspath(filename)
+    proj = None if columns is None else tuple(columns)
+    return (session, path, proj, bool(narrow))
+
+
+def shared_decode_cache_clear(free: bool = False) -> None:
+    """Drop every shared-registry entry (tests / operators);
+    ``free=True`` also frees the underlying segments."""
+    with _SHARED_CACHE_LOCK:
+        refs = list(_SHARED_CACHE.values())
+        _SHARED_CACHE.clear()
+    if free and refs:
+        try:
+            runtime.get_context().store.free(refs)
+        except Exception:
+            pass
+
+
 class _DecodeCache:
     """Driver-side registry of per-file decoded-column cache refs.
 
@@ -1206,21 +1833,57 @@ class _DecodeCache:
     later epoch's submission blocks on that map's future (same-file
     chaining only — its data cannot exist earlier anyway) and partitions
     from the cached segment instead of re-decoding Parquet.
+
+    ``shared_keys`` (one content key per file, from
+    :func:`_shared_cache_key`) arms the cross-epoch shared tier: claims
+    consult the process-level registry before decoding, and resolved
+    refs are promoted into it at run end instead of being freed.
     """
 
-    def __init__(self, enabled: bool):
+    def __init__(self, enabled: bool, shared_keys: Optional[list] = None):
         self.enabled = enabled
         self._lock = threading.Lock()
         self._futs: dict = {}  # file index -> publishing map TaskFuture
+        self._shared_keys = shared_keys
+
+    def _shared_get(self, index: int) -> Optional[ObjectRef]:
+        """A still-live shared-tier ref for file ``index``, else None
+        (stale entries — evicted or cleaned-up segments — are dropped
+        so the caller re-decodes instead of chasing a dead ref)."""
+        if self._shared_keys is None:
+            return None
+        key = self._shared_keys[index]
+        with _SHARED_CACHE_LOCK:
+            ref = _SHARED_CACHE.get(key)
+        if ref is None:
+            return None
+        try:
+            if runtime.get_context().store.exists(ref):
+                return ref
+        except Exception:
+            pass
+        with _SHARED_CACHE_LOCK:
+            if _SHARED_CACHE.get(key) is ref:
+                del _SHARED_CACHE[key]
+        return None
+
+    def _share(self, index: int, ref: ObjectRef) -> None:
+        if self._shared_keys is not None and ref is not None:
+            with _SHARED_CACHE_LOCK:
+                _SHARED_CACHE[self._shared_keys[index]] = ref
 
     def claim_or_wait(self, index: int):
-        """Returns ``(cache_ref, publish)`` for file ``index``: the first
-        caller gets ``(None, True)``; later callers block until the
-        publisher's map resolves and get ``(ref, False)``. A publisher
-        failure (its retry will have published nothing) degrades to
-        plain decode."""
+        """Returns ``(cache_ref, publish)`` for file ``index``: a
+        shared-tier hit short-circuits (cross-run cache-hot); else the
+        first caller gets ``(None, True)`` and later callers block
+        until the publisher's map resolves and get ``(ref, False)``. A
+        publisher failure (its retry will have published nothing)
+        degrades to plain decode."""
         if not self.enabled:
             return None, False
+        ref = self._shared_get(index)
+        if ref is not None:
+            return ref, False
         with self._lock:
             fut = self._futs.get(index)
             if fut is None:
@@ -1236,39 +1899,51 @@ class _DecodeCache:
             self._futs[index] = fut
 
     def hot_refs(self, num_files: int) -> Optional[List[ObjectRef]]:
-        """Every file's cache ref once all publishers have resolved, else
-        None. Blocks on in-flight publishing maps (an earlier epoch's —
-        the data cannot exist sooner anyway); any missing/failed publish
-        disqualifies the whole epoch from the index schedule, degrading
-        to the materialized path."""
+        """Every file's cache ref once all publishers have resolved (or
+        the shared tier already holds them), else None. Blocks on
+        in-flight publishing maps (an earlier epoch's — the data cannot
+        exist sooner anyway); any missing/failed publish disqualifies
+        the whole epoch from the index schedule, degrading to the
+        materialized path."""
         if not self.enabled:
             return None
-        with self._lock:
-            if any(i not in self._futs for i in range(num_files)):
-                return None
-            futs = [self._futs[i] for i in range(num_files)]
         refs = []
-        for fut in futs:
-            try:
-                _, ref = fut.result()
-            except Exception:
-                return None
+        for i in range(num_files):
+            ref = self._shared_get(i)
             if ref is None:
-                return None
+                with self._lock:
+                    fut = self._futs.get(i)
+                if fut is None:
+                    return None
+                try:
+                    _, ref = fut.result()
+                except Exception:
+                    return None
+                if ref is None:
+                    return None
+                self._share(i, ref)
             refs.append(ref)
         return refs
 
     def free_all(self) -> None:
+        """Run-end reclamation — or, with the shared tier armed,
+        promotion: resolved cache refs outlive the run in the shared
+        registry (the evictor and session cleanup own their
+        lifetime)."""
         refs = []
         with self._lock:
             futs, self._futs = dict(self._futs), {}
-        for fut in futs.values():
+        for index, fut in futs.items():
             try:
                 _, ref = fut.result()
-                if ref is not None:
-                    refs.append(ref)
             except Exception:
-                pass
+                continue
+            if ref is None:
+                continue
+            if self._shared_keys is not None:
+                self._share(index, ref)
+            else:
+                refs.append(ref)
         if refs:
             try:
                 runtime.get_context().store.free(refs)
@@ -1388,16 +2063,23 @@ def _gather_bw_for(cache_bytes: float) -> float:
 
 
 def _dataset_stats_task(
-    filenames: List[str], narrow_to_32: bool
+    filenames: List[str],
+    narrow_to_32: bool,
+    columns: Optional[Sequence[str]] = None,
 ) -> Tuple[float, int]:
     """Runs IN A POOL WORKER: ``(decoded_bytes_per_row, total_rows)``
     for a dataset — bytes/row from a <=65k-row decoded sample of the
     first file (the schema is uniform across a dataset; narrowing
     applies :func:`narrowed_dtype` per column), total rows from every
-    file's footer. Worker placement is deliberate: pyarrow opens on the
-    shuffle DRIVER thread segfaulted (pyarrow 25, observed r4 in-process
-    after unrelated earlier runs), while worker processes decode Parquet
-    all day — this rides the battle-tested path."""
+    file's footer. ``columns`` restricts the bytes/row sum to the
+    active decode projection — under pushdown the decoded footprint is
+    only the projected columns, and estimating the full schema would
+    mis-size the store budget (decline the cache / index schedule for
+    data that will never be decoded). Worker placement is deliberate:
+    pyarrow opens on the shuffle DRIVER thread segfaulted (pyarrow 25,
+    observed r4 in-process after unrelated earlier runs), while worker
+    processes decode Parquet all day — this rides the battle-tested
+    path."""
     import pyarrow.parquet as pq
 
     from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
@@ -1408,10 +2090,13 @@ def _dataset_stats_task(
 
     pf = _pf(filenames[0])
     per_row = 0.0
+    wanted = None if columns is None else set(columns)
     for batch in pf.iter_batches(batch_size=1 << 16):
         if batch.num_rows == 0:
             continue
         for col in batch.schema:
+            if wanted is not None and col.name not in wanted:
+                continue
             dt = np.dtype(col.type.to_pandas_dtype())
             if narrow_to_32:
                 dt = narrowed_dtype(dt)
@@ -1424,7 +2109,11 @@ def _dataset_stats_task(
     return per_row, int(total_rows)
 
 
-def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
+def _est_decoded_bytes(
+    filenames: List[str],
+    narrow_to_32: bool,
+    columns: Optional[Sequence[str]] = None,
+) -> float:
     """Estimated decoded-columns footprint of the dataset: measured
     bytes/row (decode microprobe on the first file — the schema is
     uniform across a dataset) x total rows from Parquet footers, plus
@@ -1435,13 +2124,17 @@ def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
     (callers treat that as "unknown: decline")."""
     if not filenames:
         return 0.0
-    key = ("est", tuple(filenames), narrow_to_32)
+    key = (
+        "est", tuple(filenames), narrow_to_32,
+        None if columns is None else tuple(columns),
+    )
     with _PROBE_LOCK:
         if key in _PROBE_CACHE:
             return _PROBE_CACHE[key]
     try:
         per_row, total_rows = runtime.get_context().scheduler.submit(
-            _dataset_stats_task, list(filenames), narrow_to_32
+            _dataset_stats_task, list(filenames), narrow_to_32,
+            list(columns) if columns is not None else None,
         ).result()
         est = per_row * total_rows * 1.15
     except Exception:
@@ -1456,7 +2149,10 @@ def _est_decoded_bytes(filenames: List[str], narrow_to_32: bool) -> float:
 
 
 def _decode_cache_auto(
-    filenames: List[str], num_epochs: int, narrow_to_32: bool = False
+    filenames: List[str],
+    num_epochs: int,
+    narrow_to_32: bool = False,
+    columns: Optional[Sequence[str]] = None,
 ) -> bool:
     """Auto policy: cache when more than one epoch will read the files AND
     the (estimated) decoded size fits comfortably inside the store's
@@ -1471,7 +2167,7 @@ def _decode_cache_auto(
     if num_epochs < 2:
         return False
     try:
-        est = _est_decoded_bytes(filenames, narrow_to_32)
+        est = _est_decoded_bytes(filenames, narrow_to_32, columns)
     except OSError:
         return False
     cap = runtime.get_context().store.capacity_bytes
@@ -1481,7 +2177,10 @@ def _decode_cache_auto(
 
 
 def _index_schedule_allowed(
-    filenames: List[str], num_reducers: int, narrow_to_32: bool
+    filenames: List[str],
+    num_reducers: int,
+    narrow_to_32: bool,
+    columns: Optional[Sequence[str]] = None,
 ) -> bool:
     """Policy for the index-only steady-state schedule. ``auto`` (default)
     weighs its read amplification: every gather reads ~the ENTIRE cached
@@ -1522,7 +2221,7 @@ def _index_schedule_allowed(
     if runtime.get_context().cluster is not None:
         return False
     try:
-        est_cache = _est_decoded_bytes(filenames, narrow_to_32)
+        est_cache = _est_decoded_bytes(filenames, narrow_to_32, columns)
     except OSError:
         return False
     costs = _probed_host_costs()
@@ -1603,6 +2302,7 @@ def shuffle_epoch(
     decode_cache: Optional[_DecodeCache] = None,
     schedule_log: Optional[list] = None,
     device_layout: Optional[dict] = None,
+    columns: Optional[Sequence[str]] = None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
 
@@ -1627,6 +2327,13 @@ def shuffle_epoch(
     gather from the cached segments — the epoch's only full data pass,
     replacing the materialized map scatter + reduce concat-permute while
     producing a bit-identical batch stream (tested).
+
+    With ``RSDL_SELECTIVE_READS=on`` and no hot cache, the epoch runs
+    the **selective schedule** instead (RINAS, ISSUE 11): per-file
+    :func:`shuffle_selective_plan` tasks return counts only and each
+    :func:`shuffle_selective_reduce` decodes just the row groups its
+    seeded window needs — no map materialization in the store at all,
+    same bit-identical stream (tested).
     """
     if stats_collector is not None:
         stats_collector.call_oneway("epoch_start", epoch)
@@ -1637,10 +2344,20 @@ def shuffle_epoch(
         decode_cache = _DecodeCache(enabled=False)
     cache_refs = (
         decode_cache.hot_refs(len(filenames))
-        if _index_schedule_allowed(filenames, num_reducers, narrow_to_32)
+        if _index_schedule_allowed(
+            filenames, num_reducers, narrow_to_32, columns
+        )
         else None
     )
-    schedule = "index" if cache_refs is not None else "mapreduce"
+    if cache_refs is not None:
+        schedule = "index"
+    elif _selective_reads_on():
+        # RINAS-style selective schedule (ISSUE 11): no map
+        # materialization at all — per-file plans return counts only,
+        # reducers decode just the row groups their windows need.
+        schedule = "selective"
+    else:
+        schedule = "mapreduce"
     if schedule_log is not None:
         schedule_log.append((epoch, schedule))
     _status_epoch(epoch, state="running", schedule=schedule)
@@ -1671,6 +2388,22 @@ def shuffle_epoch(
                     )
                 )
                 map_published.append(False)
+        elif schedule == "selective":
+            for i, fname in enumerate(filenames):
+                map_futs.append(
+                    pool.submit(
+                        shuffle_selective_plan,
+                        fname,
+                        i,
+                        num_reducers,
+                        epoch,
+                        seed,
+                        columns,
+                        narrow_to_32,
+                        stats_collector,
+                    )
+                )
+                map_published.append(False)
         else:
             for i, fname in enumerate(filenames):
                 cache_ref, publish = decode_cache.claim_or_wait(i)
@@ -1685,6 +2418,7 @@ def shuffle_epoch(
                     cache_ref,
                     publish,
                     len(filenames),
+                    columns,
                 )
                 if cache_ref is not None:
                     # Locality: run the map on the host that owns the
@@ -1737,6 +2471,18 @@ def shuffle_epoch(
                 cache_refs[i],
                 stats_collector,
             )
+        if schedule == "selective":
+            return pool.submit(
+                shuffle_selective_plan,
+                filenames[i],
+                i,
+                num_reducers,
+                epoch,
+                seed,
+                columns,
+                narrow_to_32,
+                stats_collector,
+            )
         return pool.submit(
             shuffle_map,
             filenames[i],
@@ -1749,6 +2495,7 @@ def shuffle_epoch(
             None,
             publish,
             len(filenames),
+            columns,
         )
 
     def _regenerate_cache(j):
@@ -1774,6 +2521,7 @@ def shuffle_epoch(
             None,
             True,
             len(filenames),
+            columns,
         )
         try:
             part_refs, new_cache = fut.result()
@@ -1863,11 +2611,16 @@ def shuffle_epoch(
                 # reduce dies on ObjectLostError, the driver re-executes
                 # exactly that producing map (bounded by the stage budget)
                 # instead of failing the epoch — the Ray-lineage analog
-                # the runtime lost when it replaced Ray.
+                # the runtime lost when it replaced Ray. The selective
+                # schedule has no partition refs (its "maps" return
+                # per-reducer counts) and so no lineage to track: a
+                # selective reduce's only input is the immutable Parquet
+                # source, and a plain resubmit IS its re-materialization.
                 lineage: Dict[str, int] = {}
-                for i, refs in enumerate(per_file_refs):
-                    for ref in refs:
-                        lineage[ref.object_id] = i
+                if schedule != "selective":
+                    for i, refs in enumerate(per_file_refs):
+                        for ref in refs:
+                            lineage[ref.object_id] = i
                 # Locality: each reduce runs on the host already holding the
                 # most of its input-partition rows (cluster mode; the local
                 # pool ignores the hint). Ray gets this from its scheduler;
@@ -1890,6 +2643,18 @@ def shuffle_epoch(
                 if device_layout is not None:
                     counts_r: List[Optional[int]] = []
                     for r in range(num_reducers):
+                        if schedule == "selective":
+                            # The plans returned per-reducer counts
+                            # directly — no refs to interrogate.
+                            counts_r.append(
+                                int(
+                                    sum(
+                                        int(counts[r])
+                                        for counts in per_file_refs
+                                    )
+                                )
+                            )
+                            continue
                         rows = [
                             _ref_window_rows(refs[r])
                             for refs in per_file_refs
@@ -1909,6 +2674,19 @@ def shuffle_epoch(
                             acc[rnk] = acc.get(rnk, 0) + counts_r[r]
 
                 def _submit_reduce(r, refs_r):
+                    if schedule == "selective":
+                        return pool.submit(
+                            shuffle_selective_reduce,
+                            r,
+                            epoch,
+                            seed,
+                            filenames,
+                            num_reducers,
+                            narrow_to_32,
+                            columns,
+                            stats_collector,
+                            pack_for[r],
+                        )
                     return pool.submit_local_to(
                         refs_r,
                         reduce_fn,
@@ -1921,8 +2699,13 @@ def shuffle_epoch(
                         pack_for[r],
                     )
 
+                def _refs_for(r):
+                    if schedule == "selective":
+                        return []
+                    return [refs[r] for refs in per_file_refs]
+
                 reduce_futs = [
-                    _submit_reduce(r, [refs[r] for refs in per_file_refs])
+                    _submit_reduce(r, _refs_for(r))
                     for r in range(num_reducers)
                 ]
 
@@ -1960,11 +2743,14 @@ def shuffle_epoch(
                             except Exception:
                                 pass
 
-                threading.Thread(
-                    target=free_inputs,
-                    name=f"free-inputs-e{epoch}",
-                    daemon=True,
-                ).start()
+                if schedule != "selective":
+                    # Selective reducers consumed nothing from the
+                    # store; there are no inputs to free.
+                    threading.Thread(
+                        target=free_inputs,
+                        name=f"free-inputs-e{epoch}",
+                        daemon=True,
+                    ).start()
 
                 def _rematerialize(j, r, old_ref):
                     """Lineage re-execution: re-run map ``j``, keep its
@@ -2003,7 +2789,7 @@ def shuffle_epoch(
                     inputs are re-materialized from lineage before the
                     resubmit; anything else is retried as-is (transient),
                     all bounded by the stage budget."""
-                    refs_r = [refs[r] for refs in per_file_refs]
+                    refs_r = _refs_for(r)
                     retried = False
                     for attempt, backoff in policy.attempts(
                         site="stage.reduce"
@@ -2149,6 +2935,44 @@ def _device_layout_allowed(device_layout: Optional[dict]) -> Optional[dict]:
     return device_layout
 
 
+def _pushdown_columns(
+    device_layout: Optional[dict],
+    columns: Optional[Sequence[str]],
+) -> Optional[List[str]]:
+    """The decode projection for a run, or None (full decode).
+
+    Column pushdown (ISSUE 11) engages only when the set of columns the
+    run can ever touch is PROVABLY known — an explicit ``columns=``
+    request from the caller (honored under the default ``auto``), or,
+    under ``RSDL_DECODE_PUSHDOWN=on``, the staging layout's column set
+    (the packed prefix is all the consumer ships; ``on`` is the
+    operator asserting nothing else reads the stream). The audit key
+    column is always appended when audit is armed — digests must keep
+    folding. Unknown spec → decline to full decode; ``off`` → never
+    prune (the bit-identity control)."""
+    mode = os.environ.get(
+        "RSDL_DECODE_PUSHDOWN", "auto"
+    ).strip().lower()
+    if mode in ("off", "0", "false"):
+        return None
+    need: Optional[List[str]] = None
+    if columns is not None:
+        need = [str(c) for c in columns]
+    elif mode in ("on", "1", "true") and device_layout is not None:
+        try:
+            need = [str(c) for c in device_layout["columns"]]
+        except (KeyError, TypeError):
+            return None
+    if not need:
+        return None
+    if _audit.enabled():
+        key = _audit.key_column_name()
+        if key not in need:
+            need = need + [key]
+    seen: set = set()
+    return [c for c in need if not (c in seen or seen.add(c))]
+
+
 def shuffle(
     filenames: List[str],
     batch_consumer: BatchConsumer,
@@ -2162,6 +2986,7 @@ def shuffle(
     cache_decoded: Optional[bool] = None,
     schedule_log: Optional[list] = None,
     device_layout: Optional[dict] = None,
+    columns: Optional[Sequence[str]] = None,
 ) -> float:
     """Shuffle the dataset every epoch; returns total wall-clock duration.
 
@@ -2184,6 +3009,13 @@ def shuffle(
     :func:`shuffle_epoch`) — ``{"batch": B, "columns": [...]}`` from a
     staging consumer; honored unless the ``RSDL_DEVICE_DIRECT`` kill
     switch is off (:func:`_device_layout_allowed`).
+
+    ``columns``: an explicit decode projection (column pushdown,
+    ISSUE 11) — the delivered stream then contains exactly this set
+    (plus the audit key when audit is armed) and nothing else is ever
+    decoded off Parquet; ``shuffle.decode_bytes_pruned`` counts the
+    avoided work. See :func:`_pushdown_columns` for the
+    ``RSDL_DECODE_PUSHDOWN`` gate semantics.
     """
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
@@ -2212,12 +3044,32 @@ def shuffle(
         # shuffle in the same process / spool dir) would fold into this
         # run's digests and poison the verdicts.
         _audit.begin_run()
+    device_layout = _device_layout_allowed(device_layout)
+    columns = _pushdown_columns(device_layout, columns)
     if cache_decoded is None:
         cache_decoded = _decode_cache_auto(
-            filenames, num_epochs - start_epoch, narrow_to_32
+            filenames, num_epochs - start_epoch, narrow_to_32, columns
         )
-    decode_cache = _DecodeCache(enabled=cache_decoded)
-    device_layout = _device_layout_allowed(device_layout)
+    shared_keys = None
+    if cache_decoded and shared_decode_cache_enabled():
+        # The cross-epoch shared tier: claims hit the process-level
+        # registry (cache-hot across shuffle() calls) and resolved refs
+        # are promoted into it at run end instead of freed.
+        session = runtime.get_context().store.session
+        with _SHARED_CACHE_LOCK:
+            # Entries keyed by a dead session are unreachable (their
+            # segments died with the session's cleanup) — sweep them so
+            # a driver cycling runtime sessions can't grow the registry
+            # forever.
+            for key in [k for k in _SHARED_CACHE if k[0] != session]:
+                del _SHARED_CACHE[key]
+        shared_keys = [
+            _shared_cache_key(session, f, columns, narrow_to_32)
+            for f in filenames
+        ]
+    decode_cache = _DecodeCache(
+        enabled=cache_decoded, shared_keys=shared_keys
+    )
     start = timeit.default_timer()
     threads = []
     try:
@@ -2254,6 +3106,7 @@ def shuffle(
                     decode_cache=decode_cache,
                     schedule_log=schedule_log,
                     device_layout=device_layout,
+                    columns=columns,
                 )
             )
         for t in threads:

@@ -63,13 +63,18 @@ from typing import Any, Dict, List, Optional, Tuple
 # package (and its numpy deps); the spool/gauge halves import
 # export/metrics lazily inside the functions that need them.
 
-TIERS = ("shm", "spill")
+# "cache" is a LOGICAL tier (ISSUE 11): shared decode-cache segments
+# physically live on shm but account separately so the evictor can
+# shed them first (they are lineage-re-materializable from Parquet)
+# and capacity views can tell dataset cache from epoch state.
+TIERS = ("shm", "spill", "cache")
 
 # Ledger op vocabulary (docs/observability.md). "transition" is emitted
 # by the store's tier movers (``ObjectStore.demote``/``promote``) on
 # behalf of the elastic evictor and the graceful-drain re-home path
-# (ISSUE 10).
-OPS = ("create", "fetch", "delete", "transition", "cleanup")
+# (ISSUE 10); "touch" stamps a segment's last read (store
+# ``get_columns``, ISSUE 11) — the last-touch eviction signal.
+OPS = ("create", "fetch", "delete", "transition", "cleanup", "touch")
 
 _UNKNOWN_EPOCH = "-"
 
@@ -154,6 +159,35 @@ def note(
         _register_atexit()
         with _lock:
             _records.append(rec)
+    except Exception:
+        pass
+
+
+# Per-id touch rate limit: a hot segment read in a tight loop must not
+# grow the ledger linearly with reads — last-access resolution of a few
+# seconds is ample for eviction ordering, and it bounds record volume
+# at ~(live segments x runtime / interval) instead of O(reads).
+_TOUCH_INTERVAL_S = 5.0
+_touch_lock = threading.Lock()
+_touch_last: Dict[str, float] = {}
+
+
+def touch(object_id: str) -> None:
+    """Record a read-access stamp for a segment (store read paths),
+    rate-limited per id to one record per ``_TOUCH_INTERVAL_S``.
+    Caller gates on ``metrics.enabled()``; never raises."""
+    try:
+        now = time.monotonic()
+        with _touch_lock:
+            last = _touch_last.get(object_id)
+            if last is not None and now - last < _TOUCH_INTERVAL_S:
+                return
+            if len(_touch_last) > 65536:
+                # Ids are never reused; entries only matter within the
+                # interval — cap the map instead of leaking forever.
+                _touch_last.clear()
+            _touch_last[object_id] = now
+        note("touch", object_id)
     except Exception:
         pass
 
@@ -262,6 +296,8 @@ def reset(clear_spool: bool = False) -> None:
     global _published_pairs, _fold_cache
     with _lock:
         _records.clear()
+    with _touch_lock:
+        _touch_last.clear()
     with _cache_lock:
         _read_cache.clear()
     _published_pairs = set()
@@ -283,7 +319,7 @@ def reset(clear_spool: bool = False) -> None:
 
 
 class _Seg:
-    __slots__ = ("nbytes", "tier", "epoch", "ts", "links")
+    __slots__ = ("nbytes", "tier", "epoch", "ts", "links", "last_touch")
 
     def __init__(self, nbytes, tier, epoch, ts, links):
         self.nbytes = nbytes
@@ -291,6 +327,7 @@ class _Seg:
         self.epoch = epoch
         self.ts = ts
         self.links = links
+        self.last_touch = ts  # creation counts as the first access
 
 
 # Live-fold memo: (op count, folded view) — the sampler tick, /status,
@@ -437,6 +474,14 @@ def _fold(
             if not seg.links:
                 segs.pop(primary, None)
                 _sub(seg)
+        elif op == "touch":
+            primary = by_link.get(rid)
+            if primary is None:
+                continue  # unknown id (already freed, foreign); ignore
+            seg = segs[primary]
+            seg.last_touch = max(
+                seg.last_touch, float(rec.get("ts", 0.0))
+            )
         elif op == "transition":
             primary = by_link.get(rid)
             if primary is None:
@@ -504,6 +549,7 @@ def _fold(
                     "tier": seg.tier,
                     "epoch": seg.epoch,
                     "ts": seg.ts,
+                    "last_touch": seg.last_touch,
                 }
                 for primary, seg in segs.items()
             ),
@@ -589,6 +635,18 @@ def host_sample() -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 
+def shm_resident_bytes(totals: Dict[str, Any]) -> int:
+    """Bytes physically occupying shm: the shm tier PLUS the logical
+    ``cache`` tier (shared decode-cache segments live on shm) — the
+    ONE definition of the pressure numerator, shared by
+    ``shm_used_frac`` here and the elastic evictor's watermark math
+    so the two can never drift."""
+    return int(
+        (totals.get("shm") or {}).get("resident_bytes", 0)
+        + (totals.get("cache") or {}).get("resident_bytes", 0)
+    )
+
+
 def view(
     records: Optional[List[dict]] = None, now: Optional[float] = None
 ) -> Dict[str, Any]:
@@ -597,7 +655,7 @@ def view(
     out = ledger(records=records, now=now)
     host = host_sample()
     out["host"] = host
-    shm_resident = out["totals"]["shm"]["resident_bytes"]
+    shm_resident = shm_resident_bytes(out["totals"])
     budget = host.get("capacity_bytes")
     if budget:
         out["shm_used_frac"] = round(shm_resident / budget, 4)

@@ -53,7 +53,8 @@ from typing import Any, Dict, List, Optional, Tuple
 # and the live task-record vocabulary (map/plan/reduce/gather-reduce)
 # are both embedded; unknown stages order after the known ones.
 STAGE_ORDER = [
-    "map", "plan", "reduce", "gather-reduce", "deliver", "consume",
+    "map", "plan", "reduce", "gather-reduce", "selective-reduce",
+    "deliver", "consume",
 ]
 
 Interval = Tuple[float, float]

@@ -37,8 +37,11 @@ from ray_shuffling_data_loader_tpu.telemetry import trace as _trace
 # new call sites may add phases — but keeping names here documents the
 # metric series a dashboard can rely on.
 PHASES = (
-    "decode",            # Parquet -> contiguous numpy columns (map)
-    "narrow",            # 64->32-bit cast passes (map)
+    # Decode sub-phases (ISSUE 11): the old monolithic "decode" phase
+    # split so row-group parallelism and pushdown wins are attributable.
+    "decode:io",         # Parquet open + footer/metadata parse
+    "decode:arrow",      # decompress + decode + column assembly
+    "decode:narrow",     # 64->32-bit cast passes (was "narrow")
     "cache-publish",     # decoded-columns cache segment write (map)
     "partition-scatter", # stable group-by-reducer scatter (map)
     "plan",              # index-only assignment + argsort (plan)

@@ -57,8 +57,10 @@ ENV_STRAGGLER_MIN_S = "RSDL_STRAGGLER_MIN_S"
 STAGE_OF = {
     "shuffle_map": "map",
     "shuffle_plan": "plan",
+    "shuffle_selective_plan": "plan",
     "shuffle_reduce": "reduce",
     "shuffle_gather_reduce": "gather-reduce",
+    "shuffle_selective_reduce": "selective-reduce",
 }
 
 _FLAGGED_CAP = 16  # flagged-outlier rows kept per stage in the analysis

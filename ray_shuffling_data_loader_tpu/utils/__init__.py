@@ -59,6 +59,41 @@ def arrow_decode_threads(stage_tasks: int) -> bool:
     return True
 
 
+def decode_rowgroup_threads(stage_tasks: int) -> int:
+    """Row-group decode parallelism for ONE Parquet decode task — the
+    ``RSDL_DECODE_ROWGROUPS`` gate plus the same fair-share logic as
+    :func:`arrow_decode_threads`, returning a thread COUNT instead of
+    arming Arrow's pool (the row-group plan owns its threads and reads
+    each range with ``use_threads=False``, so the two parallelism
+    sources never stack).
+
+    * unset / ``off`` — 1 (single-shot decode; the zero-overhead
+      default: no decode pool thread ever exists);
+    * ``auto`` — the task's fair share of the host
+      (``cores // concurrent``) when idle cores exist, else 1 — the
+      exact condition :func:`decode_use_threads` applies to Arrow's
+      pool, so ``auto`` can never oversubscribe a saturated host;
+    * ``on`` — fair share, floored at 2 (engage even on a host with no
+      idle cores — the operator asked);
+    * an integer — that many threads, verbatim (CI forces ``2`` on the
+      2-core host so the parallel assembly path is exercised).
+    """
+    env = os.environ.get("RSDL_DECODE_ROWGROUPS", "").strip().lower()
+    if env in ("", "off", "0", "false"):
+        return 1
+    cores = os.cpu_count() or 1
+    concurrent = min(max(1, stage_tasks), cores)
+    fair = max(1, cores // concurrent)
+    if env == "auto":
+        return fair if cores >= 2 * concurrent else 1
+    if env in ("on", "true"):
+        return max(2, fair)
+    try:
+        return max(1, int(env))
+    except ValueError:
+        return fair if cores >= 2 * concurrent else 1
+
+
 def is_remote_path(path: str) -> bool:
     """True for URI-style paths (gs://, s3://, ...) that route through a
     non-local filesystem — one definition, shared by Parquet decode and
@@ -99,6 +134,7 @@ def parquet_filesystem(path: str):
 
 __all__ = [
     "arrow_decode_threads",
+    "decode_rowgroup_threads",
     "decode_use_threads",
     "force_platform_from_env",
     "is_remote_path",

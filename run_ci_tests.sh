@@ -113,6 +113,27 @@ if [ "$tier" != "slow" ]; then
     RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
     RSDL_FAULTS_SEED=555 \
     python -m pytest tests/test_elastic.py -m "not slow" -q -x
+  # Decode-plane lane (ISSUE 11): row-group parallelism FORCED (2
+  # threads on any host), column pushdown derived from staging layouts,
+  # and the cross-epoch shared decode cache — all under the audit-STRICT
+  # chaos schedule, so bit-identity of the parallel/selective/pushdown
+  # decode paths is proven by exactly-once digests, not just unit
+  # asserts. The dedicated suite owns the shared-cache assertions.
+  RSDL_DECODE_ROWGROUPS=2 RSDL_DECODE_PUSHDOWN=on \
+    RSDL_DECODE_CACHE_SHARED=on \
+    RSDL_AUDIT=1 RSDL_AUDIT_STRICT=1 RSDL_AUDIT_DIR="$(mktemp -d)" \
+    RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=777 \
+    python -m pytest tests/test_decode_plane.py -m "not slow" -q -x
+  # ... and the decode knobs must be invisible to the core data-path
+  # suites: forced row-group parallelism + pushdown ride along (shared
+  # cache deliberately NOT set here — cross-run cache hits legitimately
+  # change epoch-0 schedules, which test_shuffle asserts).
+  RSDL_DECODE_ROWGROUPS=2 RSDL_DECODE_PUSHDOWN=on \
+    RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
+    python -m pytest tests/test_shuffle.py tests/test_dataset.py \
+      tests/test_jax_dataset.py -m "not slow" -q -x
   # Temporal + decision obs smoke (ISSUES 7/9), exit-code gated:
   # against a MID-FLIGHT shuffle with the obs endpoint up, /timeseries
   # must serve a non-empty rate series, `rsdl_top --once --json` must

@@ -276,6 +276,65 @@ def test_live_segments_feed(cap_env):
     assert [s["id"] for s in segs] == ["b", "c"]
 
 
+def test_touch_tracks_last_access(cap_env, tmp_path):
+    """ISSUE 11 satellite: the ``touch`` op (store read paths) stamps a
+    segment's last access — the fold carries ``last_touch`` (creation
+    counts as the first access; window-link touches resolve to their
+    segment; unknown ids are ignored), and the real store emits it from
+    ``get_columns`` for plain and hardlink-sliced refs alike."""
+    # Synthetic fold semantics.
+    records = [
+        _rec("create", "a", 1.0, nbytes=100, tier="shm", epoch=0,
+             ids=["a1", "a2"]),
+        _rec("create", "b", 2.0, nbytes=200, tier="shm", epoch=1),
+        _rec("touch", "a2", 5.0),  # link touch -> segment "a"
+        _rec("touch", "ghost", 9.0),  # unknown id: ignored
+        _rec("touch", "b", 3.5),
+    ]
+    segs = {s["id"]: s for s in capacity.live_segments(records)}
+    assert segs["a"]["last_touch"] == 5.0
+    assert segs["b"]["last_touch"] == 3.5
+    # An out-of-order (older) touch never rewinds the stamp.
+    records.append(_rec("touch", "b", 3.0))
+    segs = {s["id"]: s for s in capacity.live_segments(records)}
+    assert segs["b"]["last_touch"] == 3.5
+
+    # The real store: reads refresh last_touch through get_columns.
+    os.environ["RSDL_SHM_DIR"] = str(tmp_path / "shm")
+    store = store_mod.ObjectStore("touchsess")
+    with trace.context(epoch=2):
+        ref = store.put_columns({"a": np.arange(64, dtype=np.int32)})
+        pending = store.create_columns({"b": ((32,), np.int32)})
+        sliced = pending.publish_slices([(0, 16), (16, 32)])
+    seg0 = {s["id"]: s for s in capacity.live_segments()}
+    time.sleep(0.02)
+    assert store.get_columns(ref)["a"][5] == 5
+    assert store.get_columns(sliced[1]).num_rows == 16
+    seg1 = {s["id"]: s for s in capacity.live_segments()}
+    for sid in seg0:
+        assert seg1[sid]["last_touch"] > seg0[sid]["last_touch"]
+    store.cleanup()
+
+
+def test_cache_tier_fold_and_used_frac(cap_env):
+    """The logical ``cache`` tier (shared decode-cache segments): totals
+    fold separately, but the shm used fraction counts them — the bytes
+    physically live on shm and pressure must see them."""
+    records = [
+        _rec("create", "e", 1.0, nbytes=600, tier="shm", epoch=0),
+        _rec("create", "c", 2.0, nbytes=400, tier="cache", epoch=0),
+    ]
+    folded = capacity.ledger(records)
+    assert folded["totals"]["cache"]["resident_bytes"] == 400
+    assert folded["totals"]["shm"]["resident_bytes"] == 600
+    assert folded["epochs"]["0"]["cache"]["hwm_bytes"] == 400
+    view = capacity.view(records=records)
+    host = view.get("host", {})
+    if host.get("capacity_bytes"):
+        expect = 1000 / host["capacity_bytes"]
+        assert view["shm_used_frac"] == pytest.approx(expect, abs=1e-4)
+
+
 def test_spill_volume_exact_under_rate_limit(cap_env, monkeypatch):
     """The spill satellite: the 1/5s event rate limit must not drop
     byte totals — every call lands on store.spill_bytes_total, and the

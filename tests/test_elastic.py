@@ -373,6 +373,72 @@ def test_evictor_pressure_watermarks(elastic_env):
     store.cleanup()
 
 
+def test_evictor_orders_by_last_touch(elastic_env):
+    """ISSUE 11 satellite (ROADMAP 5 residual): cold-epoch ordering is
+    by LAST ACCESS, not creation age. Epoch 0 is older but actively
+    read (its ledger ``touch`` is the most recent), so under pressure
+    the evictor must demote the newer-but-idle epoch 1 first."""
+    store = _evict_store(elastic_env, budget=230_000)
+    ctl = elastic_mod.ElasticController(_bare_ctx(store))
+    ctl.evict_cooldown_s = 0.0
+    with trace.context(epoch=0):
+        old_hot = store.put_columns(
+            {"a": np.arange(25_000, dtype=np.int32)}
+        )
+    time.sleep(0.02)
+    with trace.context(epoch=1):
+        new_cold = store.put_columns(
+            {"a": np.arange(25_000, dtype=np.int32)}
+        )
+    time.sleep(0.02)
+    # A read refreshes epoch 0's last access (store.get_columns emits
+    # the ledger touch op).
+    assert store.get_columns(old_hot)["a"][3] == 3
+    stats = ctl.evict_once()
+    # Pressured (2 x ~100 KB > 0.85 x 230 KB); one demotion reaches the
+    # low watermark — and it must be the least-recently-touched epoch.
+    assert stats["demoted"] == 1
+    assert store.tier_of(store._find_segment(new_cold.object_id)) == (
+        "spill"
+    )
+    assert store.tier_of(store._find_segment(old_hot.object_id)) == "shm"
+    store.free([old_hot, new_cold])
+    store.cleanup()
+
+
+def test_evictor_cache_tier_drops_first(elastic_env):
+    """The shared decode-cache tier (ledger tier ``cache``) is the
+    evictor's first rung: under pressure its segments DROP (they
+    re-materialize from Parquet via lineage) before any epoch segment
+    is demoted."""
+    store = _evict_store(elastic_env, budget=230_000)
+    ctl = elastic_mod.ElasticController(_bare_ctx(store))
+    ctl.evict_cooldown_s = 0.0
+    with trace.context(epoch=0):
+        epoch_seg = store.put_columns(
+            {"a": np.arange(25_000, dtype=np.int32)}
+        )
+        pending = store.create_columns(
+            {"a": ((25_000,), np.int32)}, ledger_tier="cache"
+        )
+        pending.columns["a"][...] = 1
+        cache_ref = pending.seal()
+    folded = capacity.ledger()
+    assert folded["totals"]["cache"]["resident_bytes"] > 0
+    stats = ctl.evict_once()
+    # The cache segment was dropped (first rung) and that alone reached
+    # the low watermark — the epoch segment never moved tiers.
+    assert stats["dropped"] == 1 and stats["demoted"] == 0
+    assert store._find_segment(cache_ref.object_id) is None
+    assert store.tier_of(store._find_segment(epoch_seg.object_id)) == (
+        "shm"
+    )
+    folded = capacity.ledger()
+    assert folded["totals"]["cache"]["resident_bytes"] == 0
+    store.free(epoch_seg)
+    store.cleanup()
+
+
 # ---------------------------------------------------------------------------
 # Chaos-lane acceptance: scale-up + drain (crash mid-drain) + eviction
 # with lineage re-materialization, audit ok, ledger reconciles to zero

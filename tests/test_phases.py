@@ -120,7 +120,9 @@ def test_repeated_phase_accumulates(metrics_on):
 
 def test_shuffle_map_records_phases(local_runtime, metrics_on, tmp_path):
     """End to end: a real shuffle_map run in-process registers the map
-    phase series (decode, partition-scatter, publish)."""
+    phase series (decode:arrow, partition-scatter, publish — the
+    monolithic decode phase split into decode:io/arrow/narrow,
+    ISSUE 11)."""
     from ray_shuffling_data_loader_tpu.data_generation import generate_data
     from ray_shuffling_data_loader_tpu.shuffle import shuffle_map
 
@@ -135,13 +137,13 @@ def test_shuffle_map_records_phases(local_runtime, metrics_on, tmp_path):
     refs = shuffle_map(filenames[0], 0, 2, epoch=0, seed=1)
     try:
         snap = metrics.registry.snapshot()
-        for phase in ("decode", "partition-scatter", "publish"):
+        for phase in ("decode:arrow", "partition-scatter", "publish"):
             key = metrics.format_key(
                 "shuffle.phase_seconds", {"phase": phase, "stage": "map"}
             )
             assert snap[f"{key}_count"] >= 1, phase
         dkey = metrics.format_key(
-            "shuffle.phase_bytes", {"phase": "decode", "stage": "map"}
+            "shuffle.phase_bytes", {"phase": "decode:arrow", "stage": "map"}
         )
         assert snap[dkey] > 0
     finally:

@@ -338,7 +338,9 @@ def test_index_schedule_gate_is_measured(local_runtime, monkeypatch):
 
     sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
     files = [f"f{i}" for i in range(16)]
-    monkeypatch.setattr(sh, "_est_decoded_bytes", lambda f, n: 25e9)
+    monkeypatch.setattr(
+        sh, "_est_decoded_bytes", lambda f, n, c=None: 25e9
+    )
     slow_host = {
         "gather_small": 2.4e9,
         "gather_large": 0.5e9,
@@ -357,7 +359,9 @@ def test_index_schedule_gate_is_measured(local_runtime, monkeypatch):
     assert sh._index_schedule_allowed(files, 4, False)
     # Tiny datasets engage on either host: the materialized path's
     # F x R store round-trips dominate at that scale.
-    monkeypatch.setattr(sh, "_est_decoded_bytes", lambda f, n: 4e5)
+    monkeypatch.setattr(
+        sh, "_est_decoded_bytes", lambda f, n, c=None: 4e5
+    )
     monkeypatch.setitem(sh._PROBE_CACHE, "costs", slow_host)
     assert sh._index_schedule_allowed(files[:4], 4, False)
 

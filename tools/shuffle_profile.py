@@ -253,6 +253,154 @@ def run_threads_sweep(args, thread_counts) -> int:
     return 0
 
 
+def run_decode_sweep(args, thread_counts) -> int:
+    """Isolated Parquet decode sweep (``--decode``, ISSUE 11): the
+    row-group-parallel decode plan at each thread count, full decode vs
+    a staging-style projection (features+label+key), against the
+    N-core memcpy roofline. Decoded GB/s counts DECODED bytes (the
+    projected set), so the projection rows additionally report the
+    bytes pruned per file — the pushdown win is visible next to the
+    thread win."""
+    import importlib
+
+    shuffle_mod = importlib.import_module(
+        "ray_shuffling_data_loader_tpu.shuffle"
+    )
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        DATA_SPEC,
+        cached_generate_data,
+    )
+
+    runtime.init(num_workers=2)
+    bytes_per_row = 168  # DATA_SPEC
+    num_rows = max(1000, int(args.gb * 1e9) // bytes_per_row)
+    data_dir = args.data_dir or os.path.join(
+        _REPO, ".bench_cache", f"decode_r{num_rows}_f{args.files}_g8"
+    )
+    os.makedirs(data_dir, exist_ok=True)
+    filenames, dataset_bytes = cached_generate_data(
+        num_rows, args.files, 8, data_dir, seed=0
+    )
+    # The sweep itself is single-process: drop the worker pool BEFORE
+    # timing so idle runtime processes don't share the cores under
+    # measurement (measured ~15% drag on the 2-core host).
+    runtime.shutdown()
+    proj = ["embeddings_name0", "one_hot0", "labels", "key"]
+    projections = {"full": None, "projected": proj}
+    print(
+        f"[decode] dataset {dataset_bytes / 1e9:.2f} GB on disk, "
+        f"{num_rows} rows x {args.files} files, "
+        f"{len(shuffle_mod.file_row_group_sizes(filenames[0]))} row "
+        f"groups/file",
+        file=sys.stderr,
+    )
+    print()
+    print(
+        f"{'threads':>7} {'projection':<10} {'decoded GB':>10} "
+        f"{'best s':>8} {'GB/s':>7} {'x vs 1':>7} {'pruned GB':>10}"
+    )
+    sweep = []
+    base: dict = {}
+    groups_of = {
+        fname: list(
+            range(len(shuffle_mod.file_row_group_sizes(fname)))
+        )
+        for fname in filenames
+    }
+    # Caveat the baseline honestly: pq.read_table's dataset scanner
+    # uses Arrow's IO thread pool even with use_threads=False, so the
+    # legacy "single-shot" read is NOT single-core. The sweep therefore
+    # measures the row-group PLAN at 1..N threads (explicit row_groups
+    # pins the plan path at every count) and reports the legacy read as
+    # its own row for context.
+    legacy = 0
+
+    def _legacy():
+        nonlocal legacy
+        legacy = 0
+        for fname in filenames:
+            cb = shuffle_mod.read_parquet_columns(fname)
+            legacy += cb.nbytes
+            del cb
+
+    lbest = _best_s(_legacy, repeats=5)
+    print(
+        f"{'-':>7} {'legacy':<10} {legacy / 1e9:>10.3f} {lbest:>8.3f} "
+        f"{legacy / lbest / 1e9:>7.2f} {'-':>7} {0.0:>10.3f}"
+    )
+    sweep.append(
+        {
+            "threads": 0,
+            "projection": "legacy-read-table",
+            "decoded_gb": round(legacy / 1e9, 4),
+            "best_s": round(lbest, 4),
+            "gbps": round(legacy / lbest / 1e9, 3),
+        }
+    )
+    for t in thread_counts:
+        for label, cols in projections.items():
+            decoded = 0
+
+            def _run(cols=cols, t=t):
+                nonlocal decoded
+                decoded = 0
+                for fname in filenames:
+                    cb = shuffle_mod.read_parquet_columns(
+                        fname,
+                        columns=cols,
+                        row_groups=groups_of[fname],
+                        rowgroup_threads=t,
+                    )
+                    decoded += cb.nbytes
+                    del cb
+
+            best = _best_s(_run, repeats=5)
+            gbps = decoded / best / 1e9
+            base.setdefault(label, gbps)
+            # Pruned = full decoded footprint minus the projected one
+            # (what pushdown never decoded).
+            pruned = 0
+            if cols is not None and "full" in base:
+                full_decoded = sweep[0]["decoded_gb"] * 1e9
+                pruned = max(0, int(full_decoded - decoded))
+            row = {
+                "threads": t,
+                "projection": label,
+                "decoded_gb": round(decoded / 1e9, 4),
+                "best_s": round(best, 4),
+                "gbps": round(gbps, 3),
+                "speedup_vs_1": round(gbps / base[label], 3),
+                "pruned_gb": round(pruned / 1e9, 4),
+            }
+            sweep.append(row)
+            print(
+                f"{t:>7d} {label:<10} {row['decoded_gb']:>10.3f} "
+                f"{best:>8.3f} {gbps:>7.2f} "
+                f"{row['speedup_vs_1']:>6.2f}x {row['pruned_gb']:>10.3f}"
+            )
+    result = {
+        "mode": "decode-sweep",
+        "shape": {
+            "gb": args.gb,
+            "files": args.files,
+            "rows": num_rows,
+            "row_groups_per_file": len(
+                shuffle_mod.file_row_group_sizes(filenames[0])
+            ),
+            "projection": proj,
+        },
+        "host_cpus": os.cpu_count(),
+        "dataset_disk_gb": round(dataset_bytes / 1e9, 3),
+        "sweep": sweep,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[decode] wrote {args.out}", file=sys.stderr)
+    return 0
+
+
 def _phase_table(flat: dict) -> dict:
     """``{(stage, phase): {count, total_s, bytes}}`` from an aggregated
     flat snapshot."""
@@ -305,12 +453,23 @@ def main() -> int:
         "gather at the bench task shape vs the N-core memcpy roofline) "
         "instead of the pipeline profile",
     )
+    parser.add_argument(
+        "--decode",
+        default=None,
+        help="comma list of decode thread counts (e.g. 1,2): run the "
+        "ISOLATED Parquet decode sweep (row-group-parallel plan, full "
+        "vs projected decode, pruned bytes) instead of the pipeline "
+        "profile",
+    )
     parser.add_argument("--out", default=None, help="also dump JSON here")
     args = parser.parse_args()
 
     if args.threads:
         thread_counts = [int(x) for x in args.threads.split(",") if x]
         return run_threads_sweep(args, thread_counts)
+    if args.decode:
+        thread_counts = [int(x) for x in args.decode.split(",") if x]
+        return run_decode_sweep(args, thread_counts)
 
     if args.schedule != "auto":
         os.environ["RSDL_INDEX_SHUFFLE"] = (
