@@ -221,25 +221,69 @@ def _attach_obs_summaries(result: dict) -> None:
             result["alerts_fired"] = fired
     except Exception:
         pass
-    # The decode plane (ISSUE 11): row-group + pushdown counters from
-    # the cluster-wide aggregate (worker decode tasks spool them at
-    # task-done), compacted for humans next to telemetry_final.
+    # The decode plane (ISSUE 11/12): row-group + pushdown counters
+    # from the cluster-wide aggregate (worker decode tasks spool them
+    # at task-done), compacted for humans next to telemetry_final. The
+    # counters carry {schedule, plan} labels since ISSUE 12, so the
+    # summary keeps the totals AND the per-(schedule, plan) breakdown —
+    # decode amplification is attributable per run, and an audit-key
+    # side sweep never masquerades as data-path decode work.
     try:
         from ray_shuffling_data_loader_tpu.telemetry import (
             export as _export,
         )
 
         flat = _export.aggregate()
+
+        def _labeled_sum(name):
+            total, by_label = _export.labeled_sum(flat, name)
+            return int(total), {k: int(v) for k, v in by_label.items()}
+
+        rowgroups, rowgroups_by = _labeled_sum("shuffle.decode_rowgroups")
+        rows_pruned, _ = _labeled_sum("shuffle.decode_rows_pruned")
+        bytes_pruned, _ = _labeled_sum("shuffle.decode_bytes_pruned")
         decode = {
-            "rowgroups": int(flat.get("shuffle.decode_rowgroups", 0)),
-            "rows_pruned": int(
-                flat.get("shuffle.decode_rows_pruned", 0)
+            "rowgroups": rowgroups,
+            # Data-path decode only: the selective plan's audit-key
+            # side read is real decode work but not stream decode —
+            # the acceptance comparison against the dataset's physical
+            # row-group count keys on this figure.
+            "rowgroups_data": rowgroups
+            - sum(
+                v
+                for k, v in rowgroups_by.items()
+                if "schedule=audit-key" in k
             ),
-            "bytes_pruned": int(
-                flat.get("shuffle.decode_bytes_pruned", 0)
-            ),
+            "rows_pruned": rows_pruned,
+            "bytes_pruned": bytes_pruned,
         }
-        if any(decode.values()):
+        if rowgroups_by:
+            decode["rowgroups_by"] = rowgroups_by
+        if any(
+            decode[k] for k in ("rowgroups", "rows_pruned", "bytes_pruned")
+        ):
+            try:
+                import importlib
+
+                _sh = importlib.import_module(
+                    "ray_shuffling_data_loader_tpu.shuffle"
+                )
+                from ray_shuffling_data_loader_tpu.utils import (
+                    shuffle_plan_label,
+                )
+
+                engaged, reason = _sh.selective_reads_decision()
+                decode["plan"] = shuffle_plan_label()
+                # The decline is documented, not silent (ISSUE 12):
+                # under RSDL_SELECTIVE_READS=auto with a rowwise plan
+                # the reason string says the schedule fell back to the
+                # materialized path and why.
+                decode["selective"] = {
+                    "engaged": engaged,
+                    "reason": reason,
+                }
+            except Exception:
+                pass
             result["decode"] = decode
     except Exception:
         pass

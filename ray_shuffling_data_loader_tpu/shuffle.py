@@ -57,6 +57,8 @@ from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
 from ray_shuffling_data_loader_tpu.utils import (
     arrow_decode_threads,
     decode_rowgroup_threads,
+    shuffle_plan_label,
+    shuffle_plan_spec,
 )
 
 
@@ -280,13 +282,16 @@ def _table_to_columns(table) -> Dict[str, np.ndarray]:
     return cols
 
 
-def _note_pruned(schema, group_rows, sel_rows, proj) -> None:
+def _note_pruned(schema, group_rows, sel_rows, proj, labels=None) -> None:
     """Pushdown/selection observability: rows skipped by the row-group
     selection and decoded-bytes avoided by both prunes (column widths at
-    pre-narrowing decode width). One cached boolean when metrics are
-    off; never raises."""
+    pre-narrowing decode width). ``labels`` is the caller's
+    ``{schedule, plan}`` attribution (ISSUE 12) — without it a selective
+    re-read and a materialized decode are indistinguishable in the
+    aggregate. One cached boolean when metrics are off; never raises."""
     if not _metrics.enabled():
         return
+    labels = labels or {}
     try:
         total_rows = int(sum(group_rows))
         proj_bytes = 0
@@ -304,10 +309,13 @@ def _note_pruned(schema, group_rows, sel_rows, proj) -> None:
             total_rows * pruned_col_bytes + rows_pruned * proj_bytes
         )
         if rows_pruned > 0:
-            _metrics.safe_inc("shuffle.decode_rows_pruned", float(rows_pruned))
+            _metrics.safe_inc(
+                "shuffle.decode_rows_pruned", float(rows_pruned), **labels
+            )
         if bytes_pruned > 0:
             _metrics.safe_inc(
-                "shuffle.decode_bytes_pruned", float(bytes_pruned)
+                "shuffle.decode_bytes_pruned", float(bytes_pruned),
+                **labels,
             )
     except Exception:
         pass
@@ -379,6 +387,7 @@ def read_parquet_columns(
     rowgroup_threads: int = 1,
     prof=None,
     count_pruned: bool = True,
+    metric_labels: Optional[Dict[str, str]] = None,
 ) -> ColumnBatch:
     """Decode a Parquet file to contiguous numpy columns (Arrow C++ decode
     stays on host CPUs, per SURVEY §2b). ``columns`` restricts the decode
@@ -419,7 +428,13 @@ def read_parquet_columns(
 
     ``prof``: a :func:`~.telemetry.phases.stage_profiler` — decode cost
     lands as the ``decode:io`` (open + footer) and ``decode:arrow``
-    (decompress + decode + assembly) sub-phases."""
+    (decompress + decode + assembly) sub-phases.
+
+    ``metric_labels``: the caller's ``{schedule, plan}`` attribution on
+    ``shuffle.decode_rowgroups`` and the pruned counters (ISSUE 12) —
+    decode amplification is per-(schedule, plan) in /metrics, so a
+    selective re-read, a materialized decode, and an audit-key side
+    read are distinguishable; None = unlabeled (direct/tool calls)."""
     import pyarrow.parquet as pq
 
     from ray_shuffling_data_loader_tpu.utils import parquet_filesystem
@@ -489,8 +504,11 @@ def read_parquet_columns(
         # selective plan's audit-key-only decode) whose "pruned"
         # columns the run decodes elsewhere anyway — crediting them
         # would fabricate avoided work in the headline counter.
-        _note_pruned(schema, group_rows, sel_rows, proj)
-    _metrics.safe_inc("shuffle.decode_rowgroups", float(len(sel)))
+        _note_pruned(schema, group_rows, sel_rows, proj, metric_labels)
+    _metrics.safe_inc(
+        "shuffle.decode_rowgroups", float(len(sel)),
+        **(metric_labels or {}),
+    )
     with prof.phase("decode:arrow") as ph:
         cols = None
         if rowgroup_threads > 1 and sel:
@@ -559,15 +577,106 @@ def _reduce_seed(seed: int, epoch: int, reducer: int) -> np.random.Generator:
     )
 
 
+def _group_owners(
+    seed: int,
+    epoch: int,
+    file_index: int,
+    group_sizes: Sequence[int],
+    num_reducers: int,
+    granularity: int,
+) -> np.ndarray:
+    """Per-row-group reducer owners under the BLOCK plan family
+    (ISSUE 12): consecutive runs of ``granularity`` row groups form
+    blocks, and blocks are dealt to reducers by a seeded permutation of
+    a balanced round-robin multiset — per-file block counts differ by
+    at most one across reducers, and the seeded start offset keeps the
+    "one extra block" from always landing on the same low reducer
+    indices across files. Every row of a group travels to the group's
+    owner, which is what makes per-reducer row-group selections
+    disjoint (each group decoded exactly once per epoch)."""
+    rng = _map_seed(seed, epoch, file_index)
+    n_groups = len(group_sizes)
+    n_blocks = -(-n_groups // granularity) if n_groups else 0
+    if n_blocks == 0:
+        return np.empty(0, dtype=np.int64)
+    owners = (
+        np.arange(n_blocks, dtype=np.int64)
+        + int(rng.integers(num_reducers))
+    ) % num_reducers
+    rng.shuffle(owners)
+    return np.repeat(owners, granularity)[:n_groups]
+
+
+def _label_of_plan(plan: Tuple[str, int]) -> str:
+    """Metric-label value of a resolved plan spec (``rowwise`` /
+    ``block:G``) — the worker-side twin of
+    :func:`~.utils.shuffle_plan_label`, fed from the plan the DRIVER
+    resolved rather than this process's env."""
+    family, granularity = plan
+    return family if family == "rowwise" else f"block:{granularity}"
+
+
 def _file_assignment(
-    seed: int, epoch: int, file_index: int, n: int, num_reducers: int
+    seed: int,
+    epoch: int,
+    file_index: int,
+    n: int,
+    num_reducers: int,
+    filename: Optional[str] = None,
+    plan: Optional[Tuple[str, int]] = None,
 ) -> np.ndarray:
     """The seeded per-row reducer assignment for one file — THE plan,
     and its ONLY definition: :func:`shuffle_map`, :func:`shuffle_plan`,
     and the selective schedule all call it, so every schedule
-    partitions the same rows to the same reducers by construction."""
-    rng = _map_seed(seed, epoch, file_index)
-    return rng.integers(num_reducers, size=n)
+    partitions the same rows to the same reducers by construction.
+
+    The plan FAMILY is ``RSDL_SHUFFLE_PLAN`` (:func:`shuffle_plan_spec`
+    — the one parser): rowwise draws each row's reducer independently;
+    block expands :func:`_group_owners` over the file's footer
+    row-group sizes (``filename`` required — the block plan is
+    footer-metadata-driven, no data read), so a whole row group lands
+    on one reducer and the selective schedule can prune for real.
+
+    ``plan``: the resolved ``(family, granularity)`` spec. The DRIVER
+    parses the env once per run and threads it through every stage
+    task's arguments — pool workers inherit their env at spawn, so an
+    env-only plan would silently split driver and worker onto different
+    plan families whenever the env changed after ``runtime.init``
+    (schedules would still agree with each other, but auto-selective
+    would prune nothing and every label would lie). None = parse this
+    process's env (direct callers/tools)."""
+    family, granularity = plan if plan is not None else shuffle_plan_spec()
+    if family == "rowwise":
+        rng = _map_seed(seed, epoch, file_index)
+        return rng.integers(num_reducers, size=n)
+    if filename is None:
+        raise ValueError(
+            "block shuffle plan needs the source filename to read "
+            "row-group sizes from the footer (caller bug: a schedule "
+            "did not thread it through)"
+        )
+    sizes = np.asarray(file_row_group_sizes(filename), dtype=np.int64)
+    if int(sizes.sum()) != int(n):
+        raise ValueError(
+            f"block shuffle plan: footer row count {int(sizes.sum())} "
+            f"!= caller row count {n} for {filename!r} (stale decode "
+            "cache or mutated dataset)"
+        )
+    owners = _group_owners(
+        seed, epoch, file_index, sizes, num_reducers, granularity
+    )
+    return np.repeat(owners, sizes)
+
+
+def plan_is_prunable(plan: Optional[Tuple[str, int]] = None) -> bool:
+    """Can the plan family ever skip a row group for a reducer?
+    Rowwise cannot (every group holds rows for every reducer whp —
+    BENCHLOG r11); block plans can by construction. The
+    ``RSDL_SELECTIVE_READS=auto`` gate keys on this. ``plan``: the
+    resolved spec (None = parse this process's env — driver/tool
+    callers only, same rule as :func:`_file_assignment`)."""
+    family, _ = plan if plan is not None else shuffle_plan_spec()
+    return family == "block"
 
 
 def shuffle_map(
@@ -582,8 +691,13 @@ def shuffle_map(
     publish_cache: bool = False,
     stage_tasks: int = 0,
     columns: Optional[Sequence[str]] = None,
+    plan: Optional[Tuple[str, int]] = None,
 ):
     """Map stage: load one file, randomly partition its rows across reducers.
+
+    ``plan``: the driver-resolved ``RSDL_SHUFFLE_PLAN`` spec (see
+    :func:`_file_assignment` — threading it as an argument is what
+    keeps every worker on the driver's plan family).
 
     Returns ``num_reducers`` store refs (reference ``shuffle_map`` returns
     ``num_returns=num_reducers`` object refs, ``shuffle.py:129-168``) —
@@ -614,6 +728,8 @@ def shuffle_map(
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
     prof = _phases.stage_profiler("map", epoch=epoch, file=file_index)
+    if plan is None:
+        plan = shuffle_plan_spec()
     new_cache_ref = None
     if cache_ref is not None:
         with prof.phase("window-fetch") as ph:
@@ -638,6 +754,10 @@ def shuffle_map(
             use_threads=use_threads,
             rowgroup_threads=rg_threads,
             prof=prof,
+            metric_labels={
+                "schedule": "mapreduce",
+                "plan": _label_of_plan(plan),
+            },
         )
         if narrow_to_32:
             with prof.phase("decode:narrow", nbytes=batch.nbytes):
@@ -684,7 +804,9 @@ def shuffle_map(
     # then get an empty partition) and n == 0 — the reference tolerates
     # every size too (reference ``shuffle.py:151-163``).
     n = batch.num_rows
-    assignment = _file_assignment(seed, epoch, file_index, n, num_reducers)
+    assignment = _file_assignment(
+        seed, epoch, file_index, n, num_reducers, filename, plan
+    )
     # Stable group-by-reducer: single-pass counting scatter per column via
     # the C++ kernel (one-argsort-then-gather fallback otherwise), written
     # DIRECTLY into one shared-memory segment; per-reducer partitions are
@@ -759,6 +881,8 @@ def shuffle_plan(
     seed: int,
     cache_ref: ObjectRef,
     stats_collector=None,
+    filename: Optional[str] = None,
+    plan: Optional[Tuple[str, int]] = None,
 ) -> List[ObjectRef]:
     """Index-only map stage for steady-state epochs (no reference analog —
     the reference re-partitions the full data every epoch,
@@ -769,6 +893,10 @@ def shuffle_plan(
     Returns ``num_reducers`` store refs over one ``{"idx"}`` segment whose
     windows are each reducer's within-file row indices in file order,
     exactly the rows (and order) the materialized map's partitions hold.
+
+    ``filename``: the file's source path — required under a block plan
+    (:func:`_file_assignment` reads row-group sizes from the footer;
+    the cached segment alone cannot say where group boundaries fall).
     """
     if _faults.enabled():
         _faults.fire("task.map", epoch=epoch, point="entry")
@@ -784,7 +912,7 @@ def shuffle_plan(
     end_read = timeit.default_timer()
     with prof.phase("plan", nbytes=8 * n):
         assignment = _file_assignment(
-            seed, epoch, file_index, n, num_reducers
+            seed, epoch, file_index, n, num_reducers, filename, plan
         )
         # Stable argsort groups indices by reducer preserving file order —
         # the same stable grouping native.group_rows_multi applies to data.
@@ -839,15 +967,49 @@ def shuffle_plan(
     return refs
 
 
-def _selective_reads_on() -> bool:
-    """The ONE parser of ``RSDL_SELECTIVE_READS`` (default off — the
-    RINAS-style selective schedule is a first cut, opt-in): derive
-    per-reducer intra-file row-group selections from the seeded plan so
-    an epoch reads+decodes only the row groups a window needs, with no
-    map materialization in the store at all."""
-    return os.environ.get(
+def selective_reads_decision(
+    plan: Optional[Tuple[str, int]] = None,
+) -> Tuple[bool, str]:
+    """The ONE parser of ``RSDL_SELECTIVE_READS`` (default off):
+    ``(engage, reason)`` for the RINAS-style selective schedule —
+    per-reducer intra-file row-group selections derived from the seeded
+    plan, no map materialization in the store at all.
+
+    ``auto`` (ISSUE 12) engages only when the plan family is prunable
+    (:func:`plan_is_prunable` — block plans): under a rowwise plan
+    every reducer's selection covers every row group, so selective
+    would silently re-read+decode each file ~R times (BENCHLOG r11
+    measured 282 vs ~70 groups); ``auto`` declines to the materialized
+    path instead and says why — the reason string lands in the decode
+    summary ``bench.py`` embeds. ``on`` is the operator forcing it
+    regardless (the amplification is their call); anything else is
+    off.
+
+    ``plan``: the resolved spec. :func:`shuffle_epoch` passes the one
+    the driver threads through the stage tasks, so the engage decision
+    can never key on a different plan family than the assignment and
+    the metric labels; None = parse this process's env (driver-side
+    summaries/tools)."""
+    plan = plan if plan is not None else shuffle_plan_spec()
+    label = _label_of_plan(plan)
+    mode = os.environ.get(
         "RSDL_SELECTIVE_READS", ""
-    ).strip().lower() in ("1", "on", "true")
+    ).strip().lower()
+    if mode in ("1", "on", "true"):
+        return True, f"forced on (plan={label})"
+    if mode == "auto":
+        if plan_is_prunable(plan):
+            return True, (
+                f"auto: plan {label} is prunable "
+                "(disjoint per-reducer row-group selections)"
+            )
+        return False, (
+            "auto declined: rowwise plan is not prunable — selective "
+            "would re-read every row group ~R times; running the "
+            "materialized schedule (set RSDL_SHUFFLE_PLAN=block to "
+            "engage)"
+        )
+    return False, "off"
 
 
 def shuffle_selective_plan(
@@ -859,6 +1021,7 @@ def shuffle_selective_plan(
     columns: Optional[Sequence[str]] = None,
     narrow_to_32: bool = False,
     stats_collector=None,
+    plan: Optional[Tuple[str, int]] = None,
 ) -> List[int]:
     """Index-only map stage for the SELECTIVE schedule (RINAS,
     PAPERS.md): draws the seeded assignment over the file's footer row
@@ -875,19 +1038,29 @@ def shuffle_selective_plan(
     wall0 = time.time()
     runtime.ensure_initialized()
     prof = _phases.stage_profiler("plan", epoch=epoch, file=file_index)
+    if plan is None:
+        plan = shuffle_plan_spec()
     with prof.phase("decode:io"):
         n = sum(file_row_group_sizes(filename))
     end_read = timeit.default_timer()
     with prof.phase("plan", nbytes=8 * n):
         assignment = _file_assignment(
-            seed, epoch, file_index, n, num_reducers
+            seed, epoch, file_index, n, num_reducers, filename, plan
         )
         counts = np.bincount(assignment, minlength=num_reducers)
     if _audit.enabled():
         key = _audit.key_column_name()
         try:
+            # The key-only side read is labeled schedule=audit-key so
+            # the data path's decode amplification stays attributable:
+            # an audit sweep over every group is audit cost, not a
+            # selective re-read.
             kb = read_parquet_columns(
-                filename, columns=[key], prof=prof, count_pruned=False
+                filename, columns=[key], prof=prof, count_pruned=False,
+                metric_labels={
+                    "schedule": "audit-key",
+                    "plan": _label_of_plan(plan),
+                },
             )
             # Digest what the data path DELIVERS: the reduce side
             # narrows before digesting, and float narrowing changes
@@ -916,6 +1089,52 @@ def shuffle_selective_plan(
     return [int(c) for c in counts]
 
 
+def selective_file_selection(
+    filename: str,
+    file_index: int,
+    reduce_index: int,
+    num_reducers: int,
+    epoch: int,
+    seed: int,
+    plan: Optional[Tuple[str, int]] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One file's selective-read plan for one reducer:
+    ``(row_groups, positions)`` — which row groups hold this reducer's
+    rows under the seeded plan, and where each row lands within the
+    compact decode of just those groups (skipped groups collapse out).
+
+    Derived from THE :func:`_file_assignment` seam, so the selection
+    covers exactly the rows the materialized map would partition to
+    this reducer; under a block plan the selections are additionally
+    DISJOINT across reducers by construction — each group decodes
+    exactly once per epoch instead of ~R times. Shared by
+    :func:`shuffle_selective_reduce` and ``tools/shuffle_profile.py``'s
+    per-plan decode sweep (one command reproduces the amplification
+    numbers)."""
+    sizes = np.asarray(file_row_group_sizes(filename), dtype=np.int64)
+    n = int(sizes.sum())
+    assignment = _file_assignment(
+        seed, epoch, file_index, n, num_reducers, filename, plan
+    )
+    # File-order positions of my rows — identical to the stable
+    # grouping's reducer window (stable argsort preserves within-group
+    # source order).
+    mine = np.flatnonzero(assignment == reduce_index)
+    offs = np.zeros(len(sizes) + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offs[1:])
+    g_idx = np.searchsorted(offs, mine, side="right") - 1
+    gsel = np.unique(g_idx)
+    # Destination base of each SELECTED group in the compact decode
+    # (skipped groups collapse out).
+    base_of = np.zeros(len(sizes), dtype=np.int64)
+    acc = 0
+    for g in gsel:
+        base_of[g] = acc
+        acc += int(sizes[g])
+    pos = base_of[g_idx] + (mine - offs[g_idx])
+    return gsel, pos
+
+
 def shuffle_selective_reduce(
     reduce_index: int,
     epoch: int,
@@ -926,6 +1145,7 @@ def shuffle_selective_reduce(
     columns: Optional[Sequence[str]] = None,
     stats_collector=None,
     pack=None,
+    plan: Optional[Tuple[str, int]] = None,
 ):
     """Reduce stage for the selective schedule: decode ONLY the row
     groups holding this reducer's rows (per-file selections derived
@@ -935,13 +1155,17 @@ def shuffle_selective_reduce(
     in the store beyond the reducer outputs themselves (the RINAS
     property: an epoch is never fully materialized).
 
-    Honesty note on pruning: a row group is skipped only when this
-    reducer drew NONE of its rows, so selections prune aggressively
-    when groups are small relative to ``rows/num_reducers`` and degrade
-    to whole-file decode when every group holds a row for every reducer
-    (documented in TUNING.md). Each file decodes under the row-group
-    plan (``RSDL_DECODE_ROWGROUPS``) and the column projection, so the
-    three decode levers compose."""
+    Pruning by plan family (ISSUE 12): a row group is skipped only when
+    this reducer drew NONE of its rows. Under the rowwise plan that
+    almost never happens — every group holds rows for every reducer, so
+    selections degrade to whole-file decode and the epoch re-reads each
+    file ~R times (the measured BENCHLOG r11 limit). Under a BLOCK plan
+    (``RSDL_SHUFFLE_PLAN=block[:G]``) whole row groups belong to one
+    reducer, selections are disjoint by construction, and each group
+    decodes exactly once per epoch — ``decode_rows_pruned`` engages for
+    real. Each file decodes under the row-group plan
+    (``RSDL_DECODE_ROWGROUPS``) and the column projection, so the three
+    decode levers compose."""
     if _faults.enabled():
         _faults.fire("task.reduce", epoch=epoch, point="entry")
     if stats_collector is not None:
@@ -952,38 +1176,25 @@ def shuffle_selective_reduce(
     prof = _phases.stage_profiler(
         "selective-reduce", epoch=epoch, reducer=reduce_index
     )
+    if plan is None:
+        plan = shuffle_plan_spec()
     from ray_shuffling_data_loader_tpu import native
 
     # Plan every file first (footers are process-cached): which row
     # groups hold my rows, and where each row lands within the compact
-    # decoded selection.
+    # decoded selection (selective_file_selection — the same seeded
+    # seam every schedule partitions with).
     sel_per_file: List[np.ndarray] = []
     pos_per_file: List[np.ndarray] = []
     counts: List[int] = []
     with prof.phase("plan"):
         for i, fname in enumerate(filenames):
-            sizes = np.asarray(file_row_group_sizes(fname), dtype=np.int64)
-            n = int(sizes.sum())
-            assignment = _file_assignment(seed, epoch, i, n, num_reducers)
-            # File-order positions of my rows — identical to the stable
-            # grouping's reducer window (stable argsort preserves
-            # within-group source order).
-            mine = np.flatnonzero(assignment == reduce_index)
-            offs = np.zeros(len(sizes) + 1, dtype=np.int64)
-            np.cumsum(sizes, out=offs[1:])
-            g_idx = np.searchsorted(offs, mine, side="right") - 1
-            gsel = np.unique(g_idx)
-            # Destination base of each SELECTED group in the compact
-            # decode (skipped groups collapse out).
-            base_of = np.zeros(len(sizes), dtype=np.int64)
-            acc = 0
-            for g in gsel:
-                base_of[g] = acc
-                acc += int(sizes[g])
-            pos = base_of[g_idx] + (mine - offs[g_idx])
+            gsel, pos = selective_file_selection(
+                fname, i, reduce_index, num_reducers, epoch, seed, plan
+            )
             sel_per_file.append(gsel)
             pos_per_file.append(pos)
-            counts.append(len(mine))
+            counts.append(len(pos))
     dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
     np.cumsum(counts, out=dst_off[1:])
     total = int(dst_off[-1])
@@ -1002,6 +1213,10 @@ def shuffle_selective_reduce(
             row_groups=[int(g) for g in sel_per_file[i]],
             rowgroup_threads=rg_threads,
             prof=prof,
+            metric_labels={
+                "schedule": "selective",
+                "plan": _label_of_plan(plan),
+            },
         )
         if narrow_to_32:
             with prof.phase("decode:narrow", nbytes=batch.nbytes):
@@ -2303,8 +2518,14 @@ def shuffle_epoch(
     schedule_log: Optional[list] = None,
     device_layout: Optional[dict] = None,
     columns: Optional[Sequence[str]] = None,
+    plan: Optional[Tuple[str, int]] = None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
+
+    ``plan``: the resolved ``(family, granularity)`` shuffle-plan spec
+    (``RSDL_SHUFFLE_PLAN``), threaded into every stage task so workers
+    can never drift onto a different plan family than the driver (their
+    env snapshot dates from pool spawn). None = parse here.
 
     ``device_layout``: device-direct delivery (ROADMAP 3) — a
     ``{"batch": B, "columns": [...]}`` staging layout from the consumer.
@@ -2340,6 +2561,8 @@ def shuffle_epoch(
     # Cluster mode scatters stages across every host's workers; single-host
     # falls back to the local pool (same submit surface).
     pool = runtime.get_context().scheduler
+    if plan is None:
+        plan = shuffle_plan_spec()
     if decode_cache is None:
         decode_cache = _DecodeCache(enabled=False)
     cache_refs = (
@@ -2351,10 +2574,12 @@ def shuffle_epoch(
     )
     if cache_refs is not None:
         schedule = "index"
-    elif _selective_reads_on():
+    elif selective_reads_decision(plan)[0]:
         # RINAS-style selective schedule (ISSUE 11): no map
         # materialization at all — per-file plans return counts only,
         # reducers decode just the row groups their windows need.
+        # Under auto (ISSUE 12) this arm engages only for prunable
+        # (block) plans; rowwise declines to the materialized path.
         schedule = "selective"
     else:
         schedule = "mapreduce"
@@ -2385,6 +2610,8 @@ def shuffle_epoch(
                         seed,
                         cache_refs[i],
                         stats_collector,
+                        filenames[i],
+                        plan,
                     )
                 )
                 map_published.append(False)
@@ -2401,6 +2628,7 @@ def shuffle_epoch(
                         columns,
                         narrow_to_32,
                         stats_collector,
+                        plan,
                     )
                 )
                 map_published.append(False)
@@ -2419,6 +2647,7 @@ def shuffle_epoch(
                     publish,
                     len(filenames),
                     columns,
+                    plan,
                 )
                 if cache_ref is not None:
                     # Locality: run the map on the host that owns the
@@ -2470,6 +2699,8 @@ def shuffle_epoch(
                 seed,
                 cache_refs[i],
                 stats_collector,
+                filenames[i],
+                plan,
             )
         if schedule == "selective":
             return pool.submit(
@@ -2482,6 +2713,7 @@ def shuffle_epoch(
                 columns,
                 narrow_to_32,
                 stats_collector,
+                plan,
             )
         return pool.submit(
             shuffle_map,
@@ -2496,6 +2728,7 @@ def shuffle_epoch(
             publish,
             len(filenames),
             columns,
+            plan,
         )
 
     def _regenerate_cache(j):
@@ -2522,6 +2755,7 @@ def shuffle_epoch(
             True,
             len(filenames),
             columns,
+            plan,
         )
         try:
             part_refs, new_cache = fut.result()
@@ -2686,6 +2920,7 @@ def shuffle_epoch(
                             columns,
                             stats_collector,
                             pack_for[r],
+                            plan,
                         )
                     return pool.submit_local_to(
                         refs_r,
@@ -3020,6 +3255,12 @@ def shuffle(
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
         raise ValueError("no input files to shuffle")
+    # Resolve RSDL_SHUFFLE_PLAN once, driver-side (ISSUE 12): a
+    # malformed value fails fast before any task runs, and the resolved
+    # spec is threaded through every stage task's arguments — workers'
+    # env snapshots date from pool spawn, so an env-only plan could
+    # split driver and workers onto different plan families.
+    plan = shuffle_plan_spec()
     runtime.ensure_initialized()
     _status_begin_trial(
         num_epochs, len(filenames), num_reducers, num_trainers, start_epoch
@@ -3107,6 +3348,7 @@ def shuffle(
                     schedule_log=schedule_log,
                     device_layout=device_layout,
                     columns=columns,
+                    plan=plan,
                 )
             )
         for t in threads:
@@ -3126,6 +3368,7 @@ def shuffle(
             _audit.reconcile(
                 range(start_epoch, num_epochs),
                 stats_collector=stats_collector,
+                plan_label=_label_of_plan(plan),
             )
     except BaseException as exc:
         _status_end_trial(error=f"{type(exc).__name__}: {exc}")

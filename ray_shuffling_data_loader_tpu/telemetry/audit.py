@@ -642,6 +642,14 @@ def _emit_metrics(verdict: dict) -> None:
     reg.gauge("audit.epoch_ok", epoch=epoch).set(
         1.0 if verdict["ok"] else 0.0
     )
+    # Shuffle-quality gauges carry the run's plan family (ISSUE 12):
+    # block plans trade dispersion for prunability, and the tradeoff
+    # must be measurable per run — a quality regression after a plan
+    # switch should name the plan, not hide in an unlabeled gauge. The
+    # label rides the verdict (threaded by the reconcile caller from
+    # the driver-resolved spec); "unknown" when no caller recorded it —
+    # never a silently-wrong "rowwise".
+    plan = verdict.get("plan") or "unknown"
     for name in (
         "adjacent_pair_retention",
         "mean_normalized_displacement",
@@ -650,11 +658,13 @@ def _emit_metrics(verdict: dict) -> None:
     ):
         value = verdict.get(name)
         if value is not None:
-            reg.gauge(f"audit.{name}", epoch=epoch).set(value)
+            reg.gauge(f"audit.{name}", epoch=epoch, plan=plan).set(value)
 
 
 def reconcile(
-    epochs: Optional[Sequence[int]] = None, stats_collector=None
+    epochs: Optional[Sequence[int]] = None,
+    stats_collector=None,
+    plan_label: Optional[str] = None,
 ) -> List[dict]:
     """Fold every visible record into per-epoch verdicts: map-side ==
     reduce-side == delivered-side coverage (and consumed-side when every
@@ -662,7 +672,23 @@ def reconcile(
     Emits ``audit.*`` counters/gauges, forwards each verdict to the stats
     collector (``audit_epoch``), logs mismatches, and — under
     ``RSDL_AUDIT_STRICT`` — raises :class:`AuditError` naming the failing
-    epochs. Idempotent per epoch for the metric side-effects."""
+    epochs. Idempotent per epoch for the metric side-effects.
+
+    ``plan_label``: the run's resolved shuffle-plan family
+    (``rowwise`` / ``block:G``, ISSUE 12) — the driver threads the spec
+    it resolved rather than this process's env, so an offline or
+    env-divergent reconcile cannot mislabel the quality gauges; None
+    falls back to this process's env, and on any parse failure the
+    verdicts carry ``unknown`` (never a silently-wrong default)."""
+    if plan_label is None:
+        try:
+            from ray_shuffling_data_loader_tpu.utils import (
+                shuffle_plan_label,
+            )
+
+            plan_label = shuffle_plan_label()
+        except Exception:
+            plan_label = "unknown"
     flush()  # our own records join the spool view
     recs = _load_records()
     by_epoch: Dict[int, List[dict]] = {}
@@ -762,6 +788,7 @@ def reconcile(
             "consumed_digest": (
                 consumed.hex() if sides["consume"] else None
             ),
+            "plan": plan_label,
         }
         verdict.update(_quality(sample, prev_sample))
         verdict.update(_entropy(sides["map"]))

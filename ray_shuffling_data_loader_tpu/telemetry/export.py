@@ -254,6 +254,28 @@ def _with_source_label(key: str, source: str) -> str:
     return f"{key}{{source={source}}}"
 
 
+def labeled_sum(
+    flat: Dict[str, float], name: str
+) -> Tuple[float, Dict[str, float]]:
+    """``(total, by_label)`` of a counter across its labeled series in a
+    flat :func:`aggregate` view: the bare ``name`` entry plus every
+    ``name{k=v,...}`` series (the :func:`.metrics.format_key` shape —
+    this helper lives beside the key format so callers never re-parse
+    it). ``by_label`` maps the ``{...}`` suffix to its value. The ONE
+    definition for label-aware counter totals (ISSUE 12 put
+    ``{schedule, plan}`` labels on the decode counters; ``bench.py``'s
+    decode summary and the tests both fold through here)."""
+    total, by_label = 0.0, {}
+    prefix = name + "{"
+    for key, value in flat.items():
+        if key == name:
+            total += value
+        elif key.startswith(prefix):
+            total += value
+            by_label[key[len(name):]] = value
+    return total, by_label
+
+
 def aggregate_typed(
     max_age_s: Optional[float] = None,
     include_local: bool = True,

@@ -94,6 +94,53 @@ def decode_rowgroup_threads(stage_tasks: int) -> int:
         return fair if cores >= 2 * concurrent else 1
 
 
+def shuffle_plan_spec():
+    """The ONE parser of ``RSDL_SHUFFLE_PLAN`` — the seeded plan FAMILY
+    every schedule partitions with (ISSUE 12): ``("rowwise", 0)`` or
+    ``("block", G)``.
+
+    * unset / ``rowwise`` — the per-row uniform assignment (every row
+      draws its reducer independently). Maximal dispersion, but every
+      row group holds rows for every reducer, so per-reducer row-group
+      pruning can never engage (BENCHLOG r11's honest limit).
+    * ``block`` / ``block:G`` — row-group-aligned blocks of ``G``
+      consecutive row groups (default 1) are assigned to reducers by a
+      seeded permutation; rows inside a block travel together and the
+      reduce-side full permutation supplies within-reducer randomness
+      (RINAS, PAPERS.md). Per-reducer selections become DISJOINT by
+      construction, so the selective schedule decodes each group
+      exactly once per epoch.
+
+    A malformed value raises: the plan family determines the delivered
+    stream, and silently falling back to a different family would be a
+    reproducibility bug, not a tolerable default. Parsed driver-side
+    before any task is submitted, so the raise is early and loud."""
+    env = os.environ.get("RSDL_SHUFFLE_PLAN", "").strip().lower()
+    if env in ("", "rowwise", "row", "off"):
+        return ("rowwise", 0)
+    if env == "block":
+        return ("block", 1)
+    if env.startswith("block:"):
+        try:
+            g = int(env.split(":", 1)[1])
+        except ValueError:
+            g = 0
+        if g >= 1:
+            return ("block", g)
+    raise ValueError(
+        f"RSDL_SHUFFLE_PLAN={env!r}: expected 'rowwise', 'block', or "
+        "'block:<G>' with integer G >= 1 (row groups per block)"
+    )
+
+
+def shuffle_plan_label() -> str:
+    """The plan family as a metric-label value (``rowwise`` or
+    ``block:G``) — the vocabulary the ``{schedule,plan}``-labeled decode
+    counters and the audit quality gauges share."""
+    family, g = shuffle_plan_spec()
+    return family if family == "rowwise" else f"block:{g}"
+
+
 def is_remote_path(path: str) -> bool:
     """True for URI-style paths (gs://, s3://, ...) that route through a
     non-local filesystem — one definition, shared by Parquet decode and
@@ -140,5 +187,7 @@ __all__ = [
     "is_remote_path",
     "parquet_filesystem",
     "pin_platform",
+    "shuffle_plan_label",
+    "shuffle_plan_spec",
     "timer",
 ]

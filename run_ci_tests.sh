@@ -126,6 +126,23 @@ if [ "$tier" != "slow" ]; then
     RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
     RSDL_FAULTS_SEED=777 \
     python -m pytest tests/test_decode_plane.py -m "not slow" -q -x
+  # Block-plan leg (ISSUE 12): the plan family switched to block:1 with
+  # the selective schedule FORCED ON, under the same audit-STRICT chaos
+  # schedule — exactly-once coverage must hold when the plan family
+  # changes mid-fleet-of-faults, per-reducer row-group selections are
+  # disjoint by construction (each group decoded once per epoch), and
+  # the stream-equality tests prove selective==materialized under the
+  # BLOCK plan too. The shared-cache tests are excluded: a forced
+  # selective schedule never publishes decode-cache segments, so their
+  # epoch-0 index-schedule assertions cannot hold by design.
+  RSDL_SHUFFLE_PLAN=block RSDL_SELECTIVE_READS=on \
+    RSDL_DECODE_ROWGROUPS=2 \
+    RSDL_AUDIT=1 RSDL_AUDIT_STRICT=1 RSDL_AUDIT_DIR="$(mktemp -d)" \
+    RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=888 \
+    python -m pytest tests/test_decode_plane.py -m "not slow" \
+      -k "not shared_cache" -q -x
   # ... and the decode knobs must be invisible to the core data-path
   # suites: forced row-group parallelism + pushdown ride along (shared
   # cache deliberately NOT set here — cross-run cache hits legitimately

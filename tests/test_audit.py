@@ -180,6 +180,79 @@ def test_shuffle_quality_metrics(audit_runtime, audit_dataset):
         assert v["source_entropy_min"] > 0.8
 
 
+def test_shuffle_quality_metrics_block_plan(
+    audit_runtime, tmp_path_factory, monkeypatch
+):
+    """The block plan family's quality-vs-pruning tradeoff gets a
+    regression FENCE, not a BENCHLOG paragraph (ISSUE 12): with
+    RSDL_AUDIT on, a block:1 run at a bench-like shape (blocks per file
+    = 2x reducers) emits retention/displacement/entropy per epoch, the
+    gauges carry the plan label, and every metric stays within the
+    bounds documented in TUNING.md — and within range of the same
+    shape under rowwise."""
+    data_dir = tmp_path_factory.mktemp("audit-block-data")
+    filenames, _ = generate_data(
+        num_rows=2000,
+        num_files=4,
+        num_row_groups_per_file=8,
+        max_row_group_skew=0.0,
+        data_dir=str(data_dir),
+    )
+
+    def run(plan_env):
+        if plan_env is None:
+            monkeypatch.delenv("RSDL_SHUFFLE_PLAN", raising=False)
+        else:
+            monkeypatch.setenv("RSDL_SHUFFLE_PLAN", plan_env)
+        consumer = CollectingConsumer()
+        shuffle(
+            filenames, consumer, num_epochs=3, num_reducers=4,
+            num_trainers=1, seed=13,
+        )
+        return audit.verdicts()
+
+    block = run("block")
+    # Per-epoch emission with RSDL_AUDIT on: every epoch reconciled ok
+    # and carries the quality numbers (retention/displacement need a
+    # prior epoch by definition).
+    assert [v["epoch"] for v in block] == [0, 1, 2]
+    for v in block:
+        assert v["ok"] is True
+        assert v["source_entropy_mean"] is not None
+        assert v["source_entropy_min"] is not None
+    for v in block[1:]:
+        assert v["adjacent_pair_retention"] is not None
+        assert v["mean_normalized_displacement"] is not None
+    # The quality gauges are plan-labeled (observability.md vocabulary).
+    snap = metrics.registry.snapshot()
+    assert (
+        metrics.format_key(
+            "audit.source_entropy_mean", {"epoch": 1, "plan": "block:1"}
+        )
+        in snap
+    )
+    # Documented bounds (TUNING.md RSDL_SHUFFLE_PLAN row): with blocks
+    # per file >= 2R, block:1 keeps a healthy reshuffle profile...
+    for v in block[1:]:
+        assert v["adjacent_pair_retention"] < 0.05
+        assert 0.15 < v["mean_normalized_displacement"] < 0.55
+    for v in block:
+        assert v["source_entropy_min"] > 0.8
+    # ... and stays within range of rowwise at the same shape (the
+    # per-reducer file mix loses at most 0.1 normalized entropy).
+    rowwise = run(None)
+    for vb, vr in zip(block, rowwise):
+        assert vb["source_entropy_mean"] > vr["source_entropy_mean"] - 0.1
+    for vb, vr in zip(block[1:], rowwise[1:]):
+        assert (
+            abs(
+                vb["mean_normalized_displacement"]
+                - vr["mean_normalized_displacement"]
+            )
+            < 0.2
+        )
+
+
 def test_injected_row_drop_detected(audit_runtime, audit_dataset):
     """Acceptance: a test-only delivery fault (one row silently dropped)
     is detected as a digest mismatch with the failing epoch identified —

@@ -379,6 +379,71 @@ def run_decode_sweep(args, thread_counts) -> int:
                 f"{best:>8.3f} {gbps:>7.2f} "
                 f"{row['speedup_vs_1']:>6.2f}x {row['pruned_gb']:>10.3f}"
             )
+    # Per-plan selective sweep (ISSUE 12): one epoch of the selective
+    # schedule's decode work under each plan family, from the very
+    # planning seam the reduce tasks run (selective_file_selection).
+    # Rowwise shows the honest R-fold re-read (every reducer's
+    # selection covers ~every group); block:1 shows disjoint
+    # selections — each group decoded exactly once, amplification ~1x,
+    # pruned GB > 0. This one command reproduces the BENCHLOG r12
+    # amplification claim.
+    phys_groups = sum(
+        len(shuffle_mod.file_row_group_sizes(f)) for f in filenames
+    )
+    reducers = args.reducers
+    full_bytes = sweep[0]["decoded_gb"] * 1e9
+    selective_sweep = []
+    print()
+    print(
+        f"{'plan':>9} {'decoded GB':>10} {'pruned GB':>10} "
+        f"{'groups':>7} {'amp':>6} {'best s':>8}  groups/reducer"
+    )
+    for plan in (("rowwise", 0), ("block", 1)):
+        label = plan[0] if plan[0] == "rowwise" else f"block:{plan[1]}"
+        decoded = 0
+        groups_per_reducer = [0] * reducers
+
+        def _epoch(plan=plan):
+            nonlocal decoded
+            decoded = 0
+            for r in range(reducers):
+                groups_per_reducer[r] = 0
+                for i, fname in enumerate(filenames):
+                    gsel, _pos = shuffle_mod.selective_file_selection(
+                        fname, i, r, reducers, 0, 0, plan
+                    )
+                    groups_per_reducer[r] += len(gsel)
+                    cb = shuffle_mod.read_parquet_columns(
+                        fname,
+                        row_groups=[int(g) for g in gsel],
+                        rowgroup_threads=1,
+                    )
+                    decoded += cb.nbytes
+                    del cb
+
+        best = _best_s(_epoch, repeats=3)
+        groups_total = sum(groups_per_reducer)
+        # Pruned vs the selective schedule's worst case: every
+        # reducer decoding every file whole (what rowwise degrades
+        # to).
+        pruned = max(0, int(reducers * full_bytes - decoded))
+        row = {
+            "plan": label,
+            "decoded_gb": round(decoded / 1e9, 4),
+            "pruned_gb": round(pruned / 1e9, 4),
+            "groups_touched": groups_total,
+            "groups_per_reducer": groups_per_reducer[:],
+            "physical_groups": phys_groups,
+            "amplification": round(groups_total / phys_groups, 3),
+            "best_s": round(best, 4),
+        }
+        selective_sweep.append(row)
+        print(
+            f"{label:>9} {row['decoded_gb']:>10.3f} "
+            f"{row['pruned_gb']:>10.3f} {groups_total:>7d} "
+            f"{row['amplification']:>5.2f}x {best:>8.3f}  "
+            f"{groups_per_reducer}"
+        )
     result = {
         "mode": "decode-sweep",
         "shape": {
@@ -393,6 +458,11 @@ def run_decode_sweep(args, thread_counts) -> int:
         "host_cpus": os.cpu_count(),
         "dataset_disk_gb": round(dataset_bytes / 1e9, 3),
         "sweep": sweep,
+        "selective_sweep": {
+            "reducers": reducers,
+            "physical_groups": phys_groups,
+            "rows": selective_sweep,
+        },
     }
     if args.out:
         with open(args.out, "w") as f:
