@@ -1893,6 +1893,218 @@ def run_tcp_plane_bench() -> dict:
             _shutil.rmtree(d, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Suspend/resume leg (ISSUE 13): SIGKILL the driver mid-window, resume
+# from the write-ahead journal, and price the recovery.
+# ---------------------------------------------------------------------------
+
+_RESUME_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["RSDL_BENCH_RESUME_REPO"])
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+mode = os.environ["RSDL_BENCH_RESUME_MODE"]
+files = json.loads(os.environ["RSDL_BENCH_RESUME_FILES"])
+epochs = int(os.environ["RSDL_BENCH_RESUME_EPOCHS"])
+reducers = int(os.environ["RSDL_BENCH_RESUME_REDUCERS"])
+seed = int(os.environ["RSDL_BENCH_RESUME_SEED"])
+
+runtime.init(num_workers=2)
+t0 = time.perf_counter()
+first = []
+
+
+class Drain(BatchConsumer):
+    def consume(self, rank, epoch, batches, seq=None):
+        if not first:
+            first.append(time.perf_counter() - t0)
+            print("FIRST_BATCH %.4f" % first[0], flush=True)
+        store = runtime.get_context().store
+        for ref in batches:
+            store.free(ref)
+        print("DELIVERED %d %s" % (epoch, seq), flush=True)
+        if mode == "victim":
+            time.sleep(0.15)  # widen the kill window
+
+    def producer_done(self, rank, epoch):
+        pass
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+shuffle(files, Drain(), num_epochs=epochs, num_reducers=reducers,
+        num_trainers=1, seed=seed)
+verdicts = _audit.reconcile(range(epochs)) if _audit.enabled() else []
+snap = _metrics.registry.snapshot() if _metrics.enabled() else {}
+print("RESULT " + json.dumps({
+    "first_batch_s": first[0] if first else None,
+    "verdicts": [
+        {"epoch": v["epoch"], "ok": v["ok"],
+         "delivered_seq": v.get("delivered_seq")} for v in verdicts
+    ],
+    "recovery": {k: v for k, v in snap.items()
+                 if k.startswith("recovery.")},
+}), flush=True)
+runtime.shutdown()
+"""
+
+
+def run_resume_bench() -> dict:
+    """The ``--resume`` leg: a journal-armed driver is SIGKILLed
+    mid-epoch-window, a fresh driver resumes from the write-ahead
+    journal (``RSDL_RESUME=auto``), and the JSON records resume-to-
+    first-batch latency against a cold epoch start plus the resume
+    counters — with per-epoch ``delivered_seq`` digests proven
+    bit-identical to an uninterrupted same-seed control run."""
+    import shutil
+    import signal as _signal
+
+    from ray_shuffling_data_loader_tpu.data_generation import (
+        cached_generate_data,
+    )
+
+    epochs, reducers, seed = 3, 4, SEED
+    num_rows = max(20_000, int(0.05e9) // BYTES_PER_ROW)
+    data_dir = os.path.join(CACHE_DIR, f"resume_r{num_rows}_f4")
+    os.makedirs(data_dir, exist_ok=True)
+    filenames, dataset_bytes = cached_generate_data(
+        num_rows, 4, 1, data_dir, seed=seed
+    )
+    # Data generation brought up a pool in THIS process; the leg's
+    # drivers are child processes with their own runtimes — drop ours
+    # so the kill/resume measurements run against an idle parent.
+    from ray_shuffling_data_loader_tpu import runtime as _runtime
+
+    _runtime.shutdown()
+    work = tempfile.mkdtemp(prefix="rsdl-resume-bench-")
+    journal_dir = os.path.join(work, "journal")
+    spool_ctrl = os.path.join(work, "audit-control")
+    spool_run = os.path.join(work, "audit-run")
+    shm_dir = os.path.join(work, "shm")
+    for d in (journal_dir, spool_ctrl, spool_run, shm_dir):
+        os.makedirs(d, exist_ok=True)
+
+    base_env = dict(
+        os.environ,
+        RSDL_BENCH_RESUME_REPO=os.path.dirname(os.path.abspath(__file__)),
+        RSDL_BENCH_RESUME_FILES=json.dumps(list(filenames)),
+        RSDL_BENCH_RESUME_EPOCHS=str(epochs),
+        RSDL_BENCH_RESUME_REDUCERS=str(reducers),
+        RSDL_BENCH_RESUME_SEED=str(seed),
+        RSDL_SHM_DIR=shm_dir,
+        RSDL_AUDIT="1",
+        RSDL_METRICS="1",
+        JAX_PLATFORMS="cpu",
+    )
+    base_env.pop("RSDL_JOURNAL", None)
+    base_env.pop("RSDL_RESUME", None)
+
+    def _child(mode, extra, kill_after=None):
+        env = dict(base_env, RSDL_BENCH_RESUME_MODE=mode, **extra)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _RESUME_CHILD],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+        first_batch, result, delivered = None, None, 0
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("FIRST_BATCH "):
+                first_batch = float(line.split()[1])
+            elif line.startswith("DELIVERED "):
+                delivered += 1
+                if kill_after is not None and delivered >= kill_after:
+                    os.kill(proc.pid, _signal.SIGKILL)
+                    break
+            elif line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+        proc.wait()
+        return first_batch, result, delivered
+
+    result = {
+        "metric": "Suspend/resume (driver SIGKILLed mid-window)",
+        "plane": "resume",
+        "unit": "s",
+        "dataset_gb": round(dataset_bytes / 1e9, 3),
+        "epochs": epochs,
+    }
+    try:
+        # Control: uninterrupted same-seed run — the digest truth and
+        # the cold first-batch latency.
+        cold_first, ctrl, _ = _child(
+            "control", {"RSDL_AUDIT_DIR": spool_ctrl}
+        )
+        if ctrl is None:
+            result["error"] = "control run died"
+            return result
+        # Victim: journal armed, SIGKILLed after epoch 0's window plus
+        # a couple of epoch-1 deliveries (mid-epoch-window).
+        _child(
+            "victim",
+            {"RSDL_AUDIT_DIR": spool_run, "RSDL_JOURNAL": journal_dir},
+            kill_after=reducers + 2,
+        )
+        # Resume: fresh driver, RSDL_RESUME=auto, strict audit.
+        resume_first, res, _ = _child(
+            "resume",
+            {"RSDL_AUDIT_DIR": spool_run, "RSDL_JOURNAL": journal_dir,
+             "RSDL_RESUME": "auto", "RSDL_AUDIT_STRICT": "1"},
+        )
+        if res is None:
+            result["error"] = "resumed run died"
+            return result
+        ctrl_seq = {v["epoch"]: v["delivered_seq"]
+                    for v in ctrl["verdicts"]}
+        res_seq = {v["epoch"]: v["delivered_seq"]
+                   for v in res["verdicts"]}
+        recovery = res.get("recovery", {})
+
+        def _sum(prefix):
+            return int(sum(v for k, v in recovery.items()
+                           if k.startswith(prefix)))
+
+        result.update({
+            "value": round(resume_first, 4) if resume_first else None,
+            "cold_first_batch_s": (
+                round(cold_first, 4) if cold_first else None
+            ),
+            "resume_to_first_batch_s": (
+                round(resume_first, 4) if resume_first else None
+            ),
+            "resumed_epochs": _sum("recovery.resumed_epochs"),
+            "resumed_epochs_skipped": _sum(
+                "recovery.resume_epochs_skipped"
+            ),
+            "replayed_stages": _sum("recovery.resume_reexecuted"),
+            "reattached_map_stages": _sum("recovery.resume_map_skipped"),
+            "reattached_reduce_stages": _sum(
+                "recovery.resume_reduce_skipped"
+            ),
+            "digest_match": ctrl_seq == res_seq and len(ctrl_seq) == epochs,
+            "audit_ok": all(v["ok"] for v in res["verdicts"]),
+        })
+        if not result["digest_match"]:
+            result["error"] = (
+                f"delivered_seq diverged: control={ctrl_seq} "
+                f"resumed={res_seq}"
+            )
+        elif not result["audit_ok"]:
+            result["error"] = "resumed run audit mismatch"
+        elif not (result["resumed_epochs"]
+                  or result["resumed_epochs_skipped"]):
+            result["error"] = "resume found no journaled progress"
+        return result
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _parse_args(argv=None):
     import argparse
 
@@ -1922,6 +2134,18 @@ def _parse_args(argv=None):
         "per-window latency, and HMAC/framing/pickle overhead vs the "
         "same shape on local shm (plane: \"tcp\" artifact; see "
         "docs/observability.md)",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="run the suspend/resume leg instead of the training bench: "
+        "a journal-armed driver child (RSDL_JOURNAL) is SIGKILLed "
+        "mid-epoch-window, a fresh child resumes with RSDL_RESUME=auto "
+        "under strict audit, and the JSON records resume-to-first-batch "
+        "latency vs the cold start, resumed_epochs/replayed_stages "
+        "counters, and per-epoch delivered_seq digest equality against "
+        "an uninterrupted same-seed control run (plane: \"resume\" "
+        "artifact; see docs/robustness.md)",
     )
     parser.add_argument(
         "--audit",
@@ -1972,6 +2196,25 @@ def main() -> None:
             flush=True,
         )
         sys.exit(1)
+
+    if args.resume:
+        # The suspend/resume leg: self-contained child drivers (own
+        # runtimes, journals, audit spools), same one-JSON-line
+        # contract; a non-zero exit marks a failed capture.
+        try:
+            result = run_resume_bench()
+        except BaseException as exc:  # noqa: BLE001 — the JSON line matters
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            result = {
+                "metric": "Suspend/resume (driver SIGKILLed mid-window)",
+                "plane": "resume",
+                "unit": "s",
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+        print(json.dumps(result), flush=True)
+        sys.exit(1 if "error" in result else 0)
 
     if args.plane == "tcp":
         # The loopback two-host plane bench: self-contained (owns its

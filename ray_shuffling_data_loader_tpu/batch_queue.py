@@ -125,6 +125,15 @@ class _QueueActor:
         # visible in /status and /metrics instead of leaving queue depth
         # as the only (ambiguous) signal.
         self._items_enqueued = 0
+        # Idempotent re-publish (ISSUE 13): journaled deliver threads tag
+        # each reducer publication with its reducer index. The cursor per
+        # (epoch, rank) is the next seq this actor will accept; a resumed
+        # driver re-publishing a reducer that already landed (its crash
+        # fell between this publish and the journal's cursor append) is
+        # dropped whole, so the trainer never sees duplicate rows even
+        # when the queue actor outlived the driver.
+        self._delivery_seq: Dict[Tuple[int, int], int] = {}
+        self._republish_dropped = 0
 
     def register_producer(self, pid: int) -> None:
         self._producer_pid = int(pid)
@@ -193,7 +202,7 @@ class _QueueActor:
             raise Full from None
         self._items_enqueued += 1
 
-    async def put_batch(self, rank, epoch, items, timeout=None):
+    async def put_batch(self, rank, epoch, items, timeout=None, seq=None):
         # All-or-nothing: wait until the queue has room for EVERY item,
         # then enqueue atomically (single-threaded event loop, no awaits
         # between puts). A timeout therefore leaves the queue untouched —
@@ -201,6 +210,15 @@ class _QueueActor:
         # with no way to tell the caller what landed
         # (reference ``batch_queue.py:480-488`` is all-or-nothing only for
         # the nowait variant).
+        if seq is not None and seq < self._delivery_seq.get(
+            (int(epoch), int(rank)), 0
+        ):
+            # Idempotent re-publish (ISSUE 13): this reducer's refs
+            # already landed before the producer's journal cursor did.
+            # False tells the producer so it can free the re-published
+            # refs — nothing will ever consume them.
+            self._republish_dropped += 1
+            return False
         queue = self.queues[epoch][rank]
         items = list(items)
         if self.maxsize > 0 and len(items) > self.maxsize:
@@ -222,7 +240,11 @@ class _QueueActor:
                 for item in items:
                     queue.put_nowait(item)
                 self._items_enqueued += len(items)
-                return
+                if seq is not None:
+                    # Advance only after the enqueue landed: a Full
+                    # timeout leaves both queue and cursor untouched.
+                    self._delivery_seq[(int(epoch), int(rank))] = seq + 1
+                return True
             # Event-driven wait: armed (cleared) atomically with the failed
             # room check — no await separates them, so a consume landing
             # after the check sets the event and the wait returns at once.
@@ -308,6 +330,18 @@ class _QueueActor:
         # harmless (waiters re-check) and covers consumers that ack late.
         self.space_events[epoch][rank].set()
 
+    def restore_delivery_cursors(self, cursors: Dict[str, int]) -> None:
+        """Seed the idempotency cursors on a FRESH actor from a journal
+        (a resumed driver whose previous queue actor died with it).
+        Max-merged — an actor that survived the driver keeps its own,
+        possibly further-advanced, cursors."""
+        for key, seq in cursors.items():
+            e, r = key.split("/")
+            k = (int(e), int(r))
+            self._delivery_seq[k] = max(
+                self._delivery_seq.get(k, 0), int(seq)
+            )
+
     def status_snapshot(self) -> Dict[str, Any]:
         """Live window state for the obs plane's /status page: the
         admission window (in-flight epochs), per-``(epoch, rank)`` queue
@@ -328,6 +362,7 @@ class _QueueActor:
             "producer_pid": self._producer_pid,
             "producer_alive": alive,
             "items_enqueued_total": self._items_enqueued,
+            "republish_dropped_total": self._republish_dropped,
             "depth_total": self.size(),
             "depths": {
                 f"{epoch}/{rank}": q.qsize()
@@ -352,6 +387,9 @@ class _QueueActor:
                 ] = float(q.qsize())
         out["queue.depth.total"] = float(self.size())
         out["queue.items_enqueued.total"] = float(self._items_enqueued)
+        out["queue.republish_dropped.total"] = float(
+            self._republish_dropped
+        )
         return out
 
 
@@ -482,7 +520,12 @@ class BatchQueue:
                 raise ValueError("'timeout' must be a non-negative number")
             self.actor.call("put", rank, epoch, item, timeout)
 
-    def put_batch(self, rank, epoch, items, block=True, timeout=None) -> None:
+    def put_batch(
+        self, rank, epoch, items, block=True, timeout=None, seq=None
+    ):
+        """Returns False when the actor dropped a ``seq``-tagged
+        re-publish below its idempotency cursor — the caller still owns
+        the never-to-be-consumed refs and must free them."""
         if not block:
             try:
                 self.actor.call("put_nowait_batch", rank, epoch, list(items))
@@ -491,7 +534,14 @@ class BatchQueue:
         else:
             if timeout is not None and timeout < 0:
                 raise ValueError("'timeout' must be a non-negative number")
-            self.actor.call("put_batch", rank, epoch, list(items), timeout)
+            return self.actor.call(
+                "put_batch", rank, epoch, list(items), timeout, seq
+            )
+
+    def restore_delivery_cursors(self, cursors: Dict[str, int]) -> None:
+        """Seed the actor's idempotency cursors from a journal (max-
+        merged; see ``_QueueActor.restore_delivery_cursors``)."""
+        self.actor.call("restore_delivery_cursors", dict(cursors))
 
     async def put_async(self, rank, epoch, item, block=True, timeout=None):
         if not block:

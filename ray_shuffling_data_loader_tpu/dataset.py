@@ -370,11 +370,35 @@ class BatchConsumerQueue(BatchConsumer):
     def __init__(self, batch_queue: BatchQueue):
         self._batch_queue = batch_queue
 
-    def consume(self, rank: int, epoch: int, batches: List[ObjectRef]):
-        self._batch_queue.put_batch(rank, epoch, batches)
+    def consume(
+        self,
+        rank: int,
+        epoch: int,
+        batches: List[ObjectRef],
+        seq: Optional[int] = None,
+    ):
+        accepted = self._batch_queue.put_batch(
+            rank, epoch, batches, seq=seq
+        )
+        if accepted is False:
+            # Idempotency drop (a resumed driver re-published a reducer
+            # the surviving queue actor already delivered): nothing will
+            # ever consume these refs, so free them here — or the
+            # re-executed reducer's segments pin shm for the whole run.
+            store = runtime.get_context().store
+            for ref in batches:
+                try:
+                    store.free(ref)
+                except Exception:
+                    pass
 
     def producer_done(self, rank: int, epoch: int):
         self._batch_queue.producer_done(rank, epoch)
+
+    def restore_delivery_cursors(self, cursors) -> None:
+        # Journal resume (runtime/journal.py): seed the queue actor's
+        # idempotency cursors from the journaled delivery state.
+        self._batch_queue.restore_delivery_cursors(cursors)
 
     def wait_until_ready(self, epoch: int):
         self._batch_queue.new_epoch(epoch)

@@ -1,0 +1,751 @@
+"""Durable epoch-state plane: the driver-side write-ahead journal.
+
+Every recovery path before this PR (lineage re-materialization, drain
+re-homing, consumer-side ``BatchCursor`` resume) assumed the *driver*
+survives — its in-flight epoch-window state (plan seed, per-stage
+progress, queue delivery cursors, audit partials) lived only in memory,
+so a preempted driver lost the window and the run had to start over.
+This module makes that state durable, which turns preemption into a
+pause (reproducible-pipelines paper, PAPERS.md):
+
+* **Journal** (``RSDL_JOURNAL=<dir>``): one append-only NDJSON file per
+  run, published atomically (the run-identity header is written to a
+  hidden ``.tmp`` name, fsynced, then renamed — a reader can never see
+  a half-written identity) and appended with flush+fsync at the
+  existing barriers: task-done (map/reduce futures resolving at the
+  driver), the deliver thread (one record per reducer handed to the
+  consumer — the queue delivery cursor), and the epoch reconcile
+  (per-epoch audit verdict digests, which is what ``tools/replay.py``
+  checks against). Write-ahead ordering with the audit spool: the
+  deliver thread flushes its audit partials *before* journaling the
+  cursor, so a cursor that claims "delivered" implies the delivery
+  digest is on disk — a crash between the two merely re-delivers one
+  reducer, which the audit reconciler's ``(rank, reducer, offset)``
+  dedup and the batch queue's idempotent re-publish both absorb.
+
+* **Resume** (``shuffle(resume_from=)`` / ``RSDL_RESUME=auto``): a
+  fresh runtime reconstructs the epoch window from the journal —
+  completed epochs are skipped outright, journaled stage results
+  re-attach to surviving store segments (validated via
+  ``store.exists``; a missing segment degrades to lineage
+  re-materialization or full seeded re-execution), and the delivery
+  cursor skips already-delivered reducers so the per-rank
+  order-sensitive ``delivered_seq`` digest over the whole run is
+  bit-identical to an uninterrupted same-seed run.
+  ``RSDL_RESUME=redeliver`` keeps the stage re-attach but zeroes the
+  delivery cursors — for a consumer that restarts from scratch and
+  needs the in-flight epoch's full stream again (re-deliveries are
+  audit-invisible: re-executed reducers are bit-identical, so their
+  digest records dedup).
+
+* **Suspend** (SIGTERM): with the journal armed, ``shuffle()`` installs
+  a SIGTERM handler (main thread only; never installed when
+  ``RSDL_JOURNAL`` is unset — the zero-overhead contract) that treats
+  the signal as a preemption notice: stop admitting epochs, let each
+  deliver thread finish its current reducer (the quiesce window),
+  flush every spool, journal the suspension, and exit 0. A suspended
+  job is just a paused window; the next ``RSDL_RESUME=auto`` run picks
+  it up.
+
+Zero-overhead off: with ``RSDL_JOURNAL`` unset this module is never
+imported (``shuffle()`` checks the env var before importing), no file
+is created, and no signal handler is installed.
+
+See docs/robustness.md ("Preemption, suspend/resume, and replay") for
+the failure model, the journal format, and the digest-equality proof
+recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_JOURNAL = "RSDL_JOURNAL"
+ENV_RESUME = "RSDL_RESUME"
+ENV_SYNC = "RSDL_JOURNAL_SYNC"
+
+_FORMAT_V = 1
+
+# Identity keys that describe *where* the run happened rather than
+# *what* it was — a resumed run legitimately differs in all of them
+# (fresh session, fresh runtime dir) and the fault schedule may change
+# between attempts without changing the delivered stream (recovery is
+# exactly-once), so validation skips them. They stay recorded: replay
+# needs the fault schedule, re-attach needs the old session.
+_INFORMATIONAL = {
+    "run_id", "ts", "session", "runtime_dir", "shm_dir",
+    "faults", "faults_seed",
+}
+
+
+class RunSuspended(RuntimeError):
+    """``shuffle()`` quiesced and journaled the window instead of
+    finishing — the in-process analog of the SIGTERM handler's
+    exit-0 (tests and embedding drivers catch this; the signal path
+    calls ``os._exit(0)`` after the same flushes)."""
+
+    def __init__(self, journal_path: str):
+        super().__init__(
+            f"run suspended; epoch window journaled at {journal_path} "
+            "(resume with RSDL_RESUME=auto)"
+        )
+        self.journal_path = journal_path
+
+
+def journal_dir() -> Optional[str]:
+    """The journal directory (``RSDL_JOURNAL``), or None when the plane
+    is off. Read per call — journal decisions happen a handful of times
+    per run, never on the data path."""
+    return os.environ.get(ENV_JOURNAL) or None
+
+
+def enabled() -> bool:
+    return journal_dir() is not None
+
+
+def _sync_enabled() -> bool:
+    """fsync-per-append (default on — the WAL contract). ``off`` trades
+    durability of the last few records for latency on hosts where the
+    journal dir is on slow media; the atomic header publish keeps."""
+    return os.environ.get(ENV_SYNC, "").strip().lower() not in (
+        "off", "0", "false"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ref serialization (store ObjectRefs <-> JSON)
+# ---------------------------------------------------------------------------
+
+
+def ref_to_json(ref) -> dict:
+    out: Dict[str, Any] = {
+        "id": ref.object_id,
+        "nbytes": int(ref.nbytes),
+        "session": ref.session,
+    }
+    if ref.owner is not None:
+        out["owner"] = list(ref.owner)
+    if ref.rows is not None:
+        out["rows"] = [int(ref.rows[0]), int(ref.rows[1])]
+    return out
+
+
+def ref_from_json(d: dict):
+    from ray_shuffling_data_loader_tpu.runtime.store import ObjectRef
+
+    return ObjectRef(
+        object_id=str(d["id"]),
+        nbytes=int(d.get("nbytes", 0)),
+        session=str(d.get("session", "")),
+        owner=tuple(d["owner"]) if d.get("owner") else None,
+        rows=tuple(d["rows"]) if d.get("rows") else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Run identity
+# ---------------------------------------------------------------------------
+
+
+def run_identity(
+    filenames: List[str],
+    num_epochs: int,
+    num_reducers: int,
+    num_trainers: int,
+    seed: int,
+    start_epoch: int,
+    narrow_to_32: bool,
+    plan: str,
+    columns: Optional[List[str]],
+    device_layout: Optional[dict],
+) -> dict:
+    """The run's stream identity — everything that determines the
+    delivered batch stream (validated on resume; a mismatch REFUSES to
+    resume, like ``BatchCursor.validate``) plus informational context
+    (session/runtime/fault schedule — recorded for re-attach and
+    replay, excluded from validation)."""
+    from ray_shuffling_data_loader_tpu import runtime
+
+    def _abs(f: str) -> str:
+        return f if "://" in f else os.path.abspath(f)
+
+    identity: Dict[str, Any] = {
+        "v": _FORMAT_V,
+        "seed": int(seed),
+        "num_epochs": int(num_epochs),
+        "num_reducers": int(num_reducers),
+        "num_trainers": int(num_trainers),
+        "start_epoch": int(start_epoch),
+        "filenames": [_abs(f) for f in filenames],
+        "narrow_to_32": bool(narrow_to_32),
+        "plan": str(plan),
+        "columns": list(columns) if columns is not None else None,
+        "device_batch": (
+            int(device_layout["batch"]) if device_layout else None
+        ),
+        "device_columns": (
+            [str(c) for c in device_layout["columns"]]
+            if device_layout
+            else None
+        ),
+        # Informational (not validated):
+        "faults": os.environ.get("RSDL_FAULTS") or None,
+        "faults_seed": os.environ.get("RSDL_FAULTS_SEED") or None,
+    }
+    try:
+        ctx = runtime.get_context()
+        identity["session"] = ctx.session
+        identity["runtime_dir"] = ctx.runtime_dir
+        identity["shm_dir"] = ctx.store.shm_dir
+    except Exception:
+        pass
+    return identity
+
+
+def validate_identity(recorded: dict, current: dict) -> None:
+    """Refuse a resume that would change the batch stream: every
+    non-informational identity field must match (the driver-side twin
+    of ``BatchCursor.validate``)."""
+    keys = (set(recorded) | set(current)) - _INFORMATIONAL
+    diff = {
+        k: (recorded.get(k), current.get(k))
+        for k in sorted(keys)
+        if recorded.get(k) != current.get(k)
+    }
+    if diff:
+        raise ValueError(
+            "journal run identity does not match this shuffle call; "
+            f"resuming would change the batch stream: {diff}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Run state (the fold of one journal file)
+# ---------------------------------------------------------------------------
+
+
+class EpochState:
+    """One epoch's journaled progress."""
+
+    __slots__ = (
+        "epoch", "schedule", "maps", "reduces", "delivered",
+        "rank_rows", "sampled", "done",
+    )
+
+    def __init__(self, epoch: int):
+        self.epoch = int(epoch)
+        self.schedule: Optional[str] = None
+        # file index -> {"refs": [refdict]|None, "counts": [int]|None,
+        #               "cache_ref": refdict|None}
+        self.maps: Dict[int, dict] = {}
+        # reducer -> [refdict, ...] (one for legacy columnar, up to
+        # three for device-direct head/body/tail)
+        self.reduces: Dict[int, List[dict]] = {}
+        self.delivered = 0  # delivery cursor: reducers 0..delivered-1
+        self.rank_rows: Dict[int, int] = {}  # rank -> delivered rows
+        self.sampled = 0  # rank-0 audit quality-sample keys taken
+        self.done = False
+
+
+class RunState:
+    """The fold of one journal file: identity + per-epoch progress."""
+
+    def __init__(self, path: str, run_id: str, identity: dict):
+        self.path = path
+        self.run_id = run_id
+        self.identity = identity
+        self.epochs: Dict[int, EpochState] = {}
+        self.done = False
+        self.suspended = False
+        self.superseded = False
+        self.verdicts: Dict[int, dict] = {}
+
+    def epoch(self, e: int) -> EpochState:
+        return self.epochs.setdefault(int(e), EpochState(e))
+
+    def resumable(self) -> bool:
+        return not self.done and not self.superseded
+
+    def apply(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind == "epoch":
+            st = self.epoch(rec["epoch"])
+            st.schedule = rec.get("schedule") or st.schedule
+        elif kind == "map":
+            self.epoch(rec["epoch"]).maps[int(rec["file"])] = {
+                "refs": rec.get("refs"),
+                "counts": rec.get("counts"),
+                "cache_ref": rec.get("cache_ref"),
+            }
+        elif kind == "reduce":
+            self.epoch(rec["epoch"]).reduces[int(rec["reducer"])] = list(
+                rec.get("refs") or []
+            )
+        elif kind == "deliver":
+            st = self.epoch(rec["epoch"])
+            r = int(rec["reducer"])
+            # Delivery is reducer-ordered, so the cursor is a prefix.
+            st.delivered = max(st.delivered, r + 1)
+            rank = int(rec.get("rank", 0))
+            st.rank_rows[rank] = (
+                st.rank_rows.get(rank, 0) + int(rec.get("rows", 0))
+            )
+            st.sampled = max(st.sampled, int(rec.get("sampled", 0)))
+        elif kind == "epoch-done":
+            self.epoch(rec["epoch"]).done = True
+        elif kind == "verdict":
+            self.verdicts[int(rec["epoch"])] = {
+                k: v for k, v in rec.items() if k != "kind"
+            }
+        elif kind == "suspended":
+            self.suspended = True
+        elif kind == "done":
+            self.done = True
+        elif kind == "superseded":
+            self.superseded = True
+
+    def iter_records(self, carry_cursors: bool = True):
+        """Re-emit this state as journal records (the carry-forward a
+        resumed run writes so its own journal is self-contained — a
+        second preemption resumes from the NEW journal alone). With
+        ``carry_cursors=False`` the delivery cursors are dropped
+        (``redeliver`` mode: the in-flight epochs' streams will be
+        re-delivered in full)."""
+        for e in sorted(self.epochs):
+            st = self.epochs[e]
+            if st.schedule is not None:
+                yield {"kind": "epoch", "epoch": e, "schedule": st.schedule}
+            for i in sorted(st.maps):
+                m = st.maps[i]
+                rec = {"kind": "map", "epoch": e, "file": i, "carried": 1}
+                if m.get("refs") is not None:
+                    rec["refs"] = m["refs"]
+                if m.get("counts") is not None:
+                    rec["counts"] = m["counts"]
+                if m.get("cache_ref") is not None:
+                    rec["cache_ref"] = m["cache_ref"]
+                yield rec
+            for r in sorted(st.reduces):
+                yield {
+                    "kind": "reduce", "epoch": e, "reducer": r,
+                    "refs": st.reduces[r], "carried": 1,
+                }
+            if carry_cursors and st.delivered > 0:
+                # Collapse the per-reducer delivery history into one
+                # synthetic record per rank: the fold only needs the
+                # cursor (max reducer + 1) and the per-rank row
+                # offsets, both of which survive the collapse.
+                rank_rows = dict(st.rank_rows) or {0: 0}
+                for rank, rows in sorted(rank_rows.items()):
+                    yield {
+                        "kind": "deliver", "epoch": e,
+                        "reducer": st.delivered - 1,
+                        "rank": rank, "rows": int(rows),
+                        "sampled": st.sampled, "carried": 1,
+                    }
+            if st.done:
+                yield {"kind": "epoch-done", "epoch": e, "carried": 1}
+        for e in sorted(self.verdicts):
+            yield {"kind": "verdict", "carried": 1, **self.verdicts[e]}
+
+
+def load_run(path: str) -> RunState:
+    """Fold one journal file into a :class:`RunState`. The first record
+    must be the run-identity header (atomic publish guarantees it);
+    torn tail lines (a crash mid-append) are skipped."""
+    state: Optional[RunState] = None
+    with open(path) as f:
+        for line in f:
+            if not line.endswith("\n"):
+                break  # torn tail mid-append
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if state is None:
+                if rec.get("kind") != "run":
+                    raise ValueError(
+                        f"{path!r} is not a run journal (no identity "
+                        "header)"
+                    )
+                state = RunState(
+                    path, str(rec.get("run_id", "?")),
+                    dict(rec.get("identity") or {}),
+                )
+                continue
+            state.apply(rec)
+    if state is None:
+        raise ValueError(f"{path!r} is empty or torn before its header")
+    return state
+
+
+def _run_files(directory: str) -> List[str]:
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = [
+        os.path.join(directory, n)
+        for n in names
+        if n.startswith("run-") and n.endswith(".ndjson")
+    ]
+
+    def _mtime(p: str) -> float:
+        # A journal pruned between listdir and here must not crash
+        # auto-discovery (load_run tolerates the same race below).
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    out.sort(key=_mtime, reverse=True)
+    return out
+
+
+def find_resumable(
+    directory: str, identity: dict
+) -> Optional[RunState]:
+    """The newest incomplete (not done, not superseded) run in
+    ``directory`` whose identity matches — ``RSDL_RESUME=auto``'s
+    discovery. Non-matching runs are skipped silently (they are
+    different runs, not errors)."""
+    for path in _run_files(directory):
+        try:
+            state = load_run(path)
+        except (OSError, ValueError):
+            continue
+        if not state.resumable():
+            continue
+        try:
+            validate_identity(state.identity, identity)
+        except ValueError:
+            continue
+        return state
+    return None
+
+
+def resolve_resume(
+    resume_from: Optional[str], identity: dict
+) -> Tuple[Optional[RunState], str]:
+    """``(state, mode)`` for this shuffle call. ``resume_from`` (a
+    journal file, a journal dir, or ``"auto"``/``"redeliver"``) wins
+    over ``RSDL_RESUME``; an explicit path with a mismatched identity
+    RAISES (the refusal path), while auto discovery just starts fresh.
+    Modes: ``cursor`` (skip already-delivered reducers — digest
+    continuity) or ``redeliver`` (zero the cursors — a restarted
+    consumer needs the in-flight epochs' full streams)."""
+    spec = resume_from if resume_from is not None else (
+        os.environ.get(ENV_RESUME) or ""
+    )
+    spec = str(spec).strip()
+    if not spec or spec.lower() in ("0", "off", "false"):
+        return None, "cursor"
+    mode = "cursor"
+    low = spec.lower()
+    if low in ("auto", "1", "on", "true", "cursor"):
+        directory = journal_dir()
+        if not directory or not os.path.isdir(directory):
+            return None, mode
+        return find_resumable(directory, identity), mode
+    if low == "redeliver":
+        mode = "redeliver"
+        directory = journal_dir()
+        if not directory or not os.path.isdir(directory):
+            return None, mode
+        state = find_resumable(directory, identity)
+        if state is not None:
+            _zero_cursors(state)
+        return state, mode
+    # Explicit path (file or dir): identity mismatch must refuse loudly.
+    path = spec
+    if os.path.isdir(path):
+        files = _run_files(path)
+        if not files:
+            raise ValueError(f"no run journals under {path!r}")
+        path = files[0]
+    state = load_run(path)
+    validate_identity(state.identity, identity)
+    if not state.resumable():
+        raise ValueError(
+            f"journal {path!r} records a completed (or superseded) run; "
+            "nothing to resume"
+        )
+    return state, mode
+
+
+def _zero_cursors(state: RunState) -> None:
+    for st in state.epochs.values():
+        if not st.done:
+            st.delivered = 0
+            st.rank_rows = {}
+            st.sampled = 0
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+
+class RunJournal:
+    """Appender for one run's journal file (thread-safe: the deliver
+    threads of concurrent in-flight epochs all append)."""
+
+    def __init__(self, path: str, run_id: str):
+        self.path = path
+        self.run_id = run_id
+        # Set by shuffle() on a resumed run; cleared (with the
+        # recovery.resume_in_progress gauge) at the first delivery.
+        self.resume_pending = False
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+        self._sync = _sync_enabled()
+        self._closed = False
+
+    def append(self, kind: str, **fields: Any) -> None:
+        rec = {"kind": kind, "ts": time.time(), **fields}
+        try:
+            with self._lock:
+                if self._closed:
+                    return
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+                if self._sync:
+                    os.fsync(self._f.fileno())
+        except OSError:
+            # The journal must never sink the run it protects; a failed
+            # append merely widens the re-execution window on resume.
+            logger.warning("journal append failed", exc_info=True)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._f.flush()
+                if self._sync:
+                    os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._f.close()
+
+
+_current_lock = threading.Lock()
+_current: Optional[RunJournal] = None
+
+
+def current() -> Optional[RunJournal]:
+    return _current
+
+
+def current_run_id() -> Optional[str]:
+    j = _current
+    return j.run_id if j is not None else None
+
+
+def begin_run(
+    identity: dict,
+    resume: Optional[RunState] = None,
+    mode: str = "cursor",
+) -> RunJournal:
+    """Create (atomic publish) this run's journal and make it current.
+    With ``resume``, the prior run's folded state is carried forward so
+    the new journal is self-contained, and the prior journal is marked
+    superseded (a later ``RSDL_RESUME=auto`` must find THIS run, not
+    race back to the old one)."""
+    global _current
+    directory = journal_dir() or (
+        os.path.dirname(resume.path) if resume is not None else None
+    )
+    if not directory:
+        raise ValueError("RSDL_JOURNAL is not set")
+    os.makedirs(directory, exist_ok=True)
+    run_id = f"{int(time.time() * 1000):013d}-{os.getpid()}-{secrets.token_hex(3)}"
+    path = os.path.join(directory, f"run-{run_id}.ndjson")
+    tmp = path + ".tmp"
+    header = {
+        "kind": "run",
+        "run_id": run_id,
+        "ts": time.time(),
+        "identity": identity,
+    }
+    with open(tmp, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    journal = RunJournal(path, run_id)
+    if resume is not None:
+        journal.append("resumed", from_run=resume.run_id)
+        for rec in resume.iter_records(carry_cursors=(mode == "cursor")):
+            journal.append(rec.pop("kind"), **rec)
+        try:
+            with open(resume.path, "a") as f:
+                f.write(
+                    json.dumps(
+                        {
+                            "kind": "superseded",
+                            "by": run_id,
+                            "ts": time.time(),
+                        }
+                    )
+                    + "\n"
+                )
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            logger.warning(
+                "could not mark %s superseded", resume.path, exc_info=True
+            )
+    with _current_lock:
+        _current = journal
+    return journal
+
+
+def end_run(journal: RunJournal, status: str = "done") -> None:
+    """Seal a run: ``done`` marks it complete (never resumed again);
+    any other status just closes the file, leaving it resumable."""
+    global _current
+    if status == "done":
+        journal.append("done")
+    journal.close()
+    with _current_lock:
+        if _current is journal:
+            _current = None
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM graceful suspend
+# ---------------------------------------------------------------------------
+
+_suspend_event = threading.Event()
+_suspend_exit = threading.Event()
+_handler_installed = False
+_prev_handler: Any = None
+
+
+def install_sigterm_handler() -> None:
+    """Install the preemption-notice handler (idempotent). Only
+    possible from the main thread (``signal.signal`` raises elsewhere —
+    e.g. when ``ShufflingDataset`` drives the shuffle on a daemon
+    thread); callers that cannot install still get programmatic
+    suspend via :func:`request_suspend`."""
+    global _handler_installed, _prev_handler
+    if _handler_installed:
+        return
+    try:
+        _prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        _handler_installed = True
+    except ValueError:
+        logger.info(
+            "journal: not on the main thread; SIGTERM suspend handler "
+            "not installed (programmatic request_suspend still works)"
+        )
+
+
+def _on_sigterm(signum, frame) -> None:
+    if _current is not None:
+        # Preemption notice: quiesce, flush, exit 0 — driven by the
+        # shuffle driver's loops, not from signal context.
+        request_suspend(exit_process=True)
+        return
+    # No journaled run in flight: behave like the pre-existing world.
+    prev = _prev_handler
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_DFL:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def request_suspend(exit_process: bool = False) -> None:
+    """Ask the in-flight run to suspend at the next barrier. The
+    deliver threads finish their current reducer (the quiesce window),
+    the driver stops admitting epochs, flushes every spool, journals
+    the suspension, and then either exits 0 (``exit_process`` — the
+    SIGTERM path) or raises :class:`RunSuspended`."""
+    if exit_process:
+        _suspend_exit.set()
+    _suspend_event.set()
+
+
+def suspend_requested() -> bool:
+    return _suspend_event.is_set()
+
+
+def suspend_should_exit() -> bool:
+    return _suspend_exit.is_set()
+
+
+def clear_suspend() -> None:
+    _suspend_event.clear()
+    _suspend_exit.clear()
+
+
+# ---------------------------------------------------------------------------
+# Resume observability (counters/gauge/events vocabulary:
+# docs/observability.md)
+# ---------------------------------------------------------------------------
+
+
+def set_resume_in_progress(active: bool) -> None:
+    """The ``recovery.resume_in_progress`` gauge (1 from resume start
+    until the resumed run delivers its first reducer) — what the
+    ``resume_stalled`` SLO rule watches. Metrics-gated, never raises."""
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import (
+            metrics as _metrics,
+        )
+
+        if not _metrics.enabled():
+            return
+        _metrics.registry.gauge("recovery.resume_in_progress").set(
+            1.0 if active else 0.0
+        )
+    except Exception:
+        pass
+
+
+def suspend_and_exit(journal: RunJournal) -> None:
+    """The tail of the SIGTERM path, called by ``shuffle()`` after the
+    window quiesced and the suspension is journaled: flush every
+    telemetry spool that normally drains at atexit, then leave with
+    exit code 0 *without* running teardown — the store segments ARE
+    the suspended window and must survive for the resume."""
+    try:
+        from ray_shuffling_data_loader_tpu import telemetry as _t
+
+        _t.audit.safe_flush()
+        _t.export.safe_flush()
+        _t.safe_flush()
+    except Exception:
+        pass
+    for mod_name in (
+        "ray_shuffling_data_loader_tpu.telemetry.events",
+        "ray_shuffling_data_loader_tpu.telemetry.capacity",
+        "ray_shuffling_data_loader_tpu.telemetry.stragglers",
+    ):
+        import sys as _sys
+
+        mod = _sys.modules.get(mod_name)
+        if mod is not None:
+            try:
+                mod.safe_flush()
+            except Exception:
+                pass
+    journal.close()
+    os._exit(0)

@@ -1454,9 +1454,24 @@ class ObjectStore:
                             stats.spill_bytes += st.st_size
         return stats
 
-    def cleanup(self) -> None:
-        _ledger_note("cleanup", self.session)
-        prefix = f"{self.session}-"
+    def cleanup(
+        self, session: Optional[str] = None, keep=()
+    ) -> None:
+        """Reclaim every segment a session produced. Defaults to THIS
+        session; passing another session id sweeps a *superseded* one —
+        a resumed run (runtime/journal.py) re-attaches the preempted
+        driver's surviving segments and owns their reclamation, since
+        the session that created them can no longer clean up. ``keep``
+        names object ids to spare (segments the resumed run re-attached
+        and promoted into the shared decode-cache tier must outlive
+        their creating session)."""
+        own = session is None or session == self.session
+        session = session or self.session
+        keep = frozenset(keep)
+        if own and not keep:
+            # The blanket op: the ledger fold drops everything live.
+            _ledger_note("cleanup", session)
+        prefix = f"{session}-"
         for dirpath in (self.shm_dir, self.spill_dir):
             try:
                 names = os.listdir(dirpath)
@@ -1464,8 +1479,17 @@ class ObjectStore:
                 continue
             for name in names:
                 if name.startswith(prefix):
+                    if name in keep:
+                        continue
                     try:
                         os.unlink(os.path.join(dirpath, name))
                     except FileNotFoundError:
                         pass
-        self._foreign.clear()
+                    if not own or keep:
+                        # Per-name deletes, not the blanket cleanup op:
+                        # sweeping a superseded session must not zero
+                        # the CURRENT session's live fold (and a kept
+                        # segment must stay live in it).
+                        _ledger_note("delete", name)
+        if own:
+            self._foreign.clear()

@@ -193,7 +193,16 @@ class BatchConsumer:
     ``shuffle.py:11-43``)."""
 
     def consume(self, rank: int, epoch: int, batches: List[ObjectRef]):
-        """Consume the provided batches for the given trainer and epoch."""
+        """Consume the provided batches for the given trainer and epoch.
+
+        Implementations MAY accept an optional ``seq`` keyword (the
+        producing reducer's index): a journal-armed shuffle
+        (``RSDL_JOURNAL``, runtime/journal.py) then tags each delivery
+        so queue-backed consumers can drop an idempotent re-publish
+        after a driver preemption. Consumers with the plain 3-arg
+        signature keep working — they just keep the one-reducer
+        re-delivery window on resume.
+        """
         raise NotImplementedError
 
     def producer_done(self, rank: int, epoch: int):
@@ -1975,16 +1984,146 @@ def shuffle_reduce(
 
 
 class _ResolvedMapResult:
-    """A pre-resolved stand-in for a publishing map's TaskFuture, used
-    when lineage recovery regenerates a decode-cache segment
-    synchronously: registered into :class:`_DecodeCache` so later
-    epochs' ``claim_or_wait``/``hot_refs`` resolve to the NEW ref."""
+    """A pre-resolved stand-in for a stage task's TaskFuture: lineage
+    recovery registers one into :class:`_DecodeCache` when it
+    regenerates a decode-cache segment synchronously, and the journal
+    resume path (ISSUE 13) uses them to re-attach journaled map/reduce
+    results to surviving store segments without re-executing the
+    task."""
 
     def __init__(self, value):
         self._value = value
 
     def result(self, timeout=None):
         return self._value
+
+
+# ---------------------------------------------------------------------------
+# Durable epoch-state plane (ISSUE 13): journal re-attach helpers
+# ---------------------------------------------------------------------------
+# Everything here is called only when a journal is armed (RSDL_JOURNAL /
+# an explicit resume_from), so the lazy journal import inside never
+# loads on a plain run — the zero-overhead contract.
+
+
+def _journaled_refs(ref_dicts) -> Optional[list]:
+    """Reconstructed store refs for one journaled stage result, when
+    EVERY ref still resolves in the store (``store.exists``) — else
+    None, and the caller re-executes the stage (lineage/full seeded
+    re-execution; the delivered stream is identical either way)."""
+    from ray_shuffling_data_loader_tpu.runtime import journal as _journal
+
+    try:
+        store = runtime.get_context().store
+        refs = [_journal.ref_from_json(d) for d in ref_dicts]
+        if refs and all(store.exists(r) for r in refs):
+            return refs
+    except Exception:
+        pass
+    return None
+
+
+def _seed_decode_cache_from_journal(decode_cache, resume_state) -> None:
+    """Re-attach journaled decode-cache segments on resume: the newest
+    surviving cache ref per file is registered so resumed epochs skip
+    Parquet decode (and the index schedule can re-engage). A dead
+    segment is simply not seeded — the claim path re-decodes."""
+    from ray_shuffling_data_loader_tpu.runtime import journal as _journal
+
+    store = runtime.get_context().store
+    best: Dict[int, ObjectRef] = {}
+    for e in sorted(resume_state.epochs):
+        for i, m in resume_state.epochs[e].maps.items():
+            d = m.get("cache_ref")
+            if not d:
+                continue
+            try:
+                ref = _journal.ref_from_json(d)
+                if store.exists(ref):
+                    best[int(i)] = ref
+            except Exception:
+                continue
+    for i, ref in best.items():
+        decode_cache.register(i, _ResolvedMapResult((None, ref)))
+        _metrics.safe_inc(
+            "recovery.resume_refs_reattached", stage="decode-cache"
+        )
+
+
+def _iter_journaled_ref_dicts(resume_state):
+    """Every journaled ref dict in a folded run state — map partition
+    refs, decode-cache refs, and reduce outputs. The ONE traversal both
+    sweep helpers below share, so a journal-record shape change cannot
+    silently desynchronize them."""
+    for st in resume_state.epochs.values():
+        for m in st.maps.values():
+            for d in m.get("refs") or []:
+                yield d
+            if m.get("cache_ref"):
+                yield m["cache_ref"]
+        for refs in st.reduces.values():
+            for d in refs:
+                yield d
+
+
+def _free_superseded_refs(resume_state) -> None:
+    """Reclaim a same-session (in-process) superseded attempt's
+    leftovers at the end of the resumed run: journaled refs the resume
+    did NOT re-attach (re-executed stages publish fresh objects, so the
+    old segments have no other owner) would otherwise linger until
+    session cleanup. Refs the run DID re-attach are already freed
+    through the normal delivery / decode-cache paths by now
+    (``store.free`` is a no-op on missing segments) — except those
+    promoted into the shared decode-cache registry, which must outlive
+    this run and are spared."""
+    from ray_shuffling_data_loader_tpu.runtime import journal as _journal
+
+    with _SHARED_CACHE_LOCK:
+        keep = {ref.object_id for ref in _SHARED_CACHE.values()}
+    ref_dicts: Dict[str, dict] = {
+        d["id"]: d for d in _iter_journaled_ref_dicts(resume_state)
+    }
+    stale = [
+        _journal.ref_from_json(d)
+        for oid, d in ref_dicts.items()
+        if oid not in keep
+    ]
+    if stale:
+        try:
+            runtime.get_context().store.free(stale)
+            _metrics.safe_inc(
+                "recovery.superseded_refs_freed", len(stale)
+            )
+        except Exception:
+            pass
+
+
+def _sweep_superseded(resume_state) -> None:
+    """End-of-run reclamation of everything the preempted attempt(s)
+    left behind. Dead sessions — the predecessor's, and any older ones
+    whose refs were carried through a chain of preemptions — are swept
+    whole by prefix (their creating drivers are gone, so reclamation
+    falls to us and the capacity ledger's residency folds to zero),
+    sparing segments promoted into the shared decode-cache tier, which
+    must outlive the session that created them. A same-session
+    (in-process) predecessor has no prefix of its own to sweep; its
+    un-reattached journaled refs are freed individually instead."""
+    store = runtime.get_context().store
+    cur = store.session
+    with _SHARED_CACHE_LOCK:
+        spare = {ref.object_id for ref in _SHARED_CACHE.values()}
+    sessions = {resume_state.identity.get("session")}
+    sessions.update(
+        d.get("session") for d in _iter_journaled_ref_dicts(resume_state)
+    )
+    for s in sessions:
+        if s and s != cur:
+            try:
+                store.cleanup(session=s, keep=spare)
+            except Exception:
+                pass
+    if resume_state.identity.get("session") == cur:
+        _free_superseded_refs(resume_state)
 
 
 # -- cross-epoch shared decode-cache tier (ISSUE 11) ------------------------
@@ -2519,8 +2658,20 @@ def shuffle_epoch(
     device_layout: Optional[dict] = None,
     columns: Optional[Sequence[str]] = None,
     plan: Optional[Tuple[str, int]] = None,
+    journal=None,
+    est=None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
+
+    ``journal``/``est`` (ISSUE 13): the run's
+    :class:`~.runtime.journal.RunJournal` and this epoch's journaled
+    :class:`~.runtime.journal.EpochState` from a resumed run. With a
+    journal, stage completions and delivery cursors are appended at
+    the existing barriers; with an ``est``, journaled stage results
+    re-attach to surviving store segments (``store.exists``-validated,
+    re-executing on a miss) and delivery skips the journaled cursor
+    prefix so the per-rank ``delivered_seq`` digest over the whole run
+    matches an uninterrupted same-seed run bit-for-bit.
 
     ``plan``: the resolved ``(family, granularity)`` shuffle-plan spec
     (``RSDL_SHUFFLE_PLAN``), threaded into every stage task so workers
@@ -2585,11 +2736,127 @@ def shuffle_epoch(
         schedule = "mapreduce"
     if schedule_log is not None:
         schedule_log.append((epoch, schedule))
-    _status_epoch(epoch, state="running", schedule=schedule)
+    jmod = None
+    consume_seq = False
+    if journal is not None:
+        # Already imported by shuffle()'s journal bring-up; this only
+        # binds the module object for the deliver thread below.
+        from ray_shuffling_data_loader_tpu.runtime import journal as jmod
+
+        # Seq-tagged delivery is opt-in per consumer (the queue-backed
+        # one supports it); a consumer with the plain 3-arg signature
+        # still works under a journal — it just keeps the one-reducer
+        # re-delivery window on resume.
+        try:
+            import inspect
+
+            consume_seq = (
+                "seq"
+                in inspect.signature(batch_consumer.consume).parameters
+            )
+        except (TypeError, ValueError):
+            consume_seq = False
+    if est is not None and est.schedule is not None and est.schedule != schedule:
+        # The resumed epoch chose a different schedule than the
+        # journaled attempt (env/policy drift between runs): the
+        # journaled stage results belong to the other schedule's task
+        # shapes and are unusable, but the delivery CURSOR stays valid
+        # — the delivered stream is schedule-independent (bit-identical
+        # across all three schedules, tested).
+        pruned = type(est)(est.epoch)
+        pruned.schedule = schedule
+        pruned.delivered = est.delivered
+        pruned.rank_rows = dict(est.rank_rows)
+        pruned.sampled = est.sampled
+        est = pruned
+    cursor = est.delivered if est is not None else 0
+    _status_epoch(
+        epoch, state="running", schedule=schedule,
+        delivered_reducers=cursor,
+    )
+    if journal is not None:
+        journal.append("epoch", epoch=epoch, schedule=schedule)
     telemetry.emit_event(
         "epoch.start", epoch=epoch, schedule=schedule,
         files=len(filenames), reducers=num_reducers,
     )
+
+    if est is not None and cursor >= num_reducers:
+        # The journal records every reducer of this epoch as delivered
+        # before the preemption: skip the whole window — zero map, zero
+        # reduce tasks — and only re-run the rank-boundary bookkeeping.
+        # The epoch's audit partials were carried in the spool, so the
+        # whole-run digests still fold to the uninterrupted values.
+        _metrics.safe_inc("recovery.resume_epochs_skipped")
+
+        def skip_done():
+            done_ranks = set()
+            try:
+                for rank in range(num_trainers):
+                    batch_consumer.producer_done(rank, epoch)
+                    done_ranks.add(rank)
+                if journal is not None:
+                    journal.append("epoch-done", epoch=epoch)
+                _status_epoch(epoch, state="done")
+                telemetry.emit_event(
+                    "epoch.done", epoch=epoch, _flush=True
+                )
+            except BaseException as exc:
+                thread.error = exc
+                _status_epoch(epoch, state="failed")
+                telemetry.emit_event(
+                    "epoch.failed", _flush=True, epoch=epoch,
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+            finally:
+                # Same guarantee as deliver(): every rank gets its done
+                # sentinel even on failure — consumers must unblock; the
+                # driver re-raises the stored error after joining.
+                for rank in range(num_trainers):
+                    if rank not in done_ranks:
+                        try:
+                            batch_consumer.producer_done(rank, epoch)
+                        except Exception:
+                            pass
+
+        thread = threading.Thread(
+            target=skip_done, name=f"shuffle-deliver-e{epoch}",
+            daemon=True,
+        )
+        thread.error = None
+        thread.suspended = False
+        thread.start()
+        return thread
+
+    skipped_maps: set = set()
+
+    def _attach_map(i: int):
+        """The journaled map result for file ``i`` when it re-attaches
+        cleanly (selective counts always do; ref results need every
+        segment alive), else None — and the stage re-executes."""
+        if est is None:
+            return None
+        m = est.maps.get(i)
+        if m is None:
+            return None
+        if schedule == "selective":
+            counts = m.get("counts")
+            if counts is None or len(counts) != num_reducers:
+                return None
+            _metrics.safe_inc("recovery.resume_map_skipped")
+            return _ResolvedMapResult([int(c) for c in counts])
+        refs_json = m.get("refs")
+        if not refs_json:
+            return None
+        refs = _journaled_refs(refs_json)
+        if refs is None:
+            _metrics.safe_inc("recovery.resume_reexecuted", stage="map")
+            return None
+        if len(refs) != num_reducers:
+            return None  # journaled under a different reducer count
+        _metrics.safe_inc("recovery.resume_map_skipped")
+        return _ResolvedMapResult(refs)
+
     map_futs: List[TaskFuture] = []
     map_published: List[bool] = []
     # Trace context for everything this epoch submits from THIS thread:
@@ -2600,6 +2867,12 @@ def shuffle_epoch(
     with telemetry.context(epoch=epoch, schedule=schedule):
         if schedule == "index":
             for i in range(len(filenames)):
+                attached = _attach_map(i)
+                if attached is not None:
+                    map_futs.append(attached)
+                    map_published.append(False)
+                    skipped_maps.add(i)
+                    continue
                 map_futs.append(
                     pool.submit_local_to(
                         [cache_refs[i]],
@@ -2617,6 +2890,12 @@ def shuffle_epoch(
                 map_published.append(False)
         elif schedule == "selective":
             for i, fname in enumerate(filenames):
+                attached = _attach_map(i)
+                if attached is not None:
+                    map_futs.append(attached)
+                    map_published.append(False)
+                    skipped_maps.add(i)
+                    continue
                 map_futs.append(
                     pool.submit(
                         shuffle_selective_plan,
@@ -2634,6 +2913,12 @@ def shuffle_epoch(
                 map_published.append(False)
         else:
             for i, fname in enumerate(filenames):
+                attached = _attach_map(i)
+                if attached is not None:
+                    map_futs.append(attached)
+                    map_published.append(False)
+                    skipped_maps.add(i)
+                    continue
                 cache_ref, publish = decode_cache.claim_or_wait(i)
                 args = (
                     fname,
@@ -2793,13 +3078,14 @@ def shuffle_epoch(
 
     def _await_map(i, fut, published):
         """Resolve one map future, re-executing on failure up to the
-        stage budget. Returns the partition refs (publish tuples
-        unwrapped). A lost decode-cache segment (index schedule) is
+        stage budget. Returns ``(partition_refs, cache_ref_or_None)``
+        — publish tuples unwrapped, the cache ref kept for the journal
+        barrier. A lost decode-cache segment (index schedule) is
         regenerated before the plan resubmits against it."""
         for attempt, backoff in policy.attempts(site="stage.map"):
             try:
                 res = fut.result()
-                return res[0] if published else res
+                return (res[0], res[1]) if published else (res, None)
             except TaskError as exc:
                 if attempt >= policy.max_attempts:
                     raise StageFailedError(
@@ -2825,7 +3111,13 @@ def shuffle_epoch(
 
     def deliver():
         done_ranks = set()
-        audit_offsets: Dict[int, int] = {}  # rank -> delivered-row offset
+        # rank -> delivered-row offset. On resume the journaled per-rank
+        # row counts seed the offsets so the continuation's seq digests
+        # keep folding from the exact position the preempted run reached
+        # — the whole-run delivered_seq is then bit-identical.
+        audit_offsets: Dict[int, int] = (
+            dict(est.rank_rows) if est is not None else {}
+        )
         try:
             # Re-enter the epoch's trace context on this (fresh) thread
             # so the reduce submissions and delivery spans below carry
@@ -2835,12 +3127,32 @@ def shuffle_epoch(
                 # Wait for all maps (reduce needs one partition per mapper).
                 # Publishing maps return (refs, cache_ref); unwrap those.
                 with telemetry.trace_span("deliver:wait-maps", cat="shuffle"):
-                    per_file_refs = [
+                    resolved_maps = [
                         _await_map(i, f, pub)
                         for i, (f, pub) in enumerate(
                             zip(map_futs, map_published)
                         )
                     ]
+                per_file_refs = [refs for refs, _ in resolved_maps]
+                if journal is not None:
+                    # Task-done journal barrier: each map's result is
+                    # durable the moment the driver observes it (the
+                    # worker's audit/metrics spools flushed before the
+                    # future resolved — runtime/tasks.py). Re-attached
+                    # results were carried forward at begin_run.
+                    for i, (refs, cache_ref) in enumerate(resolved_maps):
+                        if i in skipped_maps:
+                            continue
+                        rec: Dict[str, object] = {}
+                        if schedule == "selective":
+                            rec["counts"] = [int(c) for c in refs]
+                        else:
+                            rec["refs"] = [
+                                jmod.ref_to_json(x) for x in refs
+                            ]
+                        if cache_ref is not None:
+                            rec["cache_ref"] = jmod.ref_to_json(cache_ref)
+                        journal.append("map", epoch=epoch, file=i, **rec)
                 # Lineage: which map produced every partition ref. When a
                 # reduce dies on ObjectLostError, the driver re-executes
                 # exactly that producing map (bounded by the stage budget)
@@ -2939,10 +3251,42 @@ def shuffle_epoch(
                         return []
                     return [refs[r] for refs in per_file_refs]
 
-                reduce_futs = [
-                    _submit_reduce(r, _refs_for(r))
-                    for r in range(num_reducers)
-                ]
+                def _attach_reduce(r):
+                    """The journaled reduce output for ``r``, when every
+                    published ref (one columnar, or device-direct
+                    head/body/tail) still resolves — else None and the
+                    reduce re-executes (bit-identical by seed)."""
+                    if est is None:
+                        return None
+                    refs_json = est.reduces.get(r)
+                    if not refs_json:
+                        return None
+                    refs = _journaled_refs(refs_json)
+                    if refs is None:
+                        _metrics.safe_inc(
+                            "recovery.resume_reexecuted", stage="reduce"
+                        )
+                        return None
+                    _metrics.safe_inc("recovery.resume_reduce_skipped")
+                    return _ResolvedMapResult(refs)
+
+                # Delivery-cursor prefix (ISSUE 13): reducers the
+                # journaled run already handed to the consumer get no
+                # future at all — their audit partials are durable in
+                # the spool, so skipping keeps the whole-run
+                # delivered_seq digest bit-identical.
+                reduce_futs = []
+                attached_reduces: set = set()
+                for r in range(num_reducers):
+                    if r < cursor:
+                        reduce_futs.append(None)
+                        continue
+                    attached = _attach_reduce(r)
+                    if attached is not None:
+                        attached_reduces.add(r)
+                        reduce_futs.append(attached)
+                    else:
+                        reduce_futs.append(_submit_reduce(r, _refs_for(r)))
 
                 def _failed(f):
                     try:
@@ -2961,8 +3305,45 @@ def shuffle_epoch(
                 # (and frees) a retried reducer's inputs.
                 def free_inputs():
                     store = runtime.get_context().store
-                    index_of = {id(f): r for r, f in enumerate(reduce_futs)}
-                    remaining = list(reduce_futs)
+                    # Resume (ISSUE 13): cursor-skipped reducers (None)
+                    # and journal-re-attached ones (_ResolvedMapResult)
+                    # never consume their input partitions — free those
+                    # windows up front (no-op on refs that were already
+                    # freed before the preemption), and only real task
+                    # futures enter the completion-order wait below.
+                    # Classified positively: a real future may be a
+                    # TaskFuture OR a ClusterTaskFuture, so "not a
+                    # TaskFuture" would misread every cluster-mode
+                    # reduce as skipped and free its inputs mid-fetch.
+                    def _skipped(f):
+                        return f is None or isinstance(
+                            f, _ResolvedMapResult
+                        )
+
+                    skipped_rs = [
+                        r
+                        for r, f in enumerate(reduce_futs)
+                        if _skipped(f)
+                    ]
+                    if skipped_rs:
+                        try:
+                            store.free(
+                                [
+                                    refs[r]
+                                    for refs in per_file_refs
+                                    for r in skipped_rs
+                                ]
+                            )
+                        except Exception:
+                            pass
+                    index_of = {
+                        id(f): r
+                        for r, f in enumerate(reduce_futs)
+                        if not _skipped(f)
+                    }
+                    remaining = [
+                        f for f in reduce_futs if not _skipped(f)
+                    ]
                     while remaining:
                         finished, remaining = wait(remaining, num_returns=1)
                         for f in finished:
@@ -3081,6 +3462,64 @@ def shuffle_epoch(
                 # completes, preserving reducer order within a rank for
                 # determinism.
                 for r, fut in enumerate(reduce_futs):
+                    rank = int(rank_of[r])
+                    if fut is None:
+                        # Journaled delivery cursor (ISSUE 13): this
+                        # reducer reached the consumer before the
+                        # preemption and its audit partials are durable
+                        # in the spool — only the rank-boundary sentinel
+                        # bookkeeping happens again.
+                        if r + 1 == num_reducers or rank_of[r + 1] != rank:
+                            batch_consumer.producer_done(rank, epoch)
+                            done_ranks.add(rank)
+                        continue
+                    if jmod is not None and jmod.suspend_requested():
+                        # Preemption notice: the current reducer was the
+                        # quiesce window; stop at this barrier with the
+                        # journal cursor exactly describing what the
+                        # consumer got. The remaining reducers are
+                        # already executing — drain them and journal
+                        # their published outputs so the work is
+                        # durable and re-attachable (the resume
+                        # delivers them without re-execution; abandoned
+                        # they would leak until session cleanup). A
+                        # reducer that fails or outlives the quiesce
+                        # budget is simply not journaled — the resume
+                        # re-executes it, bit-identical by seed. The
+                        # budget is ONE deadline across the whole drain,
+                        # not per-future: a preemption notice is
+                        # typically 30-120 s, and a wedged fleet must
+                        # not stack 60 s waits serially past it.
+                        if journal is not None:
+                            drain_deadline = timeit.default_timer() + 60
+                            for r2 in range(r, num_reducers):
+                                f2 = reduce_futs[r2]
+                                if f2 is None or r2 in attached_reduces:
+                                    continue
+                                try:
+                                    out2 = f2.result(
+                                        timeout=max(
+                                            0.0,
+                                            drain_deadline
+                                            - timeit.default_timer(),
+                                        )
+                                    )
+                                except Exception:
+                                    continue
+                                refs2 = (
+                                    list(out2)
+                                    if isinstance(out2, (list, tuple))
+                                    else [out2]
+                                )
+                                journal.append(
+                                    "reduce", epoch=epoch, reducer=r2,
+                                    refs=[
+                                        jmod.ref_to_json(x)
+                                        for x in refs2
+                                    ],
+                                )
+                        thread.suspended = True
+                        break
                     out = _await_reduce(r, fut)
                     # Device-direct reducers return a short LIST of refs
                     # (head/body/tail); legacy reducers one columnar ref.
@@ -3089,12 +3528,22 @@ def shuffle_epoch(
                         if isinstance(out, (list, tuple))
                         else [out]
                     )
-                    rank = int(rank_of[r])
+                    if journal is not None and r not in attached_reduces:
+                        # Task-done journal barrier for the reduce: its
+                        # published output can re-attach on resume even
+                        # when the preemption lands before delivery.
+                        # (Before the audit drop-row hook, which swaps
+                        # in a deliberately corrupted ref.)
+                        journal.append(
+                            "reduce", epoch=epoch, reducer=r,
+                            refs=[jmod.ref_to_json(x) for x in out_refs],
+                        )
                     if _faults.enabled():
                         # The scripted producer-stall (or kill: a dead
                         # delivery thread is what ProducerDiedError
                         # supervision detects on the consumer side).
                         _faults.fire("queue.producer", epoch=epoch)
+                    offset_before = audit_offsets.get(rank, 0)
                     if _audit.enabled():
                         out_refs = _audit_deliver(
                             runtime.get_context().store,
@@ -3107,8 +3556,50 @@ def shuffle_epoch(
                     with telemetry.trace_span(
                         "deliver", cat="queue", rank=rank, reducer=r
                     ):
-                        batch_consumer.consume(rank, epoch, out_refs)
+                        if consume_seq:
+                            # Idempotent re-publish (ISSUE 13): tag the
+                            # publication with its reducer index so a
+                            # queue actor that outlived a preempted
+                            # driver drops the one-reducer overlap
+                            # between "published" and "journaled".
+                            batch_consumer.consume(
+                                rank, epoch, out_refs, seq=r
+                            )
+                        else:
+                            batch_consumer.consume(rank, epoch, out_refs)
                     _status_epoch(epoch, delivered_inc=1)
+                    if journal is not None:
+                        # Deliver-thread journal barrier. Write-ahead
+                        # ordering with the audit spool: the delivery
+                        # digest is flushed BEFORE the cursor record, so
+                        # a journaled "delivered" always implies the
+                        # digest is on disk — a crash between the two
+                        # merely re-delivers this one reducer, which the
+                        # reconciler's (rank, reducer, offset) dedup
+                        # absorbs.
+                        if _audit.enabled():
+                            _audit.safe_flush()
+                            rows = audit_offsets.get(rank, 0) - offset_before
+                            sampled = _audit.sample_count(epoch)
+                        else:
+                            rows = sum(
+                                _ref_window_rows(ref) or 0
+                                for ref in out_refs
+                            )
+                            # Keep the per-rank row offsets folding even
+                            # with audit off — a later audited resume
+                            # must not inherit zeroed offsets.
+                            audit_offsets[rank] = offset_before + rows
+                            sampled = 0
+                        journal.append(
+                            "deliver", epoch=epoch, reducer=r, rank=rank,
+                            rows=int(rows), sampled=int(sampled),
+                        )
+                        if getattr(journal, "resume_pending", False):
+                            # First delivery of the resumed run: the
+                            # resume_stalled SLO rule stands down.
+                            journal.resume_pending = False
+                            jmod.set_resume_in_progress(False)
                     if stats_collector is not None:
                         stats_collector.call_oneway(
                             "consume", rank, epoch,
@@ -3117,11 +3608,25 @@ def shuffle_epoch(
                     if r + 1 == num_reducers or rank_of[r + 1] != rank:
                         batch_consumer.producer_done(rank, epoch)
                         done_ranks.add(rank)
+                if journal is not None and not getattr(
+                    thread, "suspended", False
+                ):
+                    # Epoch barrier: every reducer delivered — a resume
+                    # skips this epoch's window outright.
+                    journal.append("epoch-done", epoch=epoch)
         except BaseException as exc:
             thread.error = exc
         finally:
             failed = thread.error is not None
-            _status_epoch(epoch, state="failed" if failed else "done")
+            suspended = not failed and getattr(thread, "suspended", False)
+            _status_epoch(
+                epoch,
+                state=(
+                    "failed"
+                    if failed
+                    else ("suspended" if suspended else "done")
+                ),
+            )
             if failed:
                 telemetry.emit_event(
                     "epoch.failed", _flush=True, epoch=epoch,
@@ -3129,7 +3634,7 @@ def shuffle_epoch(
                         f"{type(thread.error).__name__}: {thread.error}"
                     )[:200],
                 )
-            else:
+            elif not suspended:
                 telemetry.emit_event("epoch.done", epoch=epoch, _flush=True)
             # Every rank gets its done sentinel even on failure (or when it
             # was assigned zero reducers): consumers must unblock; the
@@ -3145,6 +3650,7 @@ def shuffle_epoch(
         target=deliver, name=f"shuffle-deliver-e{epoch}", daemon=True
     )
     thread.error = None
+    thread.suspended = False
     thread.start()
     return thread
 
@@ -3222,6 +3728,7 @@ def shuffle(
     schedule_log: Optional[list] = None,
     device_layout: Optional[dict] = None,
     columns: Optional[Sequence[str]] = None,
+    resume_from: Optional[str] = None,
 ) -> float:
     """Shuffle the dataset every epoch; returns total wall-clock duration.
 
@@ -3251,6 +3758,19 @@ def shuffle(
     decoded off Parquet; ``shuffle.decode_bytes_pruned`` counts the
     avoided work. See :func:`_pushdown_columns` for the
     ``RSDL_DECODE_PUSHDOWN`` gate semantics.
+
+    ``resume_from`` (ISSUE 13): resume a preempted run from its
+    write-ahead journal — ``"auto"`` (or ``RSDL_RESUME=auto``) discovers
+    the newest resumable journal under ``RSDL_JOURNAL`` whose run
+    identity matches this call; a path names a journal file/dir
+    explicitly (an identity mismatch then refuses loudly);
+    ``"redeliver"`` resumes the stages but re-delivers the in-flight
+    epochs' full streams (a consumer that restarted from scratch).
+    With ``RSDL_JOURNAL`` set, every run journals its epoch-window
+    state at the task-done / deliver / epoch barriers and installs a
+    SIGTERM graceful-suspend handler. See
+    :mod:`~.runtime.journal` and docs/robustness.md ("Preemption,
+    suspend/resume, and replay").
     """
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
@@ -3280,13 +3800,87 @@ def shuffle(
             obs_server.register_status_provider("shuffle", live_status)
         except Exception:
             pass
+    device_layout = _device_layout_allowed(device_layout)
+    columns = _pushdown_columns(device_layout, columns)
+    # -- durable epoch-state plane (ISSUE 13) -------------------------------
+    # Lazy import: with RSDL_JOURNAL unset and no explicit resume the
+    # journal module never loads, no file is created, and no signal
+    # handler is installed (the zero-overhead contract, proven by a
+    # fresh-interpreter test).
+    jmod = None
+    journal = None
+    resume_state = None
+    resume_mode = "cursor"
+    if resume_from is not None or os.environ.get("RSDL_JOURNAL"):
+        from ray_shuffling_data_loader_tpu.runtime import journal as jmod
+
+        identity = jmod.run_identity(
+            filenames, num_epochs, num_reducers, num_trainers, seed,
+            start_epoch, narrow_to_32, _label_of_plan(plan), columns,
+            device_layout,
+        )
+        resume_state, resume_mode = jmod.resolve_resume(
+            resume_from, identity
+        )
+        if not jmod.enabled() and resume_state is None:
+            # resume_from="auto"/"off" with RSDL_JOURNAL unset: nothing
+            # to resume and nowhere to journal — the plane stays off
+            # (an explicit resume_from path journals next to the old
+            # run's file instead).
+            jmod = None
+    if jmod is not None:
+        jmod.clear_suspend()
+        journal = jmod.begin_run(
+            identity, resume=resume_state, mode=resume_mode
+        )
+        jmod.install_sigterm_handler()
+        if resume_state is not None:
+            journal.resume_pending = True
+            jmod.set_resume_in_progress(True)
+            _metrics.safe_inc("recovery.resume_runs")
+            telemetry.emit_event(
+                "run.resumed", _flush=True,
+                run_id=journal.run_id,
+                from_run=resume_state.run_id,
+                mode=resume_mode,
+                epochs_with_progress=len(resume_state.epochs),
+            )
+            restore_cursors = getattr(
+                batch_consumer, "restore_delivery_cursors", None
+            )
+            if restore_cursors is not None and resume_mode == "cursor":
+                # Seed the queue actor's idempotency cursors so a
+                # reducer that reached the queue in the crash window
+                # between its publish and its journal append is dropped
+                # whole on re-publish — never duplicated to the trainer.
+                cursors = {
+                    f"{e}/{rank}": st.delivered
+                    for e, st in resume_state.epochs.items()
+                    if st.delivered > 0
+                    for rank in range(num_trainers)
+                }
+                if cursors:
+                    try:
+                        restore_cursors(cursors)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "could not seed queue delivery cursors",
+                            exc_info=True,
+                        )
     if _audit.enabled():
         # Scope the digest records to THIS run: stale records (a previous
         # shuffle in the same process / spool dir) would fold into this
-        # run's digests and poison the verdicts.
-        _audit.begin_run()
-    device_layout = _device_layout_allowed(device_layout)
-    columns = _pushdown_columns(device_layout, columns)
+        # run's digests and poison the verdicts. On resume the superseded
+        # attempt's spooled partials are the first half of THIS run's
+        # digests — carried, not cleared (the reconciler's per-side dedup
+        # absorbs any re-executed stage's duplicate records).
+        _audit.begin_run(carry=resume_state is not None)
+        if resume_state is not None:
+            for e, st in resume_state.epochs.items():
+                if st.sampled:
+                    _audit.seed_sample_count(e, st.sampled)
     if cache_decoded is None:
         cache_decoded = _decode_cache_auto(
             filenames, num_epochs - start_epoch, narrow_to_32, columns
@@ -3311,10 +3905,19 @@ def shuffle(
     decode_cache = _DecodeCache(
         enabled=cache_decoded, shared_keys=shared_keys
     )
+    if resume_state is not None and cache_decoded:
+        # Re-attach the preempted run's surviving decode-cache segments
+        # so resumed epochs skip Parquet decode (a dead segment simply
+        # is not seeded — the claim path re-decodes).
+        _seed_decode_cache_from_journal(decode_cache, resume_state)
     start = timeit.default_timer()
     threads = []
     try:
         for epoch in range(start_epoch, num_epochs):
+            if jmod is not None and jmod.suspend_requested():
+                # Preemption notice: stop admitting epochs; the already
+                # in-flight windows quiesce at their reducer barriers.
+                break
             throttle_start = timeit.default_timer()
             _status_epoch(epoch, state="waiting-admission")
             # The admission span IS the window throttle: its duration is
@@ -3334,6 +3937,13 @@ def shuffle(
                     epoch,
                     timeit.default_timer() - throttle_start,
                 )
+            est = (
+                resume_state.epochs.get(epoch)
+                if resume_state is not None
+                else None
+            )
+            if est is not None:
+                _metrics.safe_inc("recovery.resumed_epochs")
             threads.append(
                 shuffle_epoch(
                     epoch,
@@ -3349,10 +3959,36 @@ def shuffle(
                     device_layout=device_layout,
                     columns=columns,
                     plan=plan,
+                    journal=journal,
+                    est=est,
                 )
             )
         for t in threads:
             t.join()
+        if jmod is not None and jmod.suspend_requested():
+            # Every in-flight window quiesced at a reducer barrier and
+            # its cursor is journaled: record the suspension, leave the
+            # store segments alive (they ARE the suspended window), and
+            # either leave with exit code 0 (the SIGTERM path) or raise
+            # RunSuspended for embedding drivers/tests.
+            for t in threads:
+                if t.error is not None:
+                    raise t.error
+            journal.append("suspended")
+            telemetry.emit_event(
+                "run.suspended", _flush=True, run_id=journal.run_id,
+                journal=journal.path,
+            )
+            _metrics.safe_inc("recovery.suspended_runs")
+            _status_end_trial(error="suspended")
+            # No resume is in progress once the run is suspended: a
+            # stuck gauge would page resume_stalled forever in an
+            # embedding driver that catches RunSuspended and lives on.
+            jmod.set_resume_in_progress(False)
+            if jmod.suspend_should_exit():
+                jmod.suspend_and_exit(journal)  # os._exit(0)
+            jmod.end_run(journal, status="suspended")
+            raise jmod.RunSuspended(journal.path)
         decode_cache.free_all()
         batch_consumer.wait_until_all_epochs_done()
         for t in threads:
@@ -3365,12 +4001,38 @@ def shuffle(
             # every batch — fold all sides, emit per-epoch verdicts +
             # audit.* metrics, and (in RSDL_AUDIT_STRICT mode) raise on
             # any mismatch.
-            _audit.reconcile(
+            audit_verdicts = _audit.reconcile(
                 range(start_epoch, num_epochs),
                 stats_collector=stats_collector,
                 plan_label=_label_of_plan(plan),
             )
+            if journal is not None:
+                # Epoch-reconcile journal barrier: the per-epoch digest
+                # verdicts (incl. the order-sensitive delivered_seq) are
+                # what tools/replay.py checks a re-execution against.
+                for v in audit_verdicts:
+                    journal.append("verdict", **v)
+        if journal is not None:
+            if resume_state is not None:
+                try:
+                    _sweep_superseded(resume_state)
+                except Exception:
+                    pass
+            jmod.set_resume_in_progress(False)
+            jmod.end_run(journal)
     except BaseException as exc:
+        if jmod is not None and isinstance(exc, jmod.RunSuspended):
+            raise  # already journaled + reported as suspended
+        if journal is not None:
+            # Close (but do not complete) the journal: a failed run
+            # stays resumable — its completed stages re-attach once the
+            # failure cause is fixed. The in-progress gauge clears too:
+            # an abandoned resume must not page resume_stalled forever.
+            try:
+                jmod.set_resume_in_progress(False)
+                jmod.end_run(journal, status="failed")
+            except Exception:
+                pass
         _status_end_trial(error=f"{type(exc).__name__}: {exc}")
         telemetry.emit_event(
             "trial.failed", _flush=True,

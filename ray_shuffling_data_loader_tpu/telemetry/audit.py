@@ -477,12 +477,38 @@ def reset(clear_spool: bool = False) -> None:
                         pass
 
 
-def begin_run() -> None:
+def begin_run(carry: bool = False) -> None:
     """Mark the start of one audited shuffle run: previous records (local
     and spooled) would otherwise fold into this run's digests. Called by
     ``shuffle()`` when auditing is on — one audited run per spool dir at
-    a time."""
-    reset(clear_spool=True)
+    a time.
+
+    ``carry=True`` (a journal resume, runtime/journal.py): the spool is
+    the ONE thing kept — the preempted attempt's digest records are the
+    first half of this run's digests, and clearing them would make
+    every partially-delivered epoch reconcile as a false mismatch. The
+    local buffer/verdict state still resets (this is a fresh process's
+    run boundary)."""
+    reset(clear_spool=not carry)
+
+
+def seed_sample_count(epoch: int, taken: int) -> None:
+    """Resume carry-forward for the rank-0 quality sample: the journaled
+    run already took ``taken`` sample keys for ``epoch`` (they ride its
+    spooled deliver records), so this process's cap accounting must
+    start there, not at zero — the combined sample stays one capped
+    prefix of the rank-0 stream."""
+    with _lock:
+        _sample_counts[int(epoch)] = max(
+            _sample_counts.get(int(epoch), 0), int(taken)
+        )
+
+
+def sample_count(epoch: int) -> int:
+    """Sample keys taken so far for ``epoch`` (journal barrier reads
+    this so a resumed run can seed it back)."""
+    with _lock:
+        return _sample_counts.get(int(epoch), 0)
 
 
 def _load_records() -> List[dict]:

@@ -69,6 +69,12 @@ KINDS = (
     "straggler.wedged",  # the straggler detector flagged an in-flight task
     "alert.fired",      # an SLO rule's condition held for its for_s
     "alert.resolved",   # ... and later cleared (telemetry/slo.py)
+    "run.suspended",    # a journaled run quiesced + exited (preemption
+                        # notice; runtime/journal.py)
+    "run.resumed",      # a fresh driver reconstructed a journaled
+                        # epoch window (shuffle(resume_from=))
+    "epoch.replayed",   # tools/replay.py re-ran a journaled epoch and
+                        # compared digests (time-travel debugging)
 )
 
 # Flush when the buffer reaches this many records (plus the explicit

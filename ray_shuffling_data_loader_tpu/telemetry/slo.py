@@ -159,6 +159,19 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "for_s": 0.0,
         "severity": "page",
     },
+    {
+        # A journal resume started (recovery.resume_in_progress set to
+        # 1 at resume start; cleared at the resumed run's FIRST
+        # delivery — runtime/journal.py) but no batch has reached the
+        # consumer for a sustained window: the re-attach/re-execution
+        # path is wedged, not recovering.
+        "name": "resume_stalled",
+        "kind": "threshold",
+        "metric": "recovery.resume_in_progress",
+        "op": ">", "value": 0.0,
+        "for_s": 60.0,
+        "severity": "page",
+    },
 ]
 
 _HISTORY_CAP = 64

@@ -151,6 +151,27 @@ if [ "$tier" != "slow" ]; then
     RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
     python -m pytest tests/test_shuffle.py tests/test_dataset.py \
       tests/test_jax_dataset.py -m "not slow" -q -x
+  # Resume lane (ISSUE 13): the durable epoch-state plane under chaos.
+  # Journal fold/identity units, graceful suspend (programmatic +
+  # SIGTERM), the SIGKILL-the-driver kill-and-resume legs (per-rank
+  # delivered_seq digests bit-identical to an uninterrupted same-seed
+  # control, journaled-complete epochs re-execute zero stage tasks,
+  # capacity residency folds to zero), the degraded resume with the
+  # store segments dropped, the zero-overhead-off fresh-interpreter
+  # proof, and tools/replay.py's divergence gate — all with strict
+  # audit on and the fixed-seed xN-capped fault schedule riding into
+  # every child driver (recovery is exactly-once, so injected crashes
+  # must be invisible to digest equality across the preemption). The
+  # checkpoint suite rides along: torn-publish debris pruning and the
+  # cursor's plan-family stream identity share this failure model.
+  # Chaos tests stay function-scoped-runtime per the established
+  # recipe; the kill legs own no pytest-process runtime at all.
+  RSDL_AUDIT=1 RSDL_AUDIT_STRICT=1 RSDL_AUDIT_DIR="$(mktemp -d)" \
+    RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=1313 \
+    python -m pytest tests/test_resume.py tests/test_checkpoint.py \
+      -m "not slow" -q -x
   # Temporal + decision obs smoke (ISSUES 7/9), exit-code gated:
   # against a MID-FLIGHT shuffle with the obs endpoint up, /timeseries
   # must serve a non-empty rate series, `rsdl_top --once --json` must

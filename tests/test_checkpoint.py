@@ -5,6 +5,8 @@ uninterrupted run would have yielded after its first ``k``. The reference
 has no checkpointing at all (SURVEY §5), so these tests define the new
 subsystem's contract."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -217,3 +219,225 @@ def test_end_to_end_preemption_replay(local_runtime, ckpt_files, tmp_path):
         kept.append(batch["key"].tolist())
     all_keys = [k for batch in kept for k in batch]
     assert sorted(all_keys) == list(range(2000))
+
+
+# ---------------------------------------------------------------------------
+# Crash-mid-publish debris (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_publish_debris_never_surfaces_and_ages_out(tmp_path):
+    """A writer that died between mkdtemp and the atomic rename leaves a
+    ``ckpt-*.tmp-*`` staging dir. Readers must never surface it as a
+    checkpoint, and once it is older than the grace window (a single
+    writer per directory — old debris can only be a dead writer's) the
+    read paths prune it from disk."""
+    import json as _json
+    import time as _time
+
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt_mod
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, cursor=BatchCursor(epoch=0, batches_yielded=3))
+
+    # Fabricate the torn checkpoint exactly as save() stages one: the
+    # tmp dir even holds a complete cursor.json — only the rename is
+    # missing, so nothing about its CONTENT marks it torn.
+    debris = tmp_path / "ck" / "ckpt-0000000009.tmp-dead0a"
+    debris.mkdir()
+    (debris / "cursor.json").write_text(
+        _json.dumps({"epoch": 9, "batches_yielded": 9, "step": 9})
+    )
+
+    # Young debris: skipped by every reader, but NOT pruned (it may be
+    # a live writer's in-flight save on a shared filesystem).
+    assert mgr.all_steps() == [3]
+    assert mgr.latest_step() == 3
+    cursor = mgr.restore_cursor()
+    assert cursor is not None and cursor.step == 3
+    assert debris.is_dir()
+
+    # Aged past the grace window: the next read prunes it.
+    old = _time.time() - ckpt_mod._DEBRIS_GRACE_S - 5
+    os.utime(debris, (old, old))
+    assert mgr.all_steps() == [3]
+    assert not debris.exists()
+    # A published checkpoint of the same vintage is untouched.
+    assert mgr.restore_cursor().step == 3
+
+
+def test_debris_prune_never_eats_published_checkpoints(tmp_path):
+    """The debris pattern must not match published ``ckpt-*`` dirs even
+    when they are old."""
+    import time as _time
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, cursor=BatchCursor(epoch=0, batches_yielded=1))
+    published = tmp_path / "ck" / "ckpt-0000000001"
+    old = _time.time() - 10_000
+    os.utime(published, (old, old))
+    assert mgr.all_steps() == [1]
+    assert published.is_dir()
+
+
+# ---------------------------------------------------------------------------
+# Cursor stream identity: plan family + journal run join (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_config_captures_plan_family(monkeypatch):
+    """The PR 12 plan family postdates the cursor's stream-identity
+    config: seed, plan, and blocks/group must all be captured, and a
+    plan mismatch must refuse like any other stream change."""
+    monkeypatch.delenv("RSDL_SHUFFLE_PLAN", raising=False)
+    base = dict(
+        seed=1, batch_size=10, num_trainers=2, num_reducers=4,
+        num_files=3, drop_last=False,
+    )
+    config = BatchCursor.stream_config(**base)
+    assert config["plan"] == "rowwise"
+
+    monkeypatch.setenv("RSDL_SHUFFLE_PLAN", "block:2")
+    assert BatchCursor.stream_config(**base)["plan"] == "block:2"
+    # The granularity is part of the identity: block:2 vs block:4 is a
+    # different stream even within the same family.
+    assert BatchCursor.stream_config(**base, plan="block:4")["plan"] == (
+        "block:4"
+    )
+
+    cursor = BatchCursor(epoch=0, batches_yielded=0, config=config)
+    with pytest.raises(ValueError, match="plan"):
+        cursor.validate(BatchCursor.stream_config(**base))
+    monkeypatch.delenv("RSDL_SHUFFLE_PLAN", raising=False)
+    cursor.validate(BatchCursor.stream_config(**base))
+
+
+def test_cursor_validation_refusal_paths():
+    """Every stream-identity knob refuses on mismatch, with the field
+    named; empty configs (legacy cursors) stay permissive."""
+    base = dict(
+        seed=1, batch_size=10, num_trainers=2, num_reducers=4,
+        num_files=3, drop_last=False, plan="rowwise",
+    )
+    cursor = BatchCursor(
+        epoch=0, batches_yielded=0, config=BatchCursor.stream_config(**base)
+    )
+    for key, val in (
+        ("seed", 2),
+        ("batch_size", 20),
+        ("num_trainers", 1),
+        ("num_reducers", 8),
+        ("num_files", 4),
+        ("drop_last", True),
+        ("plan", "block:1"),
+    ):
+        with pytest.raises(ValueError, match=key):
+            cursor.validate(
+                BatchCursor.stream_config(**{**base, key: val})
+            )
+    # Legacy/empty configs never refuse (nothing recorded to compare).
+    BatchCursor(epoch=0, batches_yielded=0).validate(
+        BatchCursor.stream_config(**base)
+    )
+    cursor.validate({})
+    # A cursor saved BEFORE the plan family existed (non-empty config,
+    # no "plan" key) was implicitly rowwise: it must keep resuming
+    # under rowwise, and still refuse a block-plan stream.
+    legacy = BatchCursor(
+        epoch=0, batches_yielded=0,
+        config={
+            k: v
+            for k, v in BatchCursor.stream_config(**base).items()
+            if k != "plan"
+        },
+    )
+    legacy.validate(BatchCursor.stream_config(**base))
+    with pytest.raises(ValueError, match="plan"):
+        legacy.validate(
+            BatchCursor.stream_config(**{**base, "plan": "block:2"})
+        )
+
+
+def test_cursor_joins_journal_run_identity(tmp_path, monkeypatch):
+    """With the driver's write-ahead journal in flight, ``save`` stamps
+    the cursor with the journal's run_id — trainer cursor and driver
+    window resume as one recorded run. Without one, the stamp stays
+    None (and the journal module is never consulted into existence)."""
+    from ray_shuffling_data_loader_tpu.runtime import journal as jmod
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, cursor=BatchCursor(epoch=0, batches_yielded=1))
+    assert mgr.restore_cursor(1).run_id is None
+
+    monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path / "journal"))
+    journal = jmod.begin_run({"seed": 1})
+    try:
+        mgr.save(2, cursor=BatchCursor(epoch=0, batches_yielded=2))
+        assert mgr.restore_cursor(2).run_id == journal.run_id
+        # Informational only: run_id never participates in validate()
+        # (a resumed driver gets a NEW run id for the same stream).
+        restored = mgr.restore_cursor(2)
+        restored.validate(mgr.restore_cursor(1).config or {})
+    finally:
+        jmod.end_run(journal)
+    mgr.save(3, cursor=BatchCursor(epoch=0, batches_yielded=3))
+    assert mgr.restore_cursor(3).run_id is None
+
+
+# ---------------------------------------------------------------------------
+# skip_batches stream equality under the block plan family (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def block_files(local_runtime, tmp_path_factory):
+    """Multi-row-group dataset: block plans assign row-group-aligned
+    blocks, so the fixture needs more groups than the single-group
+    ckpt_files to exercise a non-degenerate block permutation."""
+    data_dir = tmp_path_factory.mktemp("ckpt-block-data")
+    filenames, _ = generate_data(
+        num_rows=2000,
+        num_files=2,
+        num_row_groups_per_file=4,
+        max_row_group_skew=0.0,
+        data_dir=str(data_dir),
+    )
+    return filenames
+
+
+def test_skip_batches_stream_equality_under_block_plan(
+    local_runtime, block_files, monkeypatch
+):
+    """The cursor-resume property holds per plan family: under
+    ``RSDL_SHUFFLE_PLAN=block`` the resumed stream equals the
+    uninterrupted block-plan stream's tail, and the cursor refuses to
+    cross plan families (the PR 12 block plan delivers a genuinely
+    different stream than rowwise at the same seed)."""
+    monkeypatch.setenv("RSDL_SHUFFLE_PLAN", "block:1")
+    config = BatchCursor.stream_config(
+        seed=7, batch_size=300, num_trainers=1, num_reducers=3,
+        num_files=len(block_files), drop_last=False,
+    )
+    assert config["plan"] == "block:1"
+
+    full = _make_ds(block_files, "q-ck-blk-full")
+    full.set_epoch(0)
+    full_keys = [b["key"].tolist() for b in full]
+    assert sorted(k for b in full_keys for k in b) == list(range(2000))
+
+    skip = 3
+    resumed = _make_ds(block_files, "q-ck-blk-res")
+    resumed.set_epoch(0, skip_batches=skip)
+    resumed_keys = [b["key"].tolist() for b in resumed]
+    assert resumed_keys == full_keys[skip:]
+
+    # Crossing plan families with the same cursor refuses.
+    cursor = BatchCursor(epoch=0, batches_yielded=skip, config=config)
+    monkeypatch.setenv("RSDL_SHUFFLE_PLAN", "rowwise")
+    with pytest.raises(ValueError, match="plan"):
+        cursor.validate(
+            BatchCursor.stream_config(
+                seed=7, batch_size=300, num_trainers=1, num_reducers=3,
+                num_files=len(block_files), drop_last=False,
+            )
+        )

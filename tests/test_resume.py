@@ -1,0 +1,844 @@
+"""Durable epoch-state plane tests (ISSUE 13): the write-ahead journal
+as a unit, graceful suspend (programmatic and SIGTERM), kill-and-resume
+with bit-identical delivered digests, the degraded path when the store
+segments are gone, the zero-overhead-off contract, and the
+tools/replay.py time-travel check.
+
+Recipe notes (PR 3): tests that arm env-gated planes run against a
+FUNCTION-scoped runtime so the worker pool inherits the env. The
+driver-kill legs spawn whole child drivers (their own runtimes, their
+own shm dir) and SIGKILL/SIGTERM them mid-epoch-window — the pytest
+process owns no runtime there, it only folds the journals, spools, and
+digests the children leave behind.
+"""
+
+import collections
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.runtime import faults
+from ray_shuffling_data_loader_tpu.runtime import journal as jmod
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NUM_FILES = 3
+ROWS_PER_FILE = 300
+TOTAL_ROWS = NUM_FILES * ROWS_PER_FILE
+NUM_REDUCERS = 4
+NUM_EPOCHS = 3
+SEED = 7
+
+
+# ---------------------------------------------------------------------------
+# Journal unit tests (no runtime)
+# ---------------------------------------------------------------------------
+
+
+def _identity(**overrides):
+    base = {
+        "v": 1,
+        "seed": SEED,
+        "num_epochs": NUM_EPOCHS,
+        "num_reducers": NUM_REDUCERS,
+        "num_trainers": 1,
+        "start_epoch": 0,
+        "filenames": ["/data/a.parquet", "/data/b.parquet"],
+        "narrow_to_32": False,
+        "plan": "rowwise",
+        "columns": None,
+        "session": "sess-one",
+        "faults": None,
+        "faults_seed": None,
+    }
+    base.update(overrides)
+    return base
+
+
+def test_journal_fold_roundtrip(tmp_path, monkeypatch):
+    """Append at every barrier kind, fold with load_run, and carry the
+    fold into a successor journal — the successor's own fold must agree
+    and the predecessor must be left superseded."""
+    monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path))
+    identity = _identity()
+    j = jmod.begin_run(identity)
+    j.append("epoch", epoch=0, schedule="mapreduce")
+    j.append(
+        "map", epoch=0, file=0,
+        refs=[{"id": "s-aa", "nbytes": 10, "session": "s"}] * NUM_REDUCERS,
+    )
+    j.append("map", epoch=0, file=1, counts=[1, 2, 3, 4])
+    j.append(
+        "reduce", epoch=0, reducer=0,
+        refs=[{"id": "s-bb", "nbytes": 5, "session": "s"}],
+    )
+    j.append("deliver", epoch=0, reducer=0, rank=0, rows=220, sampled=3)
+    j.append("deliver", epoch=0, reducer=1, rank=0, rows=230, sampled=5)
+    j.append("epoch", epoch=1, schedule="mapreduce")
+    j.append("deliver", epoch=1, reducer=0, rank=0, rows=200, sampled=0)
+    j.append("verdict", epoch=0, ok=True, delivered_seq="abc123")
+    jmod.end_run(j, status="failed")  # closed but resumable
+
+    st = jmod.load_run(j.path)
+    assert st.resumable() and not st.done and not st.suspended
+    e0 = st.epochs[0]
+    assert e0.schedule == "mapreduce"
+    assert e0.maps[0]["refs"][0]["id"] == "s-aa"
+    assert e0.maps[1]["counts"] == [1, 2, 3, 4]
+    assert e0.reduces[0][0]["id"] == "s-bb"
+    assert e0.delivered == 2  # cursor: reducers 0..1 delivered
+    assert e0.rank_rows == {0: 450}
+    assert e0.sampled == 5
+    assert not e0.done
+    assert st.epochs[1].delivered == 1
+    assert st.verdicts[0]["delivered_seq"] == "abc123"
+
+    # Ref JSON roundtrip preserves the store identity.
+    ref = jmod.ref_from_json(e0.maps[0]["refs"][0])
+    assert ref.object_id == "s-aa" and ref.nbytes == 10
+    assert jmod.ref_to_json(ref)["id"] == "s-aa"
+
+    # Carry forward into a successor; its self-contained fold agrees.
+    j2 = jmod.begin_run(identity, resume=st)
+    jmod.end_run(j2, status="failed")
+    st2 = jmod.load_run(j2.path)
+    assert st2.epochs[0].delivered == 2
+    assert st2.epochs[0].rank_rows == {0: 450}
+    assert st2.epochs[0].maps[1]["counts"] == [1, 2, 3, 4]
+    assert st2.verdicts[0]["delivered_seq"] == "abc123"
+    # The predecessor is superseded: discovery must find the successor.
+    assert not jmod.load_run(j.path).resumable()
+    found = jmod.find_resumable(str(tmp_path), identity)
+    assert found is not None and found.run_id == j2.run_id
+
+    # redeliver mode: the carry drops the delivery cursors of epochs
+    # that were still in flight (a restarted consumer needs their full
+    # streams again) but keeps completed stages.
+    carried = list(st2.iter_records(carry_cursors=False))
+    assert not any(r["kind"] == "deliver" for r in carried)
+    assert any(r["kind"] == "map" for r in carried)
+
+
+def test_journal_done_runs_are_not_resumable(tmp_path, monkeypatch):
+    monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path))
+    identity = _identity()
+    j = jmod.begin_run(identity)
+    jmod.end_run(j)  # status="done"
+    assert jmod.load_run(j.path).done
+    assert jmod.find_resumable(str(tmp_path), identity) is None
+    # An explicit path to a completed run refuses loudly.
+    monkeypatch.setenv("RSDL_RESUME", "auto")
+    state, _ = jmod.resolve_resume(None, identity)
+    assert state is None
+    with pytest.raises(ValueError, match="completed"):
+        jmod.resolve_resume(j.path, identity)
+
+
+def test_journal_torn_tail_and_header(tmp_path, monkeypatch):
+    """Crash-mid-append debris never poisons the fold: a torn tail line
+    is skipped, a headerless file raises instead of folding garbage."""
+    monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path))
+    j = jmod.begin_run(_identity())
+    j.append("deliver", epoch=0, reducer=0, rank=0, rows=100, sampled=0)
+    jmod.end_run(j, status="failed")
+    with open(j.path, "a") as f:
+        f.write('{"kind": "deliver", "epoch": 0, "reducer": 1')  # torn
+    st = jmod.load_run(j.path)
+    assert st.epochs[0].delivered == 1  # the torn record did not fold
+
+    bad = tmp_path / "run-headerless.ndjson"
+    bad.write_text('{"kind": "deliver", "epoch": 0}\n')
+    with pytest.raises(ValueError, match="identity"):
+        jmod.load_run(str(bad))
+    empty = tmp_path / "run-empty.ndjson"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="empty or torn"):
+        jmod.load_run(str(empty))
+
+
+def test_identity_validation_refuses_stream_change():
+    recorded = _identity()
+    jmod.validate_identity(recorded, _identity())
+    # Informational drift (fresh session, different fault schedule) is
+    # exactly what a resume looks like — never a refusal.
+    jmod.validate_identity(
+        recorded,
+        _identity(session="sess-two", faults="task.map:crash-entry:0.1"),
+    )
+    for key, val in (
+        ("seed", 8),
+        ("num_reducers", 8),
+        ("plan", "block:2"),
+        ("filenames", ["/data/other.parquet"]),
+    ):
+        with pytest.raises(ValueError, match=key):
+            jmod.validate_identity(recorded, _identity(**{key: val}))
+
+
+def test_resolve_resume_explicit_mismatch_raises(tmp_path, monkeypatch):
+    """auto-discovery skips a non-matching journal silently (it is a
+    different run, not an error); an EXPLICIT path must refuse."""
+    monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path))
+    j = jmod.begin_run(_identity(seed=99))
+    jmod.end_run(j, status="failed")
+    state, mode = jmod.resolve_resume("auto", _identity())
+    assert state is None and mode == "cursor"
+    with pytest.raises(ValueError, match="seed"):
+        jmod.resolve_resume(j.path, _identity())
+    # Off spellings resolve to no resume at all.
+    assert jmod.resolve_resume("off", _identity()) == (None, "cursor")
+    assert jmod.resolve_resume(None, _identity()) == (None, "cursor")
+
+
+def test_resume_from_auto_without_journal_runs_fresh(
+    monkeypatch, resume_files, tmp_path
+):
+    """``shuffle(resume_from="auto")`` with ``RSDL_JOURNAL`` unset must
+    start fresh and journal nothing — resolve_resume's "nothing to
+    resume, nowhere to journal" outcome, not a begin_run crash."""
+    monkeypatch.delenv("RSDL_JOURNAL", raising=False)
+    monkeypatch.delenv("RSDL_RESUME", raising=False)
+    runtime.init(num_workers=2)
+    try:
+        consumer = CollectingConsumer()
+        shuffle(
+            resume_files, consumer, num_epochs=1,
+            num_reducers=NUM_REDUCERS, num_trainers=1, seed=5,
+            resume_from="auto",
+        )
+        assert sorted(consumer.keys[(0, 0)]) == list(range(TOTAL_ROWS))
+        assert not list(tmp_path.rglob("run-*.ndjson"))
+    finally:
+        runtime.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead off (fresh interpreter)
+# ---------------------------------------------------------------------------
+
+_ZERO_OVERHEAD_CHILD = """
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.pop("RSDL_JOURNAL", None)
+os.environ.pop("RSDL_RESUME", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+
+class Drain(BatchConsumer):
+    def consume(self, rank, epoch, batches):
+        store = runtime.get_context().store
+        for ref in batches:
+            store.free(ref)
+
+    def producer_done(self, rank, epoch):
+        pass
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+fname, _ = generate_file(0, 0, 120, 1, os.environ["ZO_DATA_DIR"])
+runtime.init(num_workers=1)
+shuffle([fname], Drain(), num_epochs=1, num_reducers=2, num_trainers=1,
+        seed=3)
+print(json.dumps({{
+    "journal_imported":
+        "ray_shuffling_data_loader_tpu.runtime.journal" in sys.modules,
+    "sigterm_is_default":
+        signal.getsignal(signal.SIGTERM) == signal.SIG_DFL,
+}}))
+runtime.shutdown()
+"""
+
+
+def test_zero_overhead_off_fresh_interpreter(tmp_path):
+    """The contract the whole plane hangs off: RSDL_JOURNAL unset means
+    the journal module is never imported, no journal file is created,
+    and no SIGTERM handler is installed — proven in a fresh interpreter
+    (this pytest process imported the module long ago)."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("RSDL_")
+    }
+    env["ZO_DATA_DIR"] = str(data_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RSDL_SHM_DIR"] = str(tmp_path / "shm")
+    out = subprocess.run(
+        [sys.executable, "-c", _ZERO_OVERHEAD_CHILD.format(repo=_REPO)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert report["journal_imported"] is False
+    assert report["sigterm_is_default"] is True
+    # No journal artifacts anywhere near the run.
+    assert not list(tmp_path.rglob("run-*.ndjson"))
+
+
+# ---------------------------------------------------------------------------
+# In-process suspend/resume (function-scoped runtime per the chaos recipe)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def resume_files(tmp_path_factory):
+    """Parquet dataset written IN-PROCESS: the function-scoped runtimes
+    below must spawn their pools after the env is armed, so nothing
+    here may touch the runtime."""
+    data_dir = tmp_path_factory.mktemp("resume-data")
+    files = []
+    for i in range(NUM_FILES):
+        fname, _ = generate_file(
+            i, i * ROWS_PER_FILE, ROWS_PER_FILE, 1, str(data_dir)
+        )
+        files.append(fname)
+    return files
+
+
+@pytest.fixture
+def journal_env(monkeypatch, tmp_path):
+    """Arm journal + strict audit + metrics, then a fresh runtime whose
+    workers inherit all three. Function-scoped: suspend state and audit
+    run boundaries are process-global, every test gets its own pool."""
+    def arm(extra_env=None):
+        spool = tmp_path / "audit-spool"
+        spool.mkdir(exist_ok=True)
+        monkeypatch.setenv("RSDL_JOURNAL", str(tmp_path / "journal"))
+        monkeypatch.setenv("RSDL_AUDIT", "1")
+        monkeypatch.setenv("RSDL_AUDIT_STRICT", "1")
+        monkeypatch.setenv("RSDL_AUDIT_DIR", str(spool))
+        monkeypatch.setenv("RSDL_METRICS", "1")
+        monkeypatch.delenv("RSDL_RESUME", raising=False)
+        # An ambient RSDL_FAULTS schedule (the CI resume lane's capped
+        # chaos spec) deliberately rides along: recovery is exactly-once,
+        # so injected crashes must be invisible to every assertion here.
+        for k, v in (extra_env or {}).items():
+            monkeypatch.setenv(k, v)
+        _audit.refresh_from_env()
+        _metrics.refresh_from_env()
+        _metrics.registry.clear()
+        faults.refresh_from_env()
+        return runtime.init(num_workers=2)
+
+    yield arm
+    runtime.shutdown()
+    jmod.clear_suspend()
+    monkeypatch.undo()
+    _audit.reset()
+    _audit.refresh_from_env()
+    _metrics.refresh_from_env()
+    faults.refresh_from_env()
+
+
+class CollectingConsumer(BatchConsumer):
+    """Collects delivered keys per (epoch, rank); optionally requests a
+    graceful suspend once one epoch's window has fully delivered."""
+
+    def __init__(self, suspend_after_epoch=None):
+        self.keys = collections.defaultdict(list)
+        self.done = collections.defaultdict(bool)
+        self.per_epoch = collections.Counter()
+        self.suspend_after_epoch = suspend_after_epoch
+
+    def consume(self, rank, epoch, batches, seq=None):
+        store = runtime.get_context().store
+        for ref in batches:
+            cb = store.get_columns(ref)
+            self.keys[(epoch, rank)].extend(cb["key"].tolist())
+            store.free(ref)
+        self.per_epoch[epoch] += 1
+        if (
+            self.suspend_after_epoch is not None
+            and self.per_epoch[self.suspend_after_epoch] == NUM_REDUCERS
+        ):
+            self.suspend_after_epoch = None
+            jmod.request_suspend()
+
+    def producer_done(self, rank, epoch):
+        self.done[(epoch, rank)] = True
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+def _journal_files(directory):
+    return sorted(
+        (
+            os.path.join(directory, n)
+            for n in os.listdir(directory)
+            if n.startswith("run-") and n.endswith(".ndjson")
+        ),
+        key=os.path.getmtime,
+    )
+
+
+def test_suspend_resume_in_process(journal_env, resume_files, tmp_path):
+    """Programmatic graceful suspend (the in-process twin of SIGTERM):
+    shuffle() quiesces at the reducer barriers, journals the window,
+    and raises RunSuspended. A second shuffle with resume_from="auto"
+    skips the journaled-complete epoch outright (zero stage tasks),
+    finishes the in-flight one from its cursor, and runs the
+    never-admitted one fresh — combined streams exactly-once, strict
+    audit reconciled across BOTH attempts."""
+    journal_env()
+    c1 = CollectingConsumer(suspend_after_epoch=0)
+    with pytest.raises(jmod.RunSuspended) as excinfo:
+        shuffle(
+            resume_files, c1, num_epochs=NUM_EPOCHS,
+            num_reducers=NUM_REDUCERS, num_trainers=1, seed=SEED,
+        )
+    journal_dir = os.environ["RSDL_JOURNAL"]
+    assert os.path.dirname(excinfo.value.journal_path) == journal_dir
+    st = jmod.load_run(excinfo.value.journal_path)
+    assert st.suspended and st.resumable()
+    assert st.epochs[0].done  # epoch 0's whole window was delivered
+    assert c1.per_epoch[0] == NUM_REDUCERS
+    # (The suspend request races the admission loop and the other
+    # in-flight windows — which epochs got how far before quiescing is
+    # deliberately unasserted; the exactly-once union below is the
+    # invariant.)
+    snap = _metrics.registry.snapshot()
+    assert snap.get("recovery.suspended_runs") == 1.0
+
+    c2 = CollectingConsumer()
+    shuffle(
+        resume_files, c2, num_epochs=NUM_EPOCHS,
+        num_reducers=NUM_REDUCERS, num_trainers=1, seed=SEED,
+        resume_from="auto",
+    )
+    # Journaled-complete epoch 0: skipped whole — the resumed run
+    # re-delivered nothing for it and submitted zero stage tasks (the
+    # new journal holds no fresh, non-carried stage records for it).
+    assert c2.per_epoch[0] == 0
+    new_journal = _journal_files(journal_dir)[-1]
+    fresh_e0 = [
+        rec
+        for rec in map(json.loads, open(new_journal))
+        if rec.get("kind") in ("map", "reduce")
+        and rec.get("epoch") == 0
+        and not rec.get("carried")
+    ]
+    assert fresh_e0 == []
+    snap = _metrics.registry.snapshot()
+    assert snap.get("recovery.resume_runs") == 1.0
+    # Epoch 0 is deterministically skipped; epoch 1 may be too when its
+    # window raced to completion before the suspend flag landed.
+    assert snap.get("recovery.resume_epochs_skipped", 0) >= 1.0
+    assert snap.get("recovery.resume_in_progress") == 0.0
+
+    # Exactly-once across the suspension: per (epoch, rank) the two
+    # attempts' streams are disjoint and their union is every row.
+    for epoch in range(NUM_EPOCHS):
+        combined = c1.keys[(epoch, 0)] + c2.keys[(epoch, 0)]
+        assert sorted(combined) == list(range(TOTAL_ROWS)), (
+            f"epoch {epoch} lost or duplicated rows across the suspend"
+        )
+        assert c2.done[(epoch, 0)]
+    # Strict audit already reconciled inside shuffle(); assert the
+    # verdicts fold both attempts into clean exactly-once epochs.
+    summary = _audit.summary()
+    assert summary["ok"] is True, summary
+    # The resumed run completed: its journal is sealed, nothing left
+    # to resume.
+    assert not jmod.load_run(new_journal).resumable()
+    assert jmod.find_resumable(journal_dir, st.identity) is None
+
+
+# ---------------------------------------------------------------------------
+# Kill-and-resume chaos legs (child drivers; SIGKILL / SIGTERM)
+# ---------------------------------------------------------------------------
+
+_CHILD_DRIVER = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["RESUME_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+mode = os.environ["RESUME_MODE"]
+files = json.loads(os.environ["RESUME_FILES"])
+epochs = int(os.environ["RESUME_EPOCHS"])
+reducers = int(os.environ["RESUME_REDUCERS"])
+
+runtime.init(num_workers=2)
+
+if mode == "victim":
+    # Die mid-epoch-window, deterministically W.R.T. JOURNAL CONTENT:
+    # a watcher thread folds the journal file until some epoch's whole
+    # window is journaled delivered while another epoch's is partial,
+    # then signals ourselves. (A parent-driven kill races the deliver
+    # threads: by the time the parent reacts, the run may be done.)
+    import glob as _glob
+    import signal as _signal
+    import threading as _threading
+
+    _sig = getattr(_signal, "SIG" + os.environ.get("RESUME_KILL", "KILL"))
+    _jdir = os.environ["RSDL_JOURNAL"]
+
+    def _watch():
+        while True:
+            time.sleep(0.02)
+            for path in _glob.glob(os.path.join(_jdir, "run-*.ndjson")):
+                cursors = {}
+                try:
+                    with open(path) as f:
+                        for line in f:
+                            if not line.endswith("\n"):
+                                break
+                            try:
+                                rec = json.loads(line)
+                            except ValueError:
+                                continue
+                            if rec.get("kind") == "deliver":
+                                e = int(rec["epoch"])
+                                cursors[e] = max(
+                                    cursors.get(e, 0),
+                                    int(rec["reducer"]) + 1,
+                                )
+                except OSError:
+                    continue
+                full = any(c >= reducers for c in cursors.values())
+                partial = any(0 < c < reducers for c in cursors.values())
+                if full and partial:
+                    os.kill(os.getpid(), _sig)
+                    return
+
+    _threading.Thread(target=_watch, daemon=True).start()
+
+
+import threading as _thr
+
+_epoch0_done = _thr.Event()
+_epoch0_count = [0]
+
+
+class Drain(BatchConsumer):
+    def consume(self, rank, epoch, batches, seq=None):
+        if mode == "victim" and epoch > 0:
+            # Desynchronize the concurrent epoch windows: without this
+            # they deliver in lockstep (their sleeps wake together) and
+            # the "one window complete, another partial" state the
+            # watcher kills on can collapse to milliseconds. Holding
+            # later epochs until epoch 0's window fully delivered makes
+            # that state hold for several deliveries' worth of time.
+            _epoch0_done.wait(timeout=60)
+        store = runtime.get_context().store
+        for ref in batches:
+            store.free(ref)
+        print("DELIVERED %d %s" % (epoch, seq), flush=True)
+        if mode == "victim":
+            if epoch == 0:
+                _epoch0_count[0] += 1
+                if _epoch0_count[0] >= reducers:
+                    _epoch0_done.set()
+            time.sleep(0.1)  # widen the kill window
+
+    def producer_done(self, rank, epoch):
+        pass
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+shuffle(files, Drain(), num_epochs=epochs, num_reducers=reducers,
+        num_trainers=1, seed=int(os.environ["RESUME_SEED"]))
+verdicts = _audit.reconcile(range(epochs))
+snap = _metrics.registry.snapshot() if _metrics.enabled() else {}
+print("RESULT " + json.dumps({
+    "verdicts": [{"epoch": v["epoch"], "ok": v["ok"],
+                  "delivered_seq": v.get("delivered_seq")}
+                 for v in verdicts],
+    "recovery": {k: v for k, v in snap.items()
+                 if k.startswith("recovery.")},
+}), flush=True)
+runtime.shutdown()
+if os.environ.get("RESUME_CAPACITY"):
+    from ray_shuffling_data_loader_tpu.telemetry import capacity
+    print("CAPACITY " + json.dumps(capacity.ledger()["totals"]),
+          flush=True)
+"""
+
+
+class _ResumeHarness:
+    """Shared driver-process harness: a control run's digests plus the
+    work dirs the victim/resume legs reuse."""
+
+    def __init__(self, files, work):
+        self.files = files
+        self.work = work
+        self.journal_dir = os.path.join(work, "journal")
+        self.shm_dir = os.path.join(work, "shm")
+        self.spool_run = os.path.join(work, "audit-run")
+        self.metrics_dir = os.path.join(work, "metrics")
+        spool_ctrl = os.path.join(work, "audit-ctrl")
+        for d in (self.journal_dir, self.shm_dir, self.spool_run,
+                  self.metrics_dir, spool_ctrl):
+            os.makedirs(d)
+        ctrl, _, lines, _rc = self.child(
+            "control", {"RSDL_AUDIT_DIR": spool_ctrl,
+                        "RSDL_SHM_DIR": os.path.join(work, "shm-ctrl")},
+        )
+        assert ctrl is not None, "\n".join(lines[-30:])
+        self.control_seq = {
+            v["epoch"]: v["delivered_seq"] for v in ctrl["verdicts"]
+        }
+        assert len(self.control_seq) == NUM_EPOCHS
+
+    def base_env(self):
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if not k.startswith("RSDL_")
+        }
+        # The chaos schedule (when the CI resume lane arms one) rides
+        # into every child driver: digest equality must hold across a
+        # preemption even while fault recovery is churning underneath.
+        for key in ("RSDL_FAULTS", "RSDL_FAULTS_SEED"):
+            if os.environ.get(key):
+                env[key] = os.environ[key]
+        env.update(
+            RESUME_REPO=_REPO,
+            RESUME_FILES=json.dumps(self.files),
+            RESUME_EPOCHS=str(NUM_EPOCHS),
+            RESUME_REDUCERS=str(NUM_REDUCERS),
+            RESUME_SEED=str(SEED),
+            RSDL_SHM_DIR=self.shm_dir,
+            RSDL_AUDIT="1",
+            RSDL_METRICS="1",
+            JAX_PLATFORMS="cpu",
+        )
+        return env
+
+    def child(self, mode, extra):
+        """Run one driver child to completion (victims kill themselves
+        from a journal-watching thread once the kill condition — one
+        epoch window journaled complete, another partial — holds)."""
+        env = dict(self.base_env(), RESUME_MODE=mode, **extra)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_DRIVER],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True,
+        )
+        result, capacity, lines = None, None, []
+        for line in proc.stdout:
+            line = line.rstrip()
+            lines.append(line)
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+            elif line.startswith("CAPACITY "):
+                capacity = json.loads(line[len("CAPACITY "):])
+        returncode = proc.wait()
+        return result, capacity, lines, returncode
+
+    def victim(self, sig):
+        _, _, lines, returncode = self.child(
+            "victim",
+            # The victim shares the resume leg's metrics spool so its
+            # workers' capacity create-records (flushed at the task-done
+            # barrier) survive the kill — the resumed run's ledger fold
+            # then resolves the superseded session's deletes against
+            # real creates instead of orphans.
+            {"RSDL_AUDIT_DIR": self.spool_run,
+             "RSDL_JOURNAL": self.journal_dir,
+             "RSDL_METRICS_DIR": self.metrics_dir,
+             "RESUME_KILL": sig},
+        )
+        files = _journal_files(self.journal_dir)
+        assert files, "victim journaled nothing:\n" + "\n".join(lines[-20:])
+        st = jmod.load_run(files[-1])
+        assert st.resumable(), (
+            "victim's journal is not resumable (kill condition never "
+            "held?):\n" + "\n".join(lines[-20:])
+        )
+        return st, lines, returncode
+
+    def resume(self):
+        res, cap, lines, _rc = self.child(
+            "resume",
+            {"RSDL_AUDIT_DIR": self.spool_run,
+             "RSDL_JOURNAL": self.journal_dir,
+             "RSDL_RESUME": "auto", "RSDL_AUDIT_STRICT": "1",
+             "RSDL_METRICS_DIR": self.metrics_dir,
+             "RESUME_CAPACITY": "1"},
+        )
+        assert res is not None, (
+            "resumed driver died:\n" + "\n".join(lines[-40:])
+        )
+        return res, cap
+
+
+@pytest.fixture
+def resume_harness(resume_files, tmp_path):
+    return _ResumeHarness(resume_files, str(tmp_path))
+
+
+def test_sigkill_and_resume_bit_identical(resume_harness):
+    """THE acceptance scenario: the driver is SIGKILLed mid-epoch-window
+    (no goodbye, no flush beyond the barriers already taken), a fresh
+    driver resumes from the journal, and every epoch's order-sensitive
+    per-rank delivered_seq digest is bit-identical to an uninterrupted
+    same-seed run — under strict audit, with the journaled-complete
+    epoch re-executing zero stage tasks and the capacity ledger's
+    residency folding to zero after cleanup."""
+    h = resume_harness
+    old, _, _ = h.victim("KILL")
+    res, cap = h.resume()
+
+    res_seq = {v["epoch"]: v["delivered_seq"] for v in res["verdicts"]}
+    assert res_seq == h.control_seq, (
+        f"delivered_seq diverged: control={h.control_seq} resumed={res_seq}"
+    )
+    assert all(v["ok"] for v in res["verdicts"])
+    rec = res["recovery"]
+    assert rec.get("recovery.resume_runs") == 1.0
+    assert rec.get("recovery.resumed_epochs", 0) >= 1.0
+    # The fully-delivered epoch was skipped whole: zero map/reduce
+    # tasks — counter-asserted, and its window never re-entered the
+    # new journal as fresh stage records.
+    assert rec.get("recovery.resume_epochs_skipped", 0) >= 1.0
+    done_epochs = [
+        e for e, st in old.epochs.items() if st.delivered >= NUM_REDUCERS
+    ]
+    assert done_epochs, "kill landed before any epoch window completed"
+    new_journal = _journal_files(h.journal_dir)[-1]
+    fresh = [
+        r
+        for r in map(json.loads, open(new_journal))
+        if r.get("kind") in ("map", "reduce")
+        and r.get("epoch") in done_epochs
+        and not r.get("carried")
+    ]
+    assert fresh == []
+    # Preempted-session segments were swept (the resumed run owns the
+    # superseded session's reclamation) and the ledger agrees: nothing
+    # resident on any tier once the run cleaned up.
+    assert os.listdir(h.shm_dir) == []
+    assert cap is not None
+    for tier, cell in cap.items():
+        assert cell["resident_bytes"] == 0, (tier, cap)
+
+
+def test_sigterm_graceful_suspend_then_resume(resume_harness):
+    """The preemption-notice path: SIGTERM makes the journal-armed
+    driver quiesce its windows, flush, journal the suspension, and
+    leave with exit 0 — and the resumed run completes the stream
+    bit-identically."""
+    h = resume_harness
+    st, lines, returncode = h.victim("TERM")
+    # The SIGTERM child must have exited through the graceful path:
+    # exit 0 with an explicit suspension record journaled.
+    assert returncode == 0, lines[-15:]
+    assert st.suspended, lines[-10:]
+    res, _ = h.resume()
+    res_seq = {v["epoch"]: v["delivered_seq"] for v in res["verdicts"]}
+    assert res_seq == h.control_seq
+    assert all(v["ok"] for v in res["verdicts"])
+
+
+def test_sigkill_resume_with_segments_dropped(resume_harness):
+    """Degraded resume: every store segment of the preempted session is
+    gone (host swapped out from under the job). Stage re-attach fails
+    closed, everything journaled-but-undelivered re-executes from the
+    seed, and the delivered digests STILL match the uninterrupted run."""
+    h = resume_harness
+    h.victim("KILL")
+    for name in os.listdir(h.shm_dir):
+        os.unlink(os.path.join(h.shm_dir, name))
+    res, _ = h.resume()
+    res_seq = {v["epoch"]: v["delivered_seq"] for v in res["verdicts"]}
+    assert res_seq == h.control_seq, (
+        f"delivered_seq diverged: control={h.control_seq} resumed={res_seq}"
+    )
+    assert all(v["ok"] for v in res["verdicts"])
+    # The degraded path was actually taken: journaled stages whose
+    # segments vanished were re-executed, not re-attached.
+    rec = res["recovery"]
+    reexecuted = sum(
+        v for k, v in rec.items()
+        if k.startswith("recovery.resume_reexecuted")
+    )
+    assert reexecuted > 0, rec
+
+
+# ---------------------------------------------------------------------------
+# tools/replay.py
+# ---------------------------------------------------------------------------
+
+
+def test_replay_reproduces_and_detects_divergence(
+    journal_env, resume_files, tmp_path
+):
+    """A journaled, completed run replays bit-identically (exit 0); a
+    journal whose recorded digest is tampered with makes the same
+    replay exit 1 and name the diverging field."""
+    journal_env()
+    shuffle(
+        resume_files, CollectingConsumer(), num_epochs=2,
+        num_reducers=NUM_REDUCERS, num_trainers=1, seed=SEED,
+    )
+    journal_dir = os.environ["RSDL_JOURNAL"]
+    journal_path = _journal_files(journal_dir)[-1]
+    st = jmod.load_run(journal_path)
+    assert st.done and sorted(st.verdicts) == [0, 1]
+
+    env = {
+        k: v for k, v in os.environ.items() if not k.startswith("RSDL_")
+    }
+    env["RSDL_SHM_DIR"] = str(tmp_path / "replay-shm")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "replay.py"),
+         journal_path, "--epoch", "1"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["ok"] is True
+    assert report["epochs"]["1"]["ok"] is True
+    assert report["epochs"]["1"]["diverged"] == {}
+
+    # Tamper with the recorded digest: replay must refute it.
+    lines = open(journal_path).read().splitlines()
+    tampered = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("kind") == "verdict" and rec.get("epoch") == 1:
+            rec["delivered_seq"] = "0" * 16
+            line = json.dumps(rec)
+        tampered.append(line)
+    with open(journal_path, "w") as f:
+        f.write("\n".join(tampered) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "replay.py"),
+         journal_path, "--epoch", "1"],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["ok"] is False
+    assert "delivered_seq" in report["epochs"]["1"]["diverged"]

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Time-travel debugging: re-run one journaled epoch and prove (or
+refute) that it reproduces.
+
+A run recorded under ``RSDL_JOURNAL`` (runtime/journal.py) carries
+everything that determined its delivered stream — seed, plan spec,
+topology, column set, fault schedule — plus the per-epoch audit
+verdicts journaled at the reconcile barrier, including the
+order-sensitive per-rank ``delivered_seq`` digest. This tool replays
+epoch N of such a run on a fresh runtime under the *recorded* identity
+(same seed, same ``RSDL_SHUFFLE_PLAN``, same ``RSDL_FAULTS`` schedule
+and ``RSDL_FAULTS_SEED``), reconciles the replay's digests, and
+compares them field-for-field against the journal:
+
+* match → exit 0 (the epoch reproduces — determinism held through
+  whatever faults the schedule injected);
+* divergence → exit 1, with the differing fields named in the JSON
+  report (a reproducibility bug, or a replay environment that differs
+  from the recorded one in a stream-determining knob);
+* usage / journal errors → exit 2.
+
+One ``epoch.replayed`` event is emitted per compared epoch (when the
+events plane is armed), so replays are visible in the run's timeline.
+
+Usage::
+
+    python tools/replay.py <journal-file-or-dir> [--epoch N]
+        [--workers W] [--json OUT]
+
+``--epoch`` defaults to every epoch the journal holds a verdict for.
+The journal must be a COMPLETED run (verdicts are journaled at the
+end-of-run reconcile); replaying a suspended run's journal exits 2 —
+resume it first (``RSDL_RESUME=auto``), then replay the resumed run's
+journal. The replay itself never journals and never resumes: it is a
+read-only re-execution of recorded history.
+
+See docs/robustness.md ("Preemption, suspend/resume, and replay").
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The digest fields a replay must reproduce, in report order.
+# ``delivered_seq`` is THE acceptance digest: order-sensitive per-rank
+# fold of every delivered row window. The coverage digests and row
+# counts pin the map/reduce sides too.
+_COMPARED = (
+    "delivered_seq",
+    "delivered_digest",
+    "map_digest",
+    "reduce_digest",
+    "rows_mapped",
+    "rows_reduced",
+    "rows_delivered",
+)
+
+
+def _die(msg: str) -> "NoReturn":  # noqa: F821 — py38-friendly
+    print(f"replay: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def _load_state(path: str):
+    from ray_shuffling_data_loader_tpu.runtime import journal
+
+    if os.path.isdir(path):
+        files = journal._run_files(path)
+        if not files:
+            _die(f"no run journals under {path!r}")
+        path = files[0]
+    try:
+        return journal.load_run(path)
+    except (OSError, ValueError) as exc:
+        _die(f"cannot load journal {path!r}: {exc}")
+
+
+def _arm_recorded_env(identity: dict) -> None:
+    """Point every stream-determining env knob at the RECORDED value —
+    including clearing knobs the recorded run did not have set. The
+    replay must not inherit this shell's divergent schedule."""
+    plan = identity.get("plan") or "rowwise"
+    os.environ["RSDL_SHUFFLE_PLAN"] = plan
+    for key, val in (
+        ("RSDL_FAULTS", identity.get("faults")),
+        ("RSDL_FAULTS_SEED", identity.get("faults_seed")),
+    ):
+        if val:
+            os.environ[key] = str(val)
+        else:
+            os.environ.pop(key, None)
+    # Read-only re-execution: never journal the replay, never resume.
+    os.environ.pop("RSDL_JOURNAL", None)
+    os.environ.pop("RSDL_RESUME", None)
+    # Fresh audit spool: the replay's digests must fold alone.
+    os.environ["RSDL_AUDIT"] = "1"
+    os.environ.pop("RSDL_AUDIT_STRICT", None)  # we diff, not raise
+    os.environ["RSDL_AUDIT_DIR"] = tempfile.mkdtemp(prefix="rsdl-replay-")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("journal", help="journal file, or a journal dir "
+                        "(newest run file is picked)")
+    parser.add_argument("--epoch", type=int, default=None,
+                        help="epoch to replay (default: every epoch the "
+                        "journal holds a verdict for)")
+    parser.add_argument("--workers", type=int, default=2,
+                        help="pool workers for the replay runtime")
+    parser.add_argument("--json", dest="json_out", default=None,
+                        help="also write the report JSON here")
+    args = parser.parse_args(argv)
+
+    state = _load_state(args.journal)
+    if not state.verdicts:
+        _die(
+            f"journal {state.path!r} holds no reconciled verdicts "
+            "(suspended or failed run?) — resume it to completion "
+            "first, then replay the resumed run's journal"
+        )
+    if args.epoch is not None:
+        if args.epoch not in state.verdicts:
+            _die(
+                f"no journaled verdict for epoch {args.epoch} "
+                f"(have: {sorted(state.verdicts)})"
+            )
+        epochs = [args.epoch]
+    else:
+        epochs = sorted(state.verdicts)
+
+    identity = state.identity
+    missing = [f for f in identity.get("filenames", []) if
+               "://" not in f and not os.path.exists(f)]
+    if missing:
+        _die(f"recorded input files are gone: {missing[:3]}")
+    _arm_recorded_env(identity)
+
+    from ray_shuffling_data_loader_tpu import runtime, telemetry
+    from ray_shuffling_data_loader_tpu.runtime import faults
+    from ray_shuffling_data_loader_tpu.shuffle import (
+        BatchConsumer,
+        shuffle,
+    )
+    from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+
+    _audit.refresh_from_env()
+    faults.refresh_from_env()
+
+    device_layout = None
+    if identity.get("device_batch"):
+        device_layout = {
+            "batch": int(identity["device_batch"]),
+            "columns": list(identity.get("device_columns") or []),
+        }
+
+    class _Drain(BatchConsumer):
+        def consume(self, rank, epoch, batches):
+            store = runtime.get_context().store
+            for ref in batches:
+                store.free(ref)
+
+        def producer_done(self, rank, epoch):
+            pass
+
+        def wait_until_ready(self, epoch):
+            pass
+
+        def wait_until_all_epochs_done(self):
+            pass
+
+    report = {
+        "journal": state.path,
+        "run_id": state.run_id,
+        "epochs": {},
+        "ok": True,
+    }
+    runtime.init(num_workers=args.workers)
+    try:
+        for epoch in epochs:
+            _audit.begin_run()
+            shuffle(
+                list(identity["filenames"]),
+                _Drain(),
+                num_epochs=epoch + 1,
+                num_reducers=int(identity["num_reducers"]),
+                num_trainers=int(identity["num_trainers"]),
+                seed=int(identity["seed"]),
+                start_epoch=epoch,
+                narrow_to_32=bool(identity.get("narrow_to_32")),
+                columns=identity.get("columns"),
+                device_layout=device_layout,
+            )
+            verdicts = _audit.reconcile([epoch])
+            replayed = verdicts[0] if verdicts else {}
+            recorded = state.verdicts[epoch]
+            diverged = {
+                f: {"recorded": recorded.get(f), "replayed": replayed.get(f)}
+                for f in _COMPARED
+                if recorded.get(f) != replayed.get(f)
+            }
+            ok = not diverged and replayed.get("ok") is True
+            report["epochs"][str(epoch)] = {
+                "ok": ok,
+                "diverged": diverged,
+                "delivered_seq": replayed.get("delivered_seq"),
+                "audit_ok": replayed.get("ok"),
+            }
+            report["ok"] = report["ok"] and ok
+            telemetry.emit_event(
+                "epoch.replayed", _flush=True, epoch=epoch,
+                run_id=state.run_id, ok=ok,
+                diverged=sorted(diverged) or None,
+            )
+    finally:
+        try:
+            runtime.shutdown()
+        except Exception:
+            pass
+
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(out + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
