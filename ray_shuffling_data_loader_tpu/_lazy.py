@@ -1,0 +1,54 @@
+"""Lazy module proxies for env-gated planes (ISSUE 14).
+
+The repo's gate-integrity invariant (enforced by
+``tools/rsdl_lint.py``, checker ``gate-integrity``) is that env-gated
+planes — the telemetry planes and ``runtime/{journal,faults,elastic}``
+— are never *module-level* imports of the core data-path modules:
+importing ``shuffle`` or ``runtime.store`` must not execute a gated
+plane's module body. Hot call sites still want module-attribute syntax
+(``_audit.enabled()``), so this shim gives them a proxy whose first
+attribute access performs the real (function-level, hence allowed)
+import and then delegates forever after.
+
+Cost: one ``__getattr__`` + ``getattr`` per attribute access after the
+first (the import itself happens once). Every site this proxies is
+per-task / per-batch / per-frame, never per-row, so the overhead is
+noise next to the work the call does.
+"""
+
+from __future__ import annotations
+
+
+class _LazyModule:
+    """Attribute-forwarding proxy that imports ``name`` on first use."""
+
+    __slots__ = ("_rsdl_lazy_name", "_rsdl_lazy_mod")
+
+    def __init__(self, name: str):
+        object.__setattr__(self, "_rsdl_lazy_name", name)
+        object.__setattr__(self, "_rsdl_lazy_mod", None)
+
+    def _rsdl_resolve(self):
+        mod = self._rsdl_lazy_mod
+        if mod is None:
+            import importlib
+
+            mod = importlib.import_module(self._rsdl_lazy_name)
+            object.__setattr__(self, "_rsdl_lazy_mod", mod)
+        return mod
+
+    def __getattr__(self, attr: str):
+        return getattr(self._rsdl_resolve(), attr)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        state = "loaded" if self._rsdl_lazy_mod is not None else "unloaded"
+        return f"<lazy module {self._rsdl_lazy_name!r} ({state})>"
+
+
+def lazy_module(name: str) -> _LazyModule:
+    """Return a proxy for module ``name`` that imports it on first
+    attribute access. The returned object is NOT the module (identity
+    checks and ``sys.modules`` lookups see the real module only after
+    first use); call sites that need the module object itself should do
+    a function-level import instead."""
+    return _LazyModule(name)

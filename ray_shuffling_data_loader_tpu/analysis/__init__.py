@@ -1,0 +1,48 @@
+"""rsdl-lint: the invariant-enforcing static-analysis plane (ISSUE 14).
+
+Thirteen PRs of cross-cutting invariants — zero-overhead-off lazy-import
+gating, flush-before-task-done spool barriers, seeded determinism on
+every plan/digest path, a documented knob and metric vocabulary — were
+until now re-proven by hand-written tests and re-discovered in review.
+This package checks them *structurally*, on every commit, from the AST:
+
+========================  ===================================================
+checker                   invariant
+========================  ===================================================
+``gate-integrity``        env-gated planes (telemetry planes,
+                          ``runtime/{journal,faults,elastic}``) are reachable
+                          from core data-path modules only through
+                          function-level lazy imports / ``sys.modules``
+                          lookups, never module-level ones
+``knob-registry``         every ``RSDL_*`` env read is declared in the
+                          central registry (:mod:`.knob_registry`) and every
+                          public knob is documented in ``docs/TUNING.md``
+``vocabulary-drift``      metric names, ``rsdl_`` Prometheus aliases, and
+                          event kinds emitted by code appear in
+                          ``docs/observability.md``
+``determinism-hygiene``   no unseeded ``random``/``np.random``/time-derived
+                          seeding in plan- or digest-affecting modules
+``lock-discipline``       module-level mutable state mutated off-lock in
+                          threaded modules; inconsistent lock-acquisition
+                          order across ``with`` statements
+``barrier-order``         spool flushes precede task-done / quiesce
+                          signaling in ``runtime/tasks.py`` and
+                          ``runtime/actor.py``
+========================  ===================================================
+
+Entry point: ``tools/rsdl_lint.py`` (human + ``--json`` output,
+``--explain CHECK``, per-line ``# rsdl-lint: disable=CHECK -- reason``
+suppressions). Policy and the how-to for registering a new knob or
+metric: ``docs/static-analysis.md``.
+"""
+
+from ray_shuffling_data_loader_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintCrash,
+)
+from ray_shuffling_data_loader_tpu.analysis.project import Project  # noqa: F401
+from ray_shuffling_data_loader_tpu.analysis.checkers import (  # noqa: F401
+    all_checkers,
+    get_checker,
+    run_checks,
+)

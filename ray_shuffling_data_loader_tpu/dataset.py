@@ -38,8 +38,13 @@ from ray_shuffling_data_loader_tpu.runtime.store import (
     logical_columns,
 )
 from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
-from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
-from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
+# Gated planes (ISSUE 14 gate-integrity): lazy proxies, resolved on
+# first attribute access — importing the dataset layer must not execute
+# a telemetry-plane module body.
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
+
+_audit = lazy_module("ray_shuffling_data_loader_tpu.telemetry.audit")
+_phases = lazy_module("ray_shuffling_data_loader_tpu.telemetry.phases")
 
 # Default reducer share of cluster cores (reference ``dataset.py:12``).
 REDUCER_CLUSTER_CORE_SHARE = 0.6

@@ -29,7 +29,10 @@ import tempfile
 import threading
 from typing import Callable, Optional
 
-from . import faults  # noqa: F401  (the fault-injection plane)
+# The fault-injection plane is NOT imported here (ISSUE 14
+# gate-integrity): ``runtime.faults`` resolves through the PEP 562
+# ``__getattr__`` at the bottom of this module, so importing the
+# runtime package never executes the plane's module body.
 from .actor import (  # noqa: F401
     ActorDiedError,
     ActorHandle,
@@ -438,14 +441,18 @@ def shutdown() -> None:
     # (a trainer rank with consume-side counters) leaving the session is
     # exactly the exit this plane must not lose metrics at. (The owner's
     # own file dies with its rmtree below, but with an RSDL_METRICS_DIR
-    # override the spool outlives the session, so flush unconditionally
-    # — it is cheap and metrics-gated inside.)
+    # override the spool outlives the session.) Metrics-gated BEFORE
+    # the import (ISSUE 14): a disabled run must not load the export
+    # plane just to no-op its flush.
     try:
-        from ray_shuffling_data_loader_tpu.telemetry import (
-            export as _metrics_export,
-        )
+        from ray_shuffling_data_loader_tpu.telemetry import metrics
 
-        _metrics_export.safe_flush()
+        if metrics.enabled():
+            from ray_shuffling_data_loader_tpu.telemetry import (
+                export as _metrics_export,
+            )
+
+            _metrics_export.safe_flush()
     except Exception:
         pass
     if os.environ.get(_ENV_DIR) == ctx.runtime_dir and ctx.owner:
@@ -599,3 +606,21 @@ def free(refs) -> None:
 
 def store_stats() -> StoreStats:
     return get_context().store.store_stats()
+
+
+def __getattr__(name):
+    # PEP 562 lazy resolution for the fault-injection plane (ISSUE 14
+    # gate-integrity): `runtime.faults` and `from ...runtime import
+    # faults` both keep working, but the plane's module body executes
+    # only on first touch. After that first import the package
+    # attribute exists for real and this hook is never consulted again.
+    if name == "faults":
+        # importlib, NOT `from . import faults`: the from-import form
+        # re-enters this __getattr__ while the attribute is still
+        # unbound and recurses forever.
+        import importlib
+
+        return importlib.import_module(f"{__name__}.faults")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

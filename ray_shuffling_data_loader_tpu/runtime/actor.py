@@ -40,14 +40,51 @@ from typing import Any, Dict, Optional
 
 from ray_shuffling_data_loader_tpu import telemetry
 
-from . import faults, transport
+from . import transport
+
+# Fault-injection plane (ISSUE 14 gate-integrity): lazy proxy — never
+# imported by merely importing the actor layer.
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
+from ray_shuffling_data_loader_tpu.telemetry import _env
+
+faults = lazy_module("ray_shuffling_data_loader_tpu.runtime.faults")
 from .retry import call_policy, connect_policy
 from .transport import Address
 
 
 # The caller's trace context to ship with a request frame, or None when
-# tracing is off (the common case — one cached boolean check).
-_trace_ctx = telemetry.outbound_context
+# tracing is off (the common case). A def, not a module-level
+# ``telemetry.outbound_context`` binding: binding the facade attribute
+# at import time would eagerly pull telemetry.trace into every process
+# that imports the actor layer (gate-integrity, ISSUE 14). The
+# sys.modules gate keeps the disabled path import-free at CALL time
+# too: context can only be non-empty if something already imported
+# trace (set_context/context/enable live there), and the metrics half
+# ships identity through the same outbound path only when enabled.
+def _trace_ctx():
+    if (
+        sys.modules.get("ray_shuffling_data_loader_tpu.telemetry.trace")
+        is None
+        and not telemetry.metrics.enabled()
+    ):
+        return None
+    return telemetry.outbound_context()
+
+
+def _flush_telemetry_spools(maybe: bool = False) -> None:
+    """Actor-host spool barrier (quiescence + exit): flush trace only
+    if its module is already loaded (never imported ⇒ nothing buffered
+    ⇒ nothing to import just to no-op), export only when metrics are on
+    (its spool is metrics-gated). Keeps the disabled path import-free
+    at runtime, matching the structural gate (ISSUE 14)."""
+    mod = sys.modules.get("ray_shuffling_data_loader_tpu.telemetry.trace")
+    if mod is not None:
+        mod.safe_flush()
+    if telemetry.metrics.enabled():
+        if maybe:
+            telemetry.export.maybe_flush()
+        else:
+            telemetry.export.safe_flush()
 
 
 # Virtual thread ids for traced dispatches: concurrent dispatches all run
@@ -304,8 +341,7 @@ class _ActorHost:
             # to the driver's live aggregation mid-run.
             self._inflight -= 1
             if self._inflight == 0:
-                telemetry.safe_flush()
-                telemetry.export.maybe_flush()
+                _flush_telemetry_spools(maybe=True)
 
     async def start(self):
         """Bind the server socket; returns once the actor is reachable.
@@ -357,8 +393,13 @@ def _actor_main(
                     os._exit(0)
 
         threading.Thread(target=_watch, daemon=True).start()
-    faults.set_role("actor")  # fault rules with an /actor filter fire here
-    if telemetry.enabled():
+    # Unconditional: the role tag is process IDENTITY (telemetry spool
+    # source records stamp it), not just /actor-filtered fault rules.
+    faults.set_role("actor")
+    if _env.read_flag("RSDL_TRACE"):
+        # Entrypoint-equivalent of telemetry.enabled(): a freshly
+        # spawned process can only have been enabled via env, and the
+        # flag read skips importing the trace module when off.
         telemetry.set_process_name(f"actor:{cls.__name__}-{os.getpid()}")
     try:
         instance = cls(*args, **kwargs)
@@ -392,8 +433,7 @@ def _actor_main(
         # final metrics snapshot to their spools before the process
         # exits (atexit also fires on clean exits, but not on the
         # SIGKILL escalation path).
-        telemetry.safe_flush()
-        telemetry.export.safe_flush()
+        _flush_telemetry_spools()
         if registry_path is not None:
             try:
                 os.unlink(registry_path)
@@ -756,6 +796,10 @@ def spawn_actor(
         if holder_alive:
             raise ValueError(f"actor name {name!r} already registered")
         try:
+            # rsdl-lint: disable=barrier-order -- evicting a DEAD
+            # foreign actor's stale record, not self-deregistration:
+            # the dead holder's spools were flushed (or lost) with it,
+            # this process has nothing to flush on its behalf
             os.unlink(registry_path)
         except FileNotFoundError:
             pass

@@ -43,7 +43,14 @@ import numpy as np
 
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 
-from . import faults, transport as _transport
+from . import transport as _transport
+
+# Fault-injection plane (ISSUE 14 gate-integrity): lazy proxy — the
+# store's fault sites pay one proxy getattr, the import happens only if
+# a site actually runs.
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
+
+faults = lazy_module("ray_shuffling_data_loader_tpu.runtime.faults")
 
 _MAGIC = b"RSDL1\x00"
 _ALIGN = 64

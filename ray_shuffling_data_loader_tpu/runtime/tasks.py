@@ -21,8 +21,12 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_shuffling_data_loader_tpu import telemetry
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
 
-from . import faults
+# Fault-injection plane (ISSUE 14 gate-integrity): lazy proxy — the
+# plane's module body runs only when a worker actually starts, never
+# when this module is imported.
+faults = lazy_module("ray_shuffling_data_loader_tpu.runtime.faults")
 
 
 class TaskError(Exception):
@@ -176,14 +180,41 @@ def _record_task_done(fn, duration_s: float, trace_ctx) -> None:
         pass
 
 
+def _outbound_ctx():
+    """The submitter's trace context to pickle next to the task, or
+    None with no facade touch when nothing can have produced one —
+    context lives in telemetry.trace (never imported ⇒ empty) and the
+    metrics half ships identity through the same path only when
+    enabled. Mirrors runtime/actor.py's _trace_ctx (ISSUE 14: the
+    disabled submit path stays import-free)."""
+    import sys as _sys
+
+    if (
+        _sys.modules.get("ray_shuffling_data_loader_tpu.telemetry.trace")
+        is None
+        and not telemetry.metrics.enabled()
+    ):
+        return None
+    return telemetry.outbound_context()
+
+
 def _flush_telemetry_spools() -> None:
     """The task-done spool barrier: trace, audit, metrics registry,
     plus (metrics-gated, lazily imported) the event log and straggler
-    task records."""
-    telemetry.safe_flush()
-    telemetry.audit.safe_flush()
-    telemetry.export.safe_flush()
+    task records. Trace/audit flush via ``sys.modules`` — a module
+    never imported has nothing buffered, and touching the facade
+    attribute instead would import it just to no-op (ISSUE 14: the
+    disabled path stays import-free, not merely cheap)."""
+    import sys as _sys
+
+    for _name in ("trace", "audit"):
+        _mod = _sys.modules.get(
+            f"ray_shuffling_data_loader_tpu.telemetry.{_name}"
+        )
+        if _mod is not None:
+            _mod.safe_flush()
     if telemetry.metrics.enabled():
+        telemetry.export.safe_flush()
         try:
             from ray_shuffling_data_loader_tpu.telemetry import (
                 capacity,
@@ -203,9 +234,22 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
 
     os.environ.update(env)
     pid = os.getpid()
-    faults.set_role("task")  # fault rules with a /task filter fire here
-    if telemetry.enabled():
+    # Unconditional: the role tag is process IDENTITY — the telemetry
+    # spools (events/metrics source records) stamp it, not just
+    # /task-filtered fault rules — so it must be set even with the
+    # fault plane unarmed. (One cheap stdlib import per worker, at
+    # worker start, never at module import — the gate invariant.)
+    faults.set_role("task")
+    # Entrypoint-equivalent of telemetry.enabled(): a freshly spawned
+    # worker can only have tracing on via env, and the flag read skips
+    # importing the trace module when off (ISSUE 14: the disabled path
+    # stays import-free at runtime, not just at import time).
+    from ray_shuffling_data_loader_tpu.telemetry import _env
+
+    trace_on = _env.read_flag("RSDL_TRACE")
+    if trace_on:
         telemetry.set_process_name(f"task-worker-{pid}")
+    instrumented = trace_on or telemetry.metrics.enabled()
     # Orphan self-destruct: if the pool owner dies without shutdown (e.g.
     # SIGKILL), exit rather than linger holding inherited pipes/fds.
     parent = os.getppid()
@@ -235,9 +279,14 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
             # make in-task spans inherit (trial, epoch, ...).
             fn, args, kwargs, trace_ctx = pickle.loads(blob)
             t0 = _time.perf_counter()
-            with telemetry.propagated_span(
-                f"task:{getattr(fn, '__name__', 'task')}", trace_ctx
-            ):
+            if instrumented or trace_ctx is not None:
+                with telemetry.propagated_span(
+                    f"task:{getattr(fn, '__name__', 'task')}", trace_ctx
+                ):
+                    result = fn(*args, **kwargs)
+            else:
+                # Fully disabled: don't resolve the facade span (it
+                # would import telemetry.trace just to no-op).
                 result = fn(*args, **kwargs)
             _record_task_done(fn, _time.perf_counter() - t0, trace_ctx)
             # Flush BEFORE reporting done: by the time the caller can
@@ -494,7 +543,7 @@ class WorkerPool:
         # The submitter's trace context rides along so the worker-side
         # span carries (trial, epoch, ...) without changing task args.
         blob = pickle.dumps(
-            (fn, args, kwargs, telemetry.outbound_context())
+            (fn, args, kwargs, _outbound_ctx())
         )
         with self._futures_lock:
             task_id = self._next_id

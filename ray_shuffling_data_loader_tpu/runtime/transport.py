@@ -28,7 +28,11 @@ import socket
 import struct
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
-from . import faults
+# Fault-injection plane (ISSUE 14 gate-integrity): lazy proxy — the
+# transport fault sites import the plane only when first exercised.
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
+
+faults = lazy_module("ray_shuffling_data_loader_tpu.runtime.faults")
 
 _LEN = struct.Struct("<Q")
 _AUTH_MAGIC = b"RSDLAUTH"

@@ -43,17 +43,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ray_shuffling_data_loader_tpu import runtime, telemetry
+from ray_shuffling_data_loader_tpu._lazy import lazy_module
 from ray_shuffling_data_loader_tpu.runtime import ColumnBatch, ObjectRef
-from ray_shuffling_data_loader_tpu.runtime import faults as _faults
 from ray_shuffling_data_loader_tpu.runtime.retry import stage_policy
 from ray_shuffling_data_loader_tpu.runtime.tasks import (
     TaskError,
     TaskFuture,
     wait,
 )
-from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
-from ray_shuffling_data_loader_tpu.telemetry import phases as _phases
+
+# Gated planes (ISSUE 14 gate-integrity): resolved on first attribute
+# access, never at import time — importing the shuffle engine must not
+# execute a telemetry-plane or fault-plane module body.
+_audit = lazy_module("ray_shuffling_data_loader_tpu.telemetry.audit")
+_phases = lazy_module("ray_shuffling_data_loader_tpu.telemetry.phases")
+_faults = lazy_module("ray_shuffling_data_loader_tpu.runtime.faults")
 from ray_shuffling_data_loader_tpu.utils import (
     arrow_decode_threads,
     decode_rowgroup_threads,

@@ -296,12 +296,12 @@ def reset(clear_spool: bool = False) -> None:
     global _published_pairs, _fold_cache
     with _lock:
         _records.clear()
+        _published_pairs = set()
+        _fold_cache = None
     with _touch_lock:
         _touch_last.clear()
     with _cache_lock:
         _read_cache.clear()
-    _published_pairs = set()
-    _fold_cache = None
     if clear_spool:
         directory = spool_dir()
         if directory and os.path.isdir(directory):
@@ -706,6 +706,9 @@ def publish_metrics(full: Optional[Dict[str, Any]] = None) -> None:
                 "capacity.oldest_age_seconds",
             ):
                 reg.gauge(name, epoch=epoch, tier=tier).set(0)
+        # rsdl-lint: disable=lock-discipline -- publish_metrics runs
+        # only on the sampler tick thread; _published_pairs is its
+        # private previous-tick snapshot
         _published_pairs = pairs
         for tier in TIERS:
             tot = full.get("totals", {}).get(tier) or {}

@@ -422,11 +422,16 @@ def publish_metrics(analysis: Optional[Dict[str, Any]] = None) -> None:
         # Prune tags whose task left the in-flight set: the same worker
         # wedging AGAIN later must log a fresh event (one event per
         # stall episode, not one per pid forever).
+        # rsdl-lint: disable=lock-discipline -- publish_metrics runs
+        # only on the sampler tick thread; _wedged_seen is its private
+        # episode-dedup state
         _wedged_seen.intersection_update(current)
         for task in wedged:
             tag = (task.get("pid"), task.get("stage"))
             if tag in _wedged_seen:
                 continue  # one event per stuck task, not one per tick
+            # rsdl-lint: disable=lock-discipline -- sampler-tick-thread
+            # only (same episode-dedup state as above)
             _wedged_seen.add(tag)
             from ray_shuffling_data_loader_tpu import telemetry as _t
 

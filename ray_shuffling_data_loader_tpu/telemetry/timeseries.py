@@ -134,6 +134,9 @@ def _build_sample(
             prev = _prev.get(key)
             if prev is not None and dt:
                 out["rate"] = max(0.0, _delta(value, prev["value"])) / dt
+            # rsdl-lint: disable=lock-discipline -- _build_sample runs
+            # only on the single rsdl-ts-sampler thread; _prev is its
+            # private tick-to-tick state
             _prev[key] = {"value": value}
             metrics_out[key] = out
         elif kind == "gauge":
@@ -155,6 +158,8 @@ def _build_sample(
                 out["rate"] = dcount / dt
                 if dcount > 0:
                     out["window_mean"] = max(0.0, dsum) / dcount
+            # rsdl-lint: disable=lock-discipline -- sampler-thread-only
+            # (same argument as the counter branch above)
             _prev[key] = {"value": count, "sum": total}
             metrics_out[key] = out
     return {"ts": now, "dt": dt, "metrics": metrics_out}
@@ -242,6 +247,9 @@ def _prom_name(base: str) -> str:
         cached = re.sub(r"[^a-zA-Z0-9_:]", "_", base)
         if not cached.startswith("rsdl_"):
             cached = "rsdl_" + cached
+        # rsdl-lint: disable=lock-discipline -- idempotent memo cache:
+        # racing writers store the identical sanitized string; worst
+        # case is one duplicate regex pass
         _PROM_CACHE[base] = cached
     return cached
 

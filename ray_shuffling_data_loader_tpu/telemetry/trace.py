@@ -74,6 +74,8 @@ _tls = threading.local()  # span depth only (flush heuristic)
 # dispatches interleaved on the same thread. Plain threads see their own
 # (initially empty) context, matching the old thread-local semantics.
 _ctx_stack_var: "contextvars.ContextVar[Tuple[Dict[str, Any], ...]]" = (
+    # rsdl-lint: disable=vocabulary-drift -- contextvar debug name,
+    # not a Prometheus alias; never appears on a scrape
     contextvars.ContextVar("rsdl_trace_ctx", default=())
 )
 
@@ -158,7 +160,7 @@ def reset_state() -> None:
         _threads_named.clear()
         _dropped = 0
         _process_meta_emitted = False
-    _base_ctx.clear()
+        _base_ctx.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -177,8 +179,12 @@ def current_context() -> Dict[str, Any]:
 
 
 def set_context(**kv: Any) -> None:
-    """Set process-wide base context (e.g. ``trial=0`` once per run)."""
-    _base_ctx.update(kv)
+    """Set process-wide base context (e.g. ``trial=0`` once per run).
+    Written under ``_lock`` (rare, boundary-time call); readers snapshot
+    without it — a torn read across two keys is harmless context, not
+    data."""
+    with _lock:
+        _base_ctx.update(kv)
 
 
 def outbound_context() -> Optional[Dict[str, Any]]:

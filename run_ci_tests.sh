@@ -11,6 +11,14 @@ cd "$(dirname "$0")"
 tier="${RSDL_CI_TIER:-all}"
 rc=0
 if [ "$tier" != "slow" ]; then
+  # Static-analysis lane (ISSUE 14), exit-code gated and FIRST: the
+  # invariant suite (gate-integrity lazy-import graph, knob registry vs
+  # TUNING.md, metric/event vocabulary vs observability.md, determinism
+  # hygiene, lock discipline, flush-before-done barriers) is pure AST —
+  # seconds, no runtime — so a structural violation fails the lane
+  # before any test minute is spent. docs/static-analysis.md has the
+  # checker catalog and the suppression policy.
+  python tools/rsdl_lint.py
   # Telemetry is env-gated and DEFAULT OFF: this pass asserts tier-1 is
   # clean with it disabled (the zero-overhead path).
   python -m pytest tests/ -m "not slow" -v --durations=10 -x
