@@ -188,6 +188,19 @@ KNOBS: Tuple[Knob, ...] = (
          "minimum spacing between eviction passes"),
     Knob("RSDL_EVICT_DROP_AGE_S", "float", "300", "public",
          "spill-tier drop age during a pressure pass"),
+    # -- multi-job service (ISSUE 15) ---------------------------------------
+    Knob("RSDL_SERVICE", "enum", "off", "public",
+         "multi-tenant shuffle-service plane gate (auto | off)"),
+    Knob("RSDL_JOB_NAME", "str", "job", "public",
+         "stable name for auto-registered service jobs"),
+    Knob("RSDL_JOB_ID", "str", "unset", "public",
+         "ambient job id for processes joining a job (trainer ranks)"),
+    Knob("RSDL_JOB_WEIGHT", "float", "1.0", "public",
+         "fair-share scheduling weight for this process's jobs"),
+    Knob("RSDL_SERVICE_ADMIT_FRAC", "float", "0.85", "public",
+         "shm-used fraction above which new epoch windows wait"),
+    Knob("RSDL_SERVICE_ADMIT_TIMEOUT_S", "float", "30", "public",
+         "bounded admission wait before a window proceeds anyway"),
     # -- suspend / resume ---------------------------------------------------
     Knob("RSDL_JOURNAL", "path", "off", "public",
          "driver write-ahead journal dir"),

@@ -48,7 +48,8 @@ GATED_PLANES = {
         "obs_server",
     )
 } | {
-    f"{PACKAGE}.runtime.{m}" for m in ("journal", "faults", "elastic")
+    f"{PACKAGE}.runtime.{m}"
+    for m in ("journal", "faults", "elastic", "service")
 }
 
 # Core data-path modules: the zero-overhead-off contract is theirs.

@@ -188,6 +188,26 @@ class ShufflingDataset:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
 
+        # Service plane (ISSUE 15): capture the caller's ambient job so
+        # the shuffle-driver THREAD below runs inside it (threadlocals
+        # do not cross threads) — the queue name created here and the
+        # driver's job-scoped resources must agree. NO auto-registration
+        # here: trainer ranks in other threads/processes could never
+        # learn an implicit job's id and would connect to an unscoped
+        # name the producer never spawned — job-scoped queues require
+        # the caller's job_context (or RSDL_JOB_ID), docs/service.md
+        # "Boundary". Env-guarded before the import: service off means
+        # no plane load, no behavior change.
+        service_job = None
+        if os.environ.get("RSDL_SERVICE"):
+            try:
+                from ray_shuffling_data_loader_tpu.runtime import service
+
+                if service.enabled():
+                    service_job = service.current_job()
+            except Exception:
+                service_job = None
+
         if rank == 0:
             # Master: create the queue, then kick off the shuffle driver.
             self._batch_queue = BatchQueue(
@@ -203,6 +223,12 @@ class ShufflingDataset:
 
             def _drive(result=self._shuffle_result):
                 try:
+                    if service_job is not None:
+                        from ray_shuffling_data_loader_tpu.runtime import (
+                            service,
+                        )
+
+                        service.set_current_job(service_job)
                     result.duration = shuffle(
                         filenames,
                         self._consumer,

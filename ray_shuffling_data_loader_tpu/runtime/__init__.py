@@ -88,10 +88,23 @@ class RuntimeContext:
     def scheduler(self):
         """Where tasks go: the cluster-wide round-robin scheduler when
         joined to a cluster, else the local worker pool (same ``submit``
-        surface)."""
-        if self.cluster is not None:
-            return self.cluster.scheduler()
-        return self.pool
+        surface). Under the multi-job service plane (``RSDL_SERVICE``,
+        ISSUE 15) the base scheduler is wrapped for fair-share
+        interleaving across jobs — env-guarded BEFORE the import, so a
+        service-off process never loads the plane."""
+        base = (
+            self.cluster.scheduler()
+            if self.cluster is not None
+            else self.pool
+        )
+        if os.environ.get("RSDL_SERVICE"):
+            try:
+                from .service import wrap_scheduler
+
+                return wrap_scheduler(base)
+            except Exception:
+                pass
+        return base
 
     def shutdown(self):
         if self.cluster is not None:
@@ -192,6 +205,7 @@ def _stop_obs_server() -> None:
         "ray_shuffling_data_loader_tpu.telemetry.obs_server",
         "ray_shuffling_data_loader_tpu.telemetry.timeseries",
         "ray_shuffling_data_loader_tpu.runtime.elastic",
+        "ray_shuffling_data_loader_tpu.runtime.service",
     ):
         mod = _sys.modules.get(name)
         if mod is not None:
@@ -463,6 +477,23 @@ def shutdown() -> None:
 # -- convenience wrappers bound to the current session ----------------------
 
 
+def _scoped_actor_name(name: Optional[str]) -> Optional[str]:
+    """Job-scope a named-actor name under the service plane
+    (ISSUE 15): two concurrent jobs spawning the same logical name
+    (batch queue, stats collector) get distinct actors instead of
+    racing on one registry record. Idempotent; identity without an
+    ambient job or with the plane off (env-guarded before the import —
+    the zero-overhead contract)."""
+    if name is None or not os.environ.get("RSDL_SERVICE"):
+        return name
+    try:
+        from .service import scoped_name
+
+        return scoped_name(name)
+    except Exception:
+        return name
+
+
 def submit(fn: Callable, *args, **kwargs) -> TaskFuture:
     """Submit a task to the current scheduler (cluster-wide round-robin when
     in a cluster, else the local pool)."""
@@ -488,6 +519,7 @@ def spawn_actor(
     ids; the actor is reaped with that host's agent (and terminated on
     this session's shutdown like any locally-owned actor)."""
     ctx = get_context()
+    name = _scoped_actor_name(name)
     if host_id is not None:
         if ctx.cluster is None:
             raise ValueError("host_id placement requires cluster mode")
@@ -573,6 +605,7 @@ def connect_actor(name: str, num_retries: int = 5) -> ActorHandle:
     reference's ``connect_queue_actor`` retry loop
     (``batch_queue.py:358-380``)."""
     ctx = get_context()
+    name = _scoped_actor_name(name)
     fallback = (
         ctx.cluster.lookup_named_actor if ctx.cluster is not None else None
     )
@@ -586,6 +619,7 @@ def connect_actor(name: str, num_retries: int = 5) -> ActorHandle:
 
 def resolve_actor(name: str) -> Optional[ActorHandle]:
     ctx = get_context()
+    name = _scoped_actor_name(name)
     handle = _resolve_actor(name, ctx.runtime_dir)
     if handle is None and ctx.cluster is not None:
         handle = ctx.cluster.lookup_named_actor(name)

@@ -618,6 +618,21 @@ class ElasticController:
         from ray_shuffling_data_loader_tpu.telemetry import capacity
 
         protected = self._protected_epochs()
+        claimed: set = set()
+        if tier == "cache" and os.environ.get("RSDL_SERVICE"):
+            # Service plane (ISSUE 15): shared decode-cache segments a
+            # LIVE job claims are in active cross-job use — dropping
+            # one would silently un-share a hot dataset mid-run. The
+            # claim set is refcounted per job and released at job end,
+            # so unclaimed segments stay ordinary candidates.
+            try:
+                from ray_shuffling_data_loader_tpu.runtime.service import (
+                    claimed_cache_ids,
+                )
+
+                claimed = claimed_cache_ids()
+            except Exception:
+                claimed = set()
         live = capacity.live_segments()
         # Epoch warmth across ALL tiers: a spill read keeps the epoch's
         # shm segments warm too — the epoch is demonstrably in use.
@@ -636,6 +651,11 @@ class ElasticController:
             except (TypeError, ValueError):
                 continue
             if epoch in protected:
+                continue
+            if claimed and (
+                seg["id"] in claimed
+                or any(i in claimed for i in (seg["ids"] or []))
+            ):
                 continue
             out.append(seg)
         out.sort(

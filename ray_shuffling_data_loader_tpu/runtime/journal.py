@@ -84,6 +84,12 @@ _FORMAT_V = 1
 _INFORMATIONAL = {
     "run_id", "ts", "session", "runtime_dir", "shm_dir",
     "faults", "faults_seed",
+    # Service-plane audit lineage (ISSUE 15): the per-registration job
+    # ids of every attempt in this run's resume chain. Recorded so a
+    # resumed attempt can fold the preempted attempts' job-stamped
+    # audit records (ids change across restarts; the stable job NAME
+    # above is what's validated).
+    "audit_jobs",
 }
 
 
@@ -167,6 +173,7 @@ def run_identity(
     plan: str,
     columns: Optional[List[str]],
     device_layout: Optional[dict],
+    job: Optional[str] = None,
 ) -> dict:
     """The run's stream identity — everything that determines the
     delivered batch stream (validated on resume; a mismatch REFUSES to
@@ -201,6 +208,12 @@ def run_identity(
         "faults": os.environ.get("RSDL_FAULTS") or None,
         "faults_seed": os.environ.get("RSDL_FAULTS_SEED") or None,
     }
+    if job is not None:
+        # Service plane (ISSUE 15): the job NAME (stable across
+        # restarts, unlike the per-registration id) joins the VALIDATED
+        # identity — two same-shaped concurrent jobs in one journal dir
+        # must each auto-discover their OWN run, never each other's.
+        identity["job"] = str(job)
     try:
         ctx = runtime.get_context()
         identity["session"] = ctx.session

@@ -1,0 +1,1063 @@
+"""Disaggregated shuffle service: one shuffle plane, many jobs (ISSUE 15).
+
+Today one driver owns one shuffle for one trainer group. This module
+turns the shuffle/store/queue plane into a long-lived multi-tenant
+*service*: concurrent :func:`~.shuffle.shuffle` calls — distinct
+datasets, seeds, epoch windows, possibly distinct processes joined to
+one runtime session — register **jobs** against the shared worker pool
+and get
+
+* **job-scoped namespaces** — named actors (batch queue, stats
+  collectors), the live trial status, audit digest records, journal run
+  identities, and the capacity ledger all carry the job id, so two
+  same-shaped jobs can never clobber each other's resources or fold
+  into each other's verdicts;
+* **fair-share scheduling** — :class:`FairShareScheduler` interleaves
+  stage tasks across jobs by weighted share (release the next task from
+  the job with the smallest in-flight/weight ratio), so one straggling
+  or flooding job cannot starve another out of the pool;
+* **per-job epoch-window admission** — :func:`admit_epoch` holds a new
+  epoch window back while the capacity ledger reports the shm budget
+  over the admission watermark and other jobs are in flight, so
+  concurrent windows never thrash the evictor;
+* **cross-job hot-dataset sharing** — the shared decode-cache registry
+  is re-keyed from session identity to *content identity*
+  (:func:`cache_key`: file fingerprint + projection + narrowing) with
+  refcounted per-job claims, so a second job over the same Parquet set
+  rides the first job's decoded segments from its first epoch and the
+  evictor never drops a segment a live job claims.
+
+Env-gated ``RSDL_SERVICE=auto|off`` with the repo's zero-overhead-off
+contract: unset means this module is never imported, no thread starts,
+and the single-job code path is byte-for-byte unchanged (enforced by
+the gate-integrity lint plane — every core-module import of this plane
+is function-level behind an env check).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_shuffling_data_loader_tpu import telemetry
+from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
+
+_ENV_MODE = "RSDL_SERVICE"
+_ENV_JOB_ID = "RSDL_JOB_ID"
+_ENV_JOB_NAME = "RSDL_JOB_NAME"
+_ENV_JOB_WEIGHT = "RSDL_JOB_WEIGHT"
+_ENV_ADMIT_FRAC = "RSDL_SERVICE_ADMIT_FRAC"
+_ENV_ADMIT_TIMEOUT = "RSDL_SERVICE_ADMIT_TIMEOUT_S"
+
+_OFF_VALUES = ("", "off", "0", "false", "no")
+
+
+def mode() -> str:
+    """The parsed ``RSDL_SERVICE`` value (``off`` when unset/disabled).
+    Read per call — the plane is only ever consulted from call sites
+    that already saw the env var set, so this is never on a hot path."""
+    raw = os.environ.get(_ENV_MODE, "").strip().lower()
+    if raw in _OFF_VALUES:
+        return "off"
+    return raw if raw else "off"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+
+
+class Job:
+    """One tenant of the shuffle service: identity + scheduling weight.
+
+    ``job_id`` is globally unique (name-pid-counter) and suffixes every
+    job-scoped resource name; ``name`` is the stable human identity
+    (journal run identity, default metrics label)."""
+
+    __slots__ = (
+        "job_id", "name", "weight", "pid", "created_ts", "ended_ts",
+    )
+
+    def __init__(self, job_id: str, name: str, weight: float):
+        self.job_id = job_id
+        self.name = name
+        self.weight = float(weight)
+        self.pid = os.getpid()
+        self.created_ts = time.time()
+        self.ended_ts: Optional[float] = None
+
+    @property
+    def running(self) -> bool:
+        return self.ended_ts is None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "name": self.name,
+            "weight": self.weight,
+            "pid": self.pid,
+            "created_ts": self.created_ts,
+            "ended_ts": self.ended_ts,
+            "running": self.running,
+        }
+
+
+_jobs_lock = threading.Lock()
+_jobs: Dict[str, Job] = {}
+_job_counter = itertools.count()
+_tls = threading.local()
+
+
+def _default_weight() -> float:
+    try:
+        w = float(os.environ.get(_ENV_JOB_WEIGHT, "1.0"))
+    except ValueError:
+        w = 1.0
+    return max(w, 0.001)  # zero/negative would starve the job forever
+
+
+def _service_dir() -> Optional[str]:
+    """``<runtime_dir>/service`` when a session is live, else None.
+    Job records and the cache registry live here so every process
+    joined to the session (distinct drivers, the obs endpoint owner)
+    sees one consistent view."""
+    from ray_shuffling_data_loader_tpu import runtime
+
+    if not runtime.is_initialized():
+        return None
+    try:
+        return os.path.join(runtime.get_context().runtime_dir, "service")
+    except Exception:
+        return None
+
+
+def _write_job_record(job: Job) -> None:
+    base = _service_dir()
+    if base is None:
+        return
+    try:
+        jobs_dir = os.path.join(base, "jobs")
+        os.makedirs(jobs_dir, exist_ok=True)
+        path = os.path.join(jobs_dir, f"{job.job_id}.json")
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(job.to_dict(), f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def register_job(
+    name: Optional[str] = None, weight: Optional[float] = None
+) -> Job:
+    """Register one tenant. ``name`` defaults to ``RSDL_JOB_NAME`` (or
+    ``"job"``); ``weight`` to ``RSDL_JOB_WEIGHT`` (1.0). Also registers
+    the service's ``/status`` section provider on first use when the
+    obs endpoint is configured."""
+    name = (name or os.environ.get(_ENV_JOB_NAME) or "job").strip()
+    weight = _default_weight() if weight is None else max(float(weight), 0.001)
+    with _jobs_lock:
+        job_id = f"{name}-{os.getpid()}-{next(_job_counter)}"
+        job = Job(job_id, name, weight)
+        _jobs[job_id] = job
+    _write_job_record(job)
+    _maybe_register_status_provider()
+    _metrics.safe_inc("service.jobs_registered")
+    telemetry.emit_event(
+        "job.registered", job=job_id, name=name, weight=weight
+    )
+    _set_active_gauge()
+    return job
+
+
+def end_job(job: Job) -> None:
+    """Mark a job ended: release its decode-cache claims and drop its
+    pending fair-share queue (in-flight tasks complete normally)."""
+    if job is None or job.ended_ts is not None:
+        return
+    job.ended_ts = time.time()
+    _write_job_record(job)
+    try:
+        release_claims(job.job_id)
+    except Exception:
+        pass
+    sched = _scheduler_singleton()
+    if sched is not None:
+        sched.forget_job(job.job_id)
+    telemetry.emit_event("job.ended", job=job.job_id, name=job.name)
+    _set_active_gauge()
+
+
+def _set_active_gauge() -> None:
+    try:
+        if _metrics.enabled():
+            _metrics.registry.gauge("service.jobs_active").set(
+                float(len(active_jobs()))
+            )
+    except Exception:
+        pass
+
+
+def active_jobs() -> List[Job]:
+    with _jobs_lock:
+        return [j for j in _jobs.values() if j.running]
+
+
+def _record_live(rec: Dict[str, Any]) -> bool:
+    """Is an on-disk job record genuinely live? ``running`` alone is
+    not enough: a SIGKILLed driver never ran ``end_job``, and treating
+    its record as live forever would pin its cache claims against the
+    evictor and keep admission in multi-tenant mode. The pid-liveness
+    probe is sound here — job records live in the session's runtime
+    dir, and every process that can write one is on this host."""
+    if not rec.get("running"):
+        return False
+    pid = rec.get("pid")
+    if not pid:
+        return False
+    if int(pid) == os.getpid():
+        return True
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # exists but not ours (EPERM)
+
+
+def live_jobs_count() -> int:
+    """Running jobs across EVERY process of the session (in-process
+    registry + liveness-checked on-disk records) — the multi-tenancy
+    signal admission keys on; the in-process count alone would leave
+    cross-process tenants without admission control."""
+    seen = {j.job_id for j in active_jobs()}
+    for rec in jobs_snapshot():
+        jid = rec.get("job_id")
+        if jid in seen:
+            continue
+        if _record_live(rec):
+            seen.add(jid)
+    return len(seen)
+
+
+def jobs_snapshot() -> List[Dict[str, Any]]:
+    """Every job this session knows about: this process's registry
+    merged with the on-disk records other drivers wrote (theirs win
+    nothing — same job ids never collide across processes)."""
+    with _jobs_lock:
+        out = {j.job_id: j.to_dict() for j in _jobs.values()}
+    base = _service_dir()
+    if base is not None:
+        jobs_dir = os.path.join(base, "jobs")
+        try:
+            names = os.listdir(jobs_dir)
+        except OSError:
+            names = []
+        for fname in names:
+            if not fname.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(jobs_dir, fname)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            out.setdefault(str(rec.get("job_id")), rec)
+    return sorted(out.values(), key=lambda r: r.get("created_ts") or 0.0)
+
+
+def current_job() -> Optional[Job]:
+    """The ambient job: the :func:`job_context` threadlocal, else a
+    process-wide job derived from ``RSDL_JOB_ID`` (spawned trainer
+    ranks of a job-scoped driver inherit the id via env)."""
+    job = getattr(_tls, "job", None)
+    if job is not None:
+        return job
+    env_id = os.environ.get(_ENV_JOB_ID)
+    if env_id:
+        with _jobs_lock:
+            job = _jobs.get(env_id)
+            if job is None:
+                job = Job(
+                    env_id,
+                    os.environ.get(_ENV_JOB_NAME) or env_id,
+                    _default_weight(),
+                )
+                _jobs[env_id] = job
+        return job
+    return None
+
+
+def set_current_job(job: Optional[Job]) -> None:
+    _tls.job = job
+
+
+@contextlib.contextmanager
+def job_context(job: Optional[Job]):
+    """Make ``job`` ambient for the block: resource names created
+    inside are job-scoped and the telemetry context carries
+    ``job=<id>`` (so spans, events, audit digests, and ledger ops —
+    local and propagated to workers — attribute to the job)."""
+    if job is None:
+        yield
+        return
+    prev = getattr(_tls, "job", None)
+    _tls.job = job
+    try:
+        with telemetry.context(job=job.job_id):
+            yield
+    finally:
+        _tls.job = prev
+
+
+def scoped_name(base: str, job: Optional[Job] = None) -> str:
+    """Job-scope a session-wide resource name (named actors): two
+    concurrent jobs using the same logical name get distinct resources
+    instead of racing on one (the ISSUE 15 latent-collision fix)."""
+    job = job if job is not None else current_job()
+    if not enabled() or job is None or not base:
+        return base
+    suffix = f"--{job.job_id}"
+    return base if base.endswith(suffix) else f"{base}{suffix}"
+
+
+# ---------------------------------------------------------------------------
+# Fair-share scheduling
+# ---------------------------------------------------------------------------
+
+
+class _ProxyFuture:
+    """Task-future stand-in handed out while the fair-share dispatcher
+    holds the task back. Duck-types :class:`~.tasks.TaskFuture` (done /
+    result / waiter hooks) so ``runtime.wait`` and the shuffle driver's
+    retry loops work unchanged; once dispatched it delegates to the
+    real future."""
+
+    __slots__ = ("_event", "_inner", "_waiters", "_lock")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._inner = None
+        self._waiters: List[threading.Event] = []
+        self._lock = threading.Lock()
+
+    def _resolve(self, inner) -> None:
+        """Called by the dispatcher once the INNER future completed."""
+        with self._lock:
+            self._inner = inner
+            self._event.set()
+            waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            w.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"fair-share task not done after {timeout}s"
+            )
+        return self._inner.result(0)
+
+    def _add_waiter(self, event: threading.Event) -> None:
+        with self._lock:
+            if self._event.is_set():
+                event.set()
+            else:
+                self._waiters.append(event)
+
+    def _remove_waiter(self, event: threading.Event) -> None:
+        with self._lock:
+            try:
+                self._waiters.remove(event)
+            except ValueError:
+                pass
+
+
+class FairShareScheduler:
+    """Weighted max-min interleaving of stage tasks across jobs.
+
+    Wraps the session scheduler (local :class:`~.tasks.WorkerPool` or
+    the cluster scheduler — both expose ``submit``/``submit_local_to``
+    and a ``width``). Tasks submitted with NO ambient job pass straight
+    through; job tasks queue per job and a dispatcher releases the next
+    task from the backlogged job with the smallest *virtual time*
+    (start-time fair queuing: each release advances the job's clock by
+    ``1/weight``, and a newly backlogged job starts at the active
+    minimum rather than replaying history) whenever the
+    released-but-unfinished count is under the pool width. Weighted
+    max-min by construction: under contention a ``weight=2`` job is
+    released twice per a ``weight=1`` job's once, and a flooding job
+    cannot starve a neighbor — the neighbor's clock is behind, so it
+    wins the next free slot. With a single active job the release cap
+    is waived — the sole tenant floods the pool exactly like the
+    service-off path.
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._lock = threading.Lock()
+        self._pending: Dict[str, deque] = {}
+        self._weights: Dict[str, float] = {}
+        self._inflight: Dict[str, int] = {}
+        self._vtime: Dict[str, float] = {}
+        self._released: List[tuple] = []  # (inner_fut, job_id, proxy)
+        self._notify = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        self._closed = False
+
+    # The scheduler duck-type surface shuffle_epoch sees.
+    @property
+    def width(self) -> int:
+        return max(1, int(getattr(self.inner, "width", 1)))
+
+    def submit(self, fn: Callable, *args, **kwargs):
+        return self._enqueue(
+            lambda: self.inner.submit(fn, *args, **kwargs)
+        )
+
+    def submit_local_to(self, refs, fn: Callable, *args, **kwargs):
+        return self._enqueue(
+            lambda: self.inner.submit_local_to(refs, fn, *args, **kwargs)
+        )
+
+    def _enqueue(self, thunk: Callable[[], Any]):
+        job = current_job()
+        if job is None or not job.running:
+            return thunk()
+        proxy = _ProxyFuture()
+        # Snapshot the SUBMITTER'S telemetry context now: a deferred
+        # task may be released later from the watcher thread, and the
+        # inner submit captures its outbound (job/epoch) context at
+        # release time — without the snapshot, every throttled task
+        # would lose its attribution (worker-side audit digests would
+        # fold jobless and fail a correct multi-job reconcile).
+        try:
+            ctx = telemetry.outbound_context() or {}
+        except Exception:
+            ctx = {}
+        inner_thunk = thunk
+        if ctx:
+            def thunk(_run=inner_thunk, _ctx=ctx):
+                with telemetry.context(**_ctx):
+                    return _run()
+        with self._lock:
+            self._weights[job.job_id] = job.weight
+            queue = self._pending.setdefault(job.job_id, deque())
+            if not queue and not self._inflight.get(job.job_id):
+                # Newly backlogged: start at the active minimum so an
+                # idle spell never becomes banked credit (and a
+                # latecomer never replays the incumbents' history).
+                others = [
+                    self._vtime.get(j, 0.0)
+                    for j in (
+                        set(self._inflight)
+                        | {
+                            k
+                            for k, q in self._pending.items()
+                            if q and k != job.job_id
+                        }
+                    )
+                ]
+                self._vtime[job.job_id] = max(
+                    self._vtime.get(job.job_id, 0.0),
+                    min(others) if others else 0.0,
+                )
+            queue.append((thunk, proxy))
+            self._ensure_watcher_locked()
+        self._pump()
+        return proxy
+
+    def forget_job(self, job_id: str) -> None:
+        """Drop a finished job's pending queue and clock (its in-flight
+        tasks complete and decrement normally)."""
+        with self._lock:
+            dropped = self._pending.pop(job_id, None)
+            self._vtime.pop(job_id, None)
+        if dropped:
+            # An ended job should have drained its own queue; anything
+            # left would hang its proxy waiters forever, so fail them.
+            for _thunk, proxy in dropped:
+                try:
+                    proxy._resolve(_FailedInner("job ended"))
+                except Exception:
+                    pass
+
+    def _multi_tenant_locked(self) -> bool:
+        """More than one tenant is in play — by scheduler state (tasks
+        pending or in flight from two jobs) or by registration (two
+        running jobs exist, so the very first submissions must already
+        shape to the share instead of flooding). Deliberately
+        process-LOCAL (unlike admission's session-wide count): a job in
+        another driver process submits to ITS OWN worker pool, never to
+        this scheduler, so counting it here would throttle a sole
+        tenant for a neighbor that cannot contend for these slots."""
+        jobs = set(self._inflight) | {
+            j for j, q in self._pending.items() if q
+        }
+        if len(jobs) > 1:
+            return True
+        return len(active_jobs()) > 1
+
+    def _pump(self) -> None:
+        """Release queued tasks while capacity allows, picking the
+        backlogged job with the smallest virtual time (ties: fewest
+        in-flight, then id). Runs the thunks OUTSIDE the lock — a
+        submit can block on the mp queue."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                queues = {
+                    j: q for j, q in self._pending.items() if q
+                }
+                if not queues:
+                    return
+                total = sum(self._inflight.values())
+                if self._multi_tenant_locked() and total >= self.width:
+                    _metrics.safe_inc("service.tasks_throttled")
+                    return
+                job_id = min(
+                    queues,
+                    key=lambda j: (
+                        self._vtime.get(j, 0.0),
+                        self._inflight.get(j, 0),
+                        j,
+                    ),
+                )
+                self._vtime[job_id] = self._vtime.get(
+                    job_id, 0.0
+                ) + 1.0 / self._weights.get(job_id, 1.0)
+                thunk, proxy = queues[job_id].popleft()
+                self._inflight[job_id] = self._inflight.get(job_id, 0) + 1
+            try:
+                inner_fut = thunk()
+            except BaseException as exc:
+                with self._lock:
+                    self._dec_inflight_locked(job_id)
+                # The proxy was already handed to the submitter: fail
+                # it loudly — left unresolved, a deliver thread blocked
+                # in proxy.result() (no timeout) would hang forever.
+                try:
+                    proxy._resolve(
+                        _FailedInner(
+                            f"submit failed: "
+                            f"{type(exc).__name__}: {exc}"[:200]
+                        )
+                    )
+                except Exception:
+                    pass
+                raise
+            add = getattr(inner_fut, "_add_waiter", None)
+            if add is not None:
+                add(self._notify)
+            with self._lock:
+                self._released.append((inner_fut, job_id, proxy))
+            if inner_fut.done():
+                self._notify.set()
+
+    def _dec_inflight_locked(self, job_id: str) -> None:
+        n = self._inflight.get(job_id, 0) - 1
+        if n <= 0:
+            self._inflight.pop(job_id, None)
+        else:
+            self._inflight[job_id] = n
+
+    def _ensure_watcher_locked(self) -> None:
+        if self._watcher is None or not self._watcher.is_alive():
+            self._watcher = threading.Thread(
+                target=self._watch, name="rsdl-fair-share", daemon=True
+            )
+            self._watcher.start()
+
+    def _watch(self) -> None:
+        while not self._closed:
+            self._notify.wait(timeout=0.5)
+            self._notify.clear()
+            finished: List[tuple] = []
+            with self._lock:
+                still: List[tuple] = []
+                for entry in self._released:
+                    if entry[0].done():
+                        finished.append(entry)
+                        self._dec_inflight_locked(entry[1])
+                    else:
+                        still.append(entry)
+                self._released = still
+                idle = (
+                    not self._released
+                    and not any(q for q in self._pending.values())
+                )
+            for inner_fut, _job_id, proxy in finished:
+                rm = getattr(inner_fut, "_remove_waiter", None)
+                if rm is not None:
+                    rm(self._notify)
+                proxy._resolve(inner_fut)
+            if finished:
+                # A raising submit (pool shutting down, dead cluster
+                # host) must not kill the dispatcher thread: its proxy
+                # was failed in _pump, but OTHER jobs' queued tasks
+                # still need this loop alive.
+                try:
+                    self._pump()
+                except Exception:
+                    pass
+            if idle:
+                # Park cheaply between bursts; a new enqueue restarts
+                # the loop via _notify after _pump releases.
+                self._notify.wait(timeout=5.0)
+
+    def stop(self) -> None:
+        self._closed = True
+        self._notify.set()
+
+    def queue_depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                j: len(q) for j, q in self._pending.items() if q
+            }
+
+    def inflight(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._inflight)
+
+
+class _FailedInner:
+    """Inner-future stand-in whose result always raises (an ended
+    job's still-queued tasks must fail loudly, not hang)."""
+
+    def __init__(self, why: str):
+        self._why = why
+
+    def done(self) -> bool:
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        raise RuntimeError(f"fair-share task dropped: {self._why}")
+
+
+_sched_lock = threading.Lock()
+_schedulers: Dict[int, FairShareScheduler] = {}
+
+
+def wrap_scheduler(inner):
+    """The session scheduler wrapped for fair share (cached per inner
+    scheduler object; returns ``inner`` unchanged when the plane is
+    off)."""
+    if not enabled():
+        return inner
+    if isinstance(inner, FairShareScheduler):
+        return inner
+    with _sched_lock:
+        sched = _schedulers.get(id(inner))
+        if sched is None or sched.inner is not inner:
+            sched = FairShareScheduler(inner)
+            _schedulers[id(inner)] = sched
+        return sched
+
+
+def _scheduler_singleton() -> Optional[FairShareScheduler]:
+    with _sched_lock:
+        for sched in _schedulers.values():
+            return sched
+    return None
+
+
+def stop() -> None:
+    """Session teardown: stop dispatcher threads and forget state
+    (called by ``runtime.shutdown`` via the loaded-modules sweep)."""
+    with _sched_lock:
+        scheds = list(_schedulers.values())
+        _schedulers.clear()
+    for sched in scheds:
+        try:
+            sched.stop()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Per-job epoch-window admission
+# ---------------------------------------------------------------------------
+
+
+def _admit_frac() -> float:
+    try:
+        return float(os.environ.get(_ENV_ADMIT_FRAC, "0.85"))
+    except ValueError:
+        return 0.85
+
+
+def _admit_timeout_s() -> float:
+    try:
+        return float(os.environ.get(_ENV_ADMIT_TIMEOUT, "30"))
+    except ValueError:
+        return 30.0
+
+
+def admit_epoch(job: Job, epoch: int, in_flight: int) -> float:
+    """Hold a new epoch window back while the shm budget is over the
+    admission watermark AND other jobs are active. Returns the seconds
+    waited. Progress is guaranteed by construction: a job with no
+    window in flight is always admitted (its oldest window is what
+    frees memory), the sole tenant is always admitted, and the wait is
+    bounded by ``RSDL_SERVICE_ADMIT_TIMEOUT_S`` — admission shapes
+    concurrency, it never deadlocks it. Multi-tenancy is judged across
+    every process of the session (on-disk job records, pid-alive) —
+    the shm budget is shared session-wide, so a tenant in another
+    driver process must count."""
+    if job is None or in_flight <= 0 or live_jobs_count() <= 1:
+        return 0.0
+    if not _metrics.enabled():
+        return 0.0  # no ledger -> no headroom signal to key on
+    from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+    watermark = _admit_frac()
+    deadline = time.monotonic() + _admit_timeout_s()
+    t0 = time.monotonic()
+    waited_event = False
+    while True:
+        try:
+            frac = capacity.view().get("shm_used_frac")
+        except Exception:
+            frac = None
+        if frac is None or float(frac) < watermark:
+            break
+        if time.monotonic() >= deadline:
+            _metrics.safe_inc(
+                "service.admission_timeouts", job=job.job_id
+            )
+            break
+        if not waited_event:
+            waited_event = True
+            telemetry.emit_event(
+                "service.admission_wait", job=job.job_id, epoch=epoch,
+                shm_used_frac=float(frac),
+            )
+        time.sleep(0.2)
+    waited = time.monotonic() - t0
+    if waited > 0.05:
+        try:
+            if _metrics.enabled():
+                _metrics.registry.counter(
+                    "service.admission_wait_seconds", job=job.job_id
+                ).inc(waited)
+        except Exception:
+            pass
+    return waited
+
+
+# ---------------------------------------------------------------------------
+# Cross-job hot-dataset sharing (content-identity decode-cache registry)
+# ---------------------------------------------------------------------------
+
+
+def cache_key(
+    filename: str,
+    columns: Optional[Sequence[str]],
+    narrow: bool,
+) -> str:
+    """Content identity of one file's decoded columns: the file
+    fingerprint (path + size + mtime — a rewritten file can never
+    serve a stale cache), the projection, and the narrowing flag.
+    Unlike the PR 11 session key, two JOBS with the same content
+    identity share one segment."""
+    path = filename if "://" in filename else os.path.abspath(filename)
+    try:
+        st = os.stat(path)
+        fp = f"{st.st_size}:{st.st_mtime_ns}"
+    except OSError:
+        fp = "?"
+    proj = "*" if columns is None else ",".join(str(c) for c in columns)
+    return f"{path}|{fp}|{proj}|{int(bool(narrow))}"
+
+
+_cache_lock = threading.Lock()
+_cache_mem: Dict[str, Dict[str, Any]] = {}  # in-process fast path
+
+
+def _registry_paths() -> Optional[tuple]:
+    base = _service_dir()
+    if base is None:
+        return None
+    return (
+        os.path.join(base, "cache-registry.json"),
+        os.path.join(base, "cache-registry.lock"),
+    )
+
+
+@contextlib.contextmanager
+def _registry_locked():
+    """The cross-process registry dict under an flock'd lockfile;
+    mutations inside the block are persisted on exit. Yields None when
+    no session is live (in-process registry only)."""
+    paths = _registry_paths()
+    if paths is None:
+        yield None
+        return
+    reg_path, lock_path = paths
+    os.makedirs(os.path.dirname(reg_path), exist_ok=True)
+    import fcntl
+
+    with open(lock_path, "a+") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            try:
+                with open(reg_path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = {}
+            yield data
+            tmp = f"{reg_path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, reg_path)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+def _ref_to_dict(ref) -> Dict[str, Any]:
+    return {
+        "id": ref.object_id,
+        "nbytes": int(ref.nbytes),
+        "session": ref.session,
+        "owner": list(ref.owner) if ref.owner is not None else None,
+        "rows": (
+            [int(ref.rows[0]), int(ref.rows[1])]
+            if ref.rows is not None
+            else None
+        ),
+    }
+
+
+def _ref_from_dict(d: Dict[str, Any]):
+    from ray_shuffling_data_loader_tpu.runtime.store import ObjectRef
+
+    return ObjectRef(
+        object_id=str(d["id"]),
+        nbytes=int(d.get("nbytes", 0)),
+        session=str(d.get("session", "")),
+        owner=tuple(d["owner"]) if d.get("owner") else None,
+        rows=tuple(d["rows"]) if d.get("rows") else None,
+    )
+
+
+def cache_publish(key: str, ref, job: Optional[Job] = None) -> None:
+    """Publish one decoded-file segment under its content key, claimed
+    by the publishing job. Never raises into the data path."""
+    job = job if job is not None else current_job()
+    try:
+        entry = _ref_to_dict(ref)
+        entry["claims"] = {job.job_id: time.time()} if job else {}
+        with _cache_lock:
+            _cache_mem[key] = entry
+        with _registry_locked() as data:
+            if data is not None:
+                cur = data.get(key)
+                if cur is not None and cur.get("id") != entry["id"]:
+                    # Keep the incumbent (first publisher wins) but
+                    # carry our claim onto it so it stays fenced.
+                    if job is not None:
+                        cur.setdefault("claims", {})[
+                            job.job_id
+                        ] = time.time()
+                    with _cache_lock:
+                        _cache_mem[key] = dict(cur)
+                else:
+                    prev_claims = (cur or {}).get("claims") or {}
+                    entry["claims"] = {**prev_claims, **entry["claims"]}
+                    data[key] = entry
+    except Exception:
+        pass
+
+
+def cache_lookup(key: str, job: Optional[Job] = None):
+    """A still-live shared segment for ``key`` (session-validated and
+    ``store.exists``-checked), with a claim added for ``job`` — or
+    None, dropping any stale entry so the caller re-decodes."""
+    from ray_shuffling_data_loader_tpu import runtime
+
+    job = job if job is not None else current_job()
+    with _cache_lock:
+        entry = _cache_mem.get(key)
+    if entry is None:
+        try:
+            with _registry_locked() as data:
+                entry = dict(data[key]) if data and key in data else None
+        except Exception:
+            entry = None
+        if entry is not None:
+            with _cache_lock:
+                _cache_mem[key] = entry
+    if entry is None:
+        return None
+    try:
+        ctx = runtime.get_context()
+        ref = _ref_from_dict(entry)
+        if ref.session == ctx.store.session and ctx.store.exists(ref):
+            if job is not None:
+                claim_cache(key, job)
+            _metrics.safe_inc(
+                "service.cache_hits",
+                job=job.job_id if job else "none",
+            )
+            return ref
+    except Exception:
+        pass
+    _drop_cache_entry(key)
+    return None
+
+
+def claim_cache(key: str, job: Job) -> None:
+    try:
+        with _cache_lock:
+            entry = _cache_mem.get(key)
+            if entry is not None:
+                claims = entry.setdefault("claims", {})
+                if job.job_id in claims:
+                    # Already claimed: claims never age out while the
+                    # job is pid-live, so skip the flock'd full-file
+                    # registry rewrite — a hot per-epoch lookup loop
+                    # must cost one write per (job, key), not one per
+                    # hit.
+                    return
+                claims[job.job_id] = time.time()
+        with _registry_locked() as data:
+            if data is not None and key in data:
+                data[key].setdefault("claims", {})[
+                    job.job_id
+                ] = time.time()
+    except Exception:
+        pass
+
+
+def release_claims(job_id: str) -> None:
+    """Release every cache claim ``job_id`` holds (job end): unclaimed
+    segments become ordinary evictor candidates again."""
+    try:
+        with _cache_lock:
+            for entry in _cache_mem.values():
+                (entry.get("claims") or {}).pop(job_id, None)
+        with _registry_locked() as data:
+            if data is not None:
+                for entry in data.values():
+                    (entry.get("claims") or {}).pop(job_id, None)
+    except Exception:
+        pass
+
+
+def claimed_cache_ids() -> set:
+    """Object ids of shared-cache segments a LIVE job still claims —
+    the evictor's do-not-drop set (:mod:`.elastic`). Liveness is
+    record-``running`` AND pid-alive: a SIGKILLed driver's claims must
+    not fence segments forever (its record stays ``running`` — only
+    the liveness probe can retire it)."""
+    live = {
+        rec.get("job_id")
+        for rec in jobs_snapshot()
+        if _record_live(rec)
+    }
+    out = set()
+    try:
+        with _registry_locked() as data:
+            entries = list((data or {}).values())
+    except Exception:
+        entries = []
+    with _cache_lock:
+        entries += list(_cache_mem.values())
+    for entry in entries:
+        claims = entry.get("claims") or {}
+        if any(j in live for j in claims):
+            oid = entry.get("id")
+            if oid:
+                out.add(str(oid))
+    return out
+
+
+def _drop_cache_entry(key: str) -> None:
+    try:
+        with _cache_lock:
+            _cache_mem.pop(key, None)
+        with _registry_locked() as data:
+            if data is not None:
+                data.pop(key, None)
+    except Exception:
+        pass
+
+
+def cache_registry_clear() -> None:
+    """Drop every registry entry (tests / operators). Segments are not
+    freed — the session cleanup / evictor own their lifetime."""
+    with _cache_lock:
+        _cache_mem.clear()
+    try:
+        with _registry_locked() as data:
+            if data is not None:
+                data.clear()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+
+
+_provider_registered = False
+
+
+def _maybe_register_status_provider() -> None:
+    global _provider_registered
+    if _provider_registered or not os.environ.get("RSDL_OBS_PORT"):
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import obs_server
+
+        obs_server.register_status_provider("service", status_section)
+        _provider_registered = True
+    except Exception:
+        pass
+
+
+def status_section() -> Dict[str, Any]:
+    """The ``service`` section of ``/status``: registered jobs, the
+    fair-share queues, and the shared-cache registry size."""
+    sched = _scheduler_singleton()
+    try:
+        with _registry_locked() as data:
+            cache_entries = len(data or {})
+    except Exception:
+        cache_entries = len(_cache_mem)
+    return {
+        "mode": mode(),
+        "jobs": jobs_snapshot(),
+        "fair_share": {
+            "queued": sched.queue_depths() if sched else {},
+            "in_flight": sched.inflight() if sched else {},
+        },
+        "cache_entries": cache_entries,
+    }
+
+
+def reset_state() -> None:
+    """Tests only: forget jobs, schedulers, and the in-process cache
+    view (the on-disk registry belongs to the session)."""
+    stop()
+    with _jobs_lock:
+        _jobs.clear()
+    with _cache_lock:
+        _cache_mem.clear()
+    _tls.job = None
+    global _provider_registered
+    _provider_registered = False

@@ -172,9 +172,10 @@ def _record_task_done(fn, duration_s: float, trace_ctx) -> None:
     try:
         from ray_shuffling_data_loader_tpu.telemetry import stragglers
 
-        epoch = (trace_ctx or {}).get("epoch")
+        ctx = trace_ctx or {}
         stragglers.record_task(
-            getattr(fn, "__name__", "task"), duration_s, epoch=epoch
+            getattr(fn, "__name__", "task"), duration_s,
+            epoch=ctx.get("epoch"), job=ctx.get("job"),
         )
     except Exception:
         pass
@@ -185,8 +186,12 @@ def _outbound_ctx():
     None with no facade touch when nothing can have produced one —
     context lives in telemetry.trace (never imported ⇒ empty) and the
     metrics half ships identity through the same path only when
-    enabled. Mirrors runtime/actor.py's _trace_ctx (ISSUE 14: the
-    disabled submit path stays import-free)."""
+    enabled. The service plane's job identity (ISSUE 15) also rides
+    this context, but a job can only be ambient after the shuffle
+    driver entered telemetry.context — which loads the trace module —
+    so the sys.modules check below already covers it. Mirrors
+    runtime/actor.py's _trace_ctx (ISSUE 14: the disabled submit path
+    stays import-free)."""
     import sys as _sys
 
     if (

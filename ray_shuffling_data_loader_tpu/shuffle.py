@@ -101,35 +101,73 @@ def _count_recovery(name: str, **labels) -> None:
 # ---------------------------------------------------------------------------
 # Live trial status (the obs plane's shuffle provider)
 # ---------------------------------------------------------------------------
-# A driver-side view of the running trial — which epochs are in flight,
-# what schedule each runs, how far delivery has progressed — published to
-# telemetry.obs_server's /status endpoint. The tracker itself is a plain
-# dict under a lock, updated a handful of times per epoch (admission,
-# schedule pick, one increment per delivered reducer, completion): noise
-# next to the per-reducer RPC + store traffic, so it stays on
-# unconditionally; the obs_server registration (the only part with an
-# import cost) happens only when RSDL_OBS_PORT is set.
+# A driver-side view of the running trial(s) — which epochs are in
+# flight, what schedule each runs, how far delivery has progressed —
+# published to telemetry.obs_server's /status endpoint. The tracker is
+# keyed per service-plane job (ISSUE 15): concurrent ``shuffle()``
+# calls each own an entry instead of clobbering one global dict (the
+# latent multi-job collision), and the eviction fence unions every
+# running job's window. Single-job runs use one "_default" entry and
+# see the exact historical shape. Updates are a handful per epoch
+# (admission, schedule pick, one increment per delivered reducer,
+# completion): noise next to the per-reducer RPC + store traffic, so
+# the tracker stays on unconditionally; the obs_server registration
+# (the only part with an import cost) happens only when RSDL_OBS_PORT
+# is set.
 
 _live_lock = threading.Lock()
-_live_status: Dict[str, object] = {}
+_DEFAULT_JOB_KEY = "_default"
+_live_jobs: Dict[str, Dict[str, object]] = {}
+_MAX_ENDED_JOBS = 8  # ended entries kept for /status history
+
+
+def _in_flight_of(status: Dict[str, object]) -> List[int]:
+    return sorted(
+        int(e)
+        for e, st in (status.get("epochs") or {}).items()
+        if st.get("state") not in ("done", "failed")
+    )
 
 
 def live_status() -> dict:
     """JSON-safe snapshot of the current (or last) trial's live state —
     the status provider ``shuffle()`` registers with
-    :mod:`~.telemetry.obs_server` when the obs endpoint is on."""
+    :mod:`~.telemetry.obs_server` when the obs endpoint is on. With the
+    service plane on and several jobs live, the top-level fields mirror
+    the most recently started RUNNING job (compatibility with every
+    single-job consumer), ``running`` is true while ANY job runs,
+    ``in_flight_epochs`` is the union over running jobs (the eviction
+    fence), and a ``jobs`` section carries every tracked job's view."""
     with _live_lock:
-        epochs = {
-            str(e): dict(st)
-            for e, st in (_live_status.get("epochs") or {}).items()
-        }
-        out = {k: v for k, v in _live_status.items() if k != "epochs"}
-    out["epochs"] = epochs
+        jobs: Dict[str, Dict[str, object]] = {}
+        for key, st in _live_jobs.items():
+            top = {k: v for k, v in st.items() if k != "epochs"}
+            top["epochs"] = {
+                str(e): dict(es)
+                for e, es in (st.get("epochs") or {}).items()
+            }
+            jobs[key] = top
+    if not jobs:
+        return {"epochs": {}, "in_flight_epochs": []}
+    running = [k for k, st in jobs.items() if st.get("running")]
+
+    def _started(key: str) -> float:
+        return float(jobs[key].get("started_ts") or 0.0)
+
+    primary = max(running or jobs, key=_started)
+    out = dict(jobs[primary])
+    for key in jobs:
+        jobs[key]["in_flight_epochs"] = _in_flight_of(jobs[key])
+    out["running"] = bool(running)
     out["in_flight_epochs"] = sorted(
-        int(e)
-        for e, st in epochs.items()
-        if st.get("state") not in ("done", "failed")
+        {
+            e
+            for key in (running or [primary])
+            for e in jobs[key]["in_flight_epochs"]
+        }
     )
+    if len(jobs) > 1 or primary != _DEFAULT_JOB_KEY:
+        out["jobs"] = jobs
     return out
 
 
@@ -138,7 +176,9 @@ def protected_epochs() -> set:
     window — admitted but not yet fully delivered/consumed — whose
     segments the tiered evictor must not demote or drop. Derived from
     the same live tracker ``/status`` serves, so "in flight" here and
-    on the obs plane can never disagree. Between trials (or before the
+    on the obs plane can never disagree; with several service jobs live
+    the fence is the UNION of their windows (two jobs both at epoch 0
+    keep it fenced until both finish it). Between trials (or before the
     first) the set is empty: everything still resident is cold by
     definition and lineage-recoverable — an ended trial's epochs must
     not stay fenced forever just because delivery never marked them
@@ -155,26 +195,44 @@ def _status_begin_trial(
     num_reducers: int,
     num_trainers: int,
     start_epoch: int,
+    job: Optional[str] = None,
 ) -> None:
+    key = job or _DEFAULT_JOB_KEY
     with _live_lock:
-        _live_status.clear()
-        _live_status.update(
-            {
-                "running": True,
-                "started_ts": time.time(),
-                "num_epochs": num_epochs,
-                "num_files": num_files,
-                "num_reducers": num_reducers,
-                "num_trainers": num_trainers,
-                "start_epoch": start_epoch,
-                "epochs": {},
-            }
-        )
+        if job is None:
+            # Historical single-job semantics: a fresh trial owns the
+            # whole tracker.
+            _live_jobs.clear()
+        else:
+            ended = sorted(
+                (k for k, st in _live_jobs.items() if not st.get("running")),
+                key=lambda k: float(_live_jobs[k].get("ended_ts") or 0.0),
+            )
+            while len(ended) > _MAX_ENDED_JOBS:
+                _live_jobs.pop(ended.pop(0), None)
+        _live_jobs[key] = {
+            "running": True,
+            "job": key,
+            "started_ts": time.time(),
+            "num_epochs": num_epochs,
+            "num_files": num_files,
+            "num_reducers": num_reducers,
+            "num_trainers": num_trainers,
+            "start_epoch": start_epoch,
+            "epochs": {},
+        }
 
 
-def _status_epoch(epoch: int, delivered_inc: int = 0, **kv) -> None:
+def _status_epoch(
+    epoch: int,
+    delivered_inc: int = 0,
+    job: Optional[str] = None,
+    **kv,
+) -> None:
+    key = job or _DEFAULT_JOB_KEY
     with _live_lock:
-        epochs = _live_status.setdefault("epochs", {})
+        status = _live_jobs.setdefault(key, {"epochs": {}})
+        epochs = status.setdefault("epochs", {})
         st = epochs.setdefault(
             int(epoch), {"state": "pending", "delivered_reducers": 0}
         )
@@ -185,12 +243,16 @@ def _status_epoch(epoch: int, delivered_inc: int = 0, **kv) -> None:
         st.update(kv)
 
 
-def _status_end_trial(error: Optional[str] = None) -> None:
+def _status_end_trial(
+    error: Optional[str] = None, job: Optional[str] = None
+) -> None:
+    key = job or _DEFAULT_JOB_KEY
     with _live_lock:
-        _live_status["running"] = False
-        _live_status["ended_ts"] = time.time()
+        status = _live_jobs.setdefault(key, {"epochs": {}})
+        status["running"] = False
+        status["ended_ts"] = time.time()
         if error is not None:
-            _live_status["error"] = error[:300]
+            status["error"] = error[:300]
 
 
 class BatchConsumer:
@@ -2151,10 +2213,25 @@ _SHARED_CACHE: Dict[tuple, ObjectRef] = {}
 def shared_decode_cache_enabled() -> bool:
     """The ONE parser of ``RSDL_DECODE_CACHE_SHARED`` (default off —
     the zero-overhead contract: unset means no registry entry, no
-    ledger ``cache`` tier, per-run cache semantics untouched)."""
-    return os.environ.get(
-        "RSDL_DECODE_CACHE_SHARED", ""
-    ).strip().lower() in ("1", "on", "true", "auto")
+    ledger ``cache`` tier, per-run cache semantics untouched). Under
+    the multi-job service plane (``RSDL_SERVICE``, ISSUE 15) the
+    default flips ON — cross-job hot-dataset sharing is half the point
+    of the service — while an explicit ``off`` still wins."""
+    raw = os.environ.get("RSDL_DECODE_CACHE_SHARED", "").strip().lower()
+    if raw in ("1", "on", "true", "auto"):
+        return True
+    if raw in ("0", "off", "false", "no"):
+        return False
+    if os.environ.get("RSDL_SERVICE"):
+        try:
+            from ray_shuffling_data_loader_tpu.runtime import (
+                service as _service,
+            )
+
+            return _service.enabled()
+        except Exception:
+            return False
+    return False
 
 
 def _shared_cache_key(
@@ -2197,13 +2274,27 @@ class _DecodeCache:
     :func:`_shared_cache_key`) arms the cross-epoch shared tier: claims
     consult the process-level registry before decoding, and resolved
     refs are promoted into it at run end instead of being freed.
+
+    ``service_job`` (ISSUE 15) re-homes the shared tier onto the
+    service plane's CONTENT-identity registry (``shared_keys`` are then
+    :func:`~.runtime.service.cache_key` strings): lookups add a
+    refcounted claim for the job (fencing the segment against the
+    evictor while the job lives) and publishes land in the
+    cross-process registry, so a second job over the same Parquet set
+    is cache-hot from its first epoch.
     """
 
-    def __init__(self, enabled: bool, shared_keys: Optional[list] = None):
+    def __init__(
+        self,
+        enabled: bool,
+        shared_keys: Optional[list] = None,
+        service_job=None,
+    ):
         self.enabled = enabled
         self._lock = threading.Lock()
         self._futs: dict = {}  # file index -> publishing map TaskFuture
         self._shared_keys = shared_keys
+        self._service_job = service_job
 
     def _shared_get(self, index: int) -> Optional[ObjectRef]:
         """A still-live shared-tier ref for file ``index``, else None
@@ -2212,6 +2303,12 @@ class _DecodeCache:
         if self._shared_keys is None:
             return None
         key = self._shared_keys[index]
+        if self._service_job is not None:
+            from ray_shuffling_data_loader_tpu.runtime import (
+                service as _service,
+            )
+
+            return _service.cache_lookup(key, job=self._service_job)
         with _SHARED_CACHE_LOCK:
             ref = _SHARED_CACHE.get(key)
         if ref is None:
@@ -2227,9 +2324,19 @@ class _DecodeCache:
         return None
 
     def _share(self, index: int, ref: ObjectRef) -> None:
-        if self._shared_keys is not None and ref is not None:
-            with _SHARED_CACHE_LOCK:
-                _SHARED_CACHE[self._shared_keys[index]] = ref
+        if self._shared_keys is None or ref is None:
+            return
+        if self._service_job is not None:
+            from ray_shuffling_data_loader_tpu.runtime import (
+                service as _service,
+            )
+
+            _service.cache_publish(
+                self._shared_keys[index], ref, job=self._service_job
+            )
+            return
+        with _SHARED_CACHE_LOCK:
+            _SHARED_CACHE[self._shared_keys[index]] = ref
 
     def claim_or_wait(self, index: int):
         """Returns ``(cache_ref, publish)`` for file ``index``: a
@@ -2665,8 +2772,14 @@ def shuffle_epoch(
     plan: Optional[Tuple[str, int]] = None,
     journal=None,
     est=None,
+    job=None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
+
+    ``job`` (ISSUE 15): the service-plane tenant this epoch belongs to.
+    Its id rides the telemetry context into every stage task (so
+    worker-side audit digests, events, and ledger ops attribute to the
+    job) and keys the live-status entry this epoch updates.
 
     ``journal``/``est`` (ISSUE 13): the run's
     :class:`~.runtime.journal.RunJournal` and this epoch's journaled
@@ -2714,6 +2827,11 @@ def shuffle_epoch(
     """
     if stats_collector is not None:
         stats_collector.call_oneway("epoch_start", epoch)
+    jid = job.job_id if job is not None else None
+    # Job identity for every context (re-)entry below: thread-new
+    # threads and task submissions must all carry it (contextvars do
+    # not cross threads).
+    jkv = {"job": jid} if jid is not None else {}
     # Cluster mode scatters stages across every host's workers; single-host
     # falls back to the local pool (same submit surface).
     pool = runtime.get_context().scheduler
@@ -2777,7 +2895,7 @@ def shuffle_epoch(
     cursor = est.delivered if est is not None else 0
     _status_epoch(
         epoch, state="running", schedule=schedule,
-        delivered_reducers=cursor,
+        delivered_reducers=cursor, job=jid,
     )
     if journal is not None:
         journal.append("epoch", epoch=epoch, schedule=schedule)
@@ -2802,13 +2920,13 @@ def shuffle_epoch(
                     done_ranks.add(rank)
                 if journal is not None:
                     journal.append("epoch-done", epoch=epoch)
-                _status_epoch(epoch, state="done")
+                _status_epoch(epoch, state="done", job=jid)
                 telemetry.emit_event(
                     "epoch.done", epoch=epoch, _flush=True
                 )
             except BaseException as exc:
                 thread.error = exc
-                _status_epoch(epoch, state="failed")
+                _status_epoch(epoch, state="failed", job=jid)
                 telemetry.emit_event(
                     "epoch.failed", _flush=True, epoch=epoch,
                     error=f"{type(exc).__name__}: {exc}"[:200],
@@ -2869,7 +2987,7 @@ def shuffle_epoch(
     # worker-side map spans inherit the epoch id (the deliver thread below
     # re-enters it separately — thread-local context does not cross
     # threads).
-    with telemetry.context(epoch=epoch, schedule=schedule):
+    with telemetry.context(epoch=epoch, schedule=schedule, **jkv):
         if schedule == "index":
             for i in range(len(filenames)):
                 attached = _attach_map(i)
@@ -3090,6 +3208,14 @@ def shuffle_epoch(
         for attempt, backoff in policy.attempts(site="stage.map"):
             try:
                 res = fut.result()
+                if published and res[1] is not None:
+                    # Promote the fresh cache segment into the shared
+                    # tier NOW, not at run end: under the service plane
+                    # (ISSUE 15) a CONCURRENT job over the same files
+                    # should ride these segments mid-flight, not only
+                    # after this run finishes. No-op without shared
+                    # keys; idempotent (first publisher wins).
+                    decode_cache._share(i, res[1])
                 return (res[0], res[1]) if published else (res, None)
             except TaskError as exc:
                 if attempt >= policy.max_attempts:
@@ -3123,12 +3249,22 @@ def shuffle_epoch(
         audit_offsets: Dict[int, int] = (
             dict(est.rank_rows) if est is not None else {}
         )
+        if job is not None:
+            # Fresh thread: make the job ambient for the fair-share
+            # scheduler's reduce submissions (service TLS does not
+            # cross threads; the trace context below carries the id
+            # for telemetry, this carries the Job for scheduling).
+            from ray_shuffling_data_loader_tpu.runtime import (
+                service as _service,
+            )
+
+            _service.set_current_job(job)
         try:
             # Re-enter the epoch's trace context on this (fresh) thread
             # so the reduce submissions and delivery spans below carry
             # the epoch id — INSIDE the try, so the finally's sentinel
             # delivery can never depend on telemetry.
-            with telemetry.context(epoch=epoch, schedule=schedule):
+            with telemetry.context(epoch=epoch, schedule=schedule, **jkv):
                 # Wait for all maps (reduce needs one partition per mapper).
                 # Publishing maps return (refs, cache_ref); unwrap those.
                 with telemetry.trace_span("deliver:wait-maps", cat="shuffle"):
@@ -3572,7 +3708,18 @@ def shuffle_epoch(
                             )
                         else:
                             batch_consumer.consume(rank, epoch, out_refs)
-                    _status_epoch(epoch, delivered_inc=1)
+                    _status_epoch(epoch, delivered_inc=1, job=jid)
+                    if jid is not None:
+                        # Per-job delivered-volume rate: the fairness
+                        # signal the service bench/SLOs key on. Bytes,
+                        # not rows — a whole-segment reducer output
+                        # carries no row window, and opening it just to
+                        # count would cost a read on the hot path.
+                        _metrics.safe_inc(
+                            "service.delivered_bytes",
+                            float(sum(ref.nbytes for ref in out_refs)),
+                            job=jid,
+                        )
                     if journal is not None:
                         # Deliver-thread journal barrier. Write-ahead
                         # ordering with the audit spool: the delivery
@@ -3631,6 +3778,7 @@ def shuffle_epoch(
                     if failed
                     else ("suspended" if suspended else "done")
                 ),
+                job=jid,
             )
             if failed:
                 telemetry.emit_event(
@@ -3776,7 +3924,84 @@ def shuffle(
     SIGTERM graceful-suspend handler. See
     :mod:`~.runtime.journal` and docs/robustness.md ("Preemption,
     suspend/resume, and replay").
+
+    Under the multi-tenant service plane (``RSDL_SERVICE``, ISSUE 15)
+    every call runs as a *job*: the ambient
+    :func:`~.runtime.service.job_context` job if the caller entered
+    one, else a freshly auto-registered job ended when this call
+    returns. Job identity then scopes the live status, audit digests,
+    journal identity, and capacity-ledger attribution, stage tasks are
+    fair-share scheduled against concurrent jobs, epoch admission keys
+    on the shared shm budget, and the decode cache is shared by content
+    identity across jobs. With ``RSDL_SERVICE`` unset none of this
+    executes — the single-job path is byte-for-byte unchanged.
     """
+    service_mod = None
+    job = None
+    own_job = False
+    if os.environ.get("RSDL_SERVICE"):
+        # Lazy, env-guarded: the plane's module body never runs on a
+        # service-off driver (gate-integrity).
+        from ray_shuffling_data_loader_tpu.runtime import (
+            service as service_mod,
+        )
+
+        if service_mod.enabled():
+            job = service_mod.current_job()
+            if job is None:
+                job = service_mod.register_job()
+                own_job = True
+        else:
+            service_mod = None
+    if job is None:
+        return _shuffle_impl(
+            filenames, batch_consumer, num_epochs, num_reducers,
+            num_trainers, seed=seed, stats_collector=stats_collector,
+            start_epoch=start_epoch, narrow_to_32=narrow_to_32,
+            cache_decoded=cache_decoded, schedule_log=schedule_log,
+            device_layout=device_layout, columns=columns,
+            resume_from=resume_from,
+        )
+    try:
+        with service_mod.job_context(job):
+            return _shuffle_impl(
+                filenames, batch_consumer, num_epochs, num_reducers,
+                num_trainers, seed=seed, stats_collector=stats_collector,
+                start_epoch=start_epoch, narrow_to_32=narrow_to_32,
+                cache_decoded=cache_decoded, schedule_log=schedule_log,
+                device_layout=device_layout, columns=columns,
+                resume_from=resume_from, job=job,
+            )
+    finally:
+        if own_job:
+            service_mod.end_job(job)
+
+
+def _shuffle_impl(
+    filenames: List[str],
+    batch_consumer: BatchConsumer,
+    num_epochs: int,
+    num_reducers: int,
+    num_trainers: int,
+    seed: int = 0,
+    stats_collector=None,
+    start_epoch: int = 0,
+    narrow_to_32: bool = False,
+    cache_decoded: Optional[bool] = None,
+    schedule_log: Optional[list] = None,
+    device_layout: Optional[dict] = None,
+    columns: Optional[Sequence[str]] = None,
+    resume_from: Optional[str] = None,
+    job=None,
+) -> float:
+    """The driver body behind :func:`shuffle`; ``job`` is the resolved
+    service-plane tenant (already ambient via job_context) or None."""
+    jid = job.job_id if job is not None else None
+    # What the audit layer reconciles as "this run": normally the job
+    # id; widened to the whole resume chain's ids under a journaled
+    # service resume (set below — records stamped by a preempted
+    # attempt carry ITS id).
+    audit_scope = jid
     if not filenames:
         # A typo'd glob would otherwise "shuffle" zero rows successfully.
         raise ValueError("no input files to shuffle")
@@ -3788,7 +4013,8 @@ def shuffle(
     plan = shuffle_plan_spec()
     runtime.ensure_initialized()
     _status_begin_trial(
-        num_epochs, len(filenames), num_reducers, num_trainers, start_epoch
+        num_epochs, len(filenames), num_reducers, num_trainers,
+        start_epoch, job=jid,
     )
     telemetry.emit_event(
         "trial.start", epochs=num_epochs, files=len(filenames),
@@ -3819,14 +4045,52 @@ def shuffle(
     if resume_from is not None or os.environ.get("RSDL_JOURNAL"):
         from ray_shuffling_data_loader_tpu.runtime import journal as jmod
 
+        if job is not None and job.name == "job":
+            # The journal identity distinguishes tenants by job NAME
+            # (stable across restarts — the per-registration id would
+            # refuse every legitimate resume). With the implicit
+            # default name, two same-shaped tenants sharing one
+            # journal dir would collide and RSDL_RESUME=auto could
+            # cross them — warn loudly; distinct names (RSDL_JOB_NAME
+            # or register_job(name=)) are the documented contract for
+            # journaled multi-tenant runs (docs/service.md).
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "journaled service run with the default job name "
+                "'job': concurrent same-shaped tenants in this journal "
+                "dir would share a run identity — set RSDL_JOB_NAME "
+                "(or register_job(name=...)) per tenant"
+            )
         identity = jmod.run_identity(
             filenames, num_epochs, num_reducers, num_trainers, seed,
             start_epoch, narrow_to_32, _label_of_plan(plan), columns,
             device_layout,
+            job=job.name if job is not None else None,
         )
         resume_state, resume_mode = jmod.resolve_resume(
             resume_from, identity
         )
+        if jid is not None:
+            # Audit lineage across the resume chain (ISSUE 15): digest
+            # records are stamped with the per-registration job id,
+            # which CHANGES across restarts — a resumed attempt must
+            # fold every ancestor attempt's records or the carried
+            # spool would reconcile as a false mismatch. The chain
+            # rides the journal identity (informational, not
+            # validated), so a twice-preempted run still reaches its
+            # grandparent's records.
+            prev_jobs = []
+            if resume_state is not None:
+                prev_jobs = [
+                    str(j)
+                    for j in (
+                        resume_state.identity.get("audit_jobs") or []
+                    )
+                ]
+            identity["audit_jobs"] = prev_jobs + [jid]
+            if prev_jobs:
+                audit_scope = identity["audit_jobs"]
         if not jmod.enabled() and resume_state is None:
             # resume_from="auto"/"off" with RSDL_JOURNAL unset: nothing
             # to resume and nowhere to journal — the plane stays off
@@ -3880,8 +4144,9 @@ def shuffle(
         # run's digests and poison the verdicts. On resume the superseded
         # attempt's spooled partials are the first half of THIS run's
         # digests — carried, not cleared (the reconciler's per-side dedup
-        # absorbs any re-executed stage's duplicate records).
-        _audit.begin_run(carry=resume_state is not None)
+        # absorbs any re-executed stage's duplicate records). Job-scoped
+        # runs must not clear a concurrent tenant's records (ISSUE 15).
+        _audit.begin_run(carry=resume_state is not None, job=jid)
         if resume_state is not None:
             for e, st in resume_state.epochs.items():
                 if st.sampled:
@@ -3892,23 +4157,39 @@ def shuffle(
         )
     shared_keys = None
     if cache_decoded and shared_decode_cache_enabled():
-        # The cross-epoch shared tier: claims hit the process-level
-        # registry (cache-hot across shuffle() calls) and resolved refs
-        # are promoted into it at run end instead of freed.
-        session = runtime.get_context().store.session
-        with _SHARED_CACHE_LOCK:
-            # Entries keyed by a dead session are unreachable (their
-            # segments died with the session's cleanup) — sweep them so
-            # a driver cycling runtime sessions can't grow the registry
-            # forever.
-            for key in [k for k in _SHARED_CACHE if k[0] != session]:
-                del _SHARED_CACHE[key]
-        shared_keys = [
-            _shared_cache_key(session, f, columns, narrow_to_32)
-            for f in filenames
-        ]
+        if job is not None:
+            # Service plane (ISSUE 15): content-identity keys in the
+            # cross-process registry — a concurrent or later job over
+            # the same files (any session process) rides these
+            # segments, and its claims fence them from the evictor.
+            from ray_shuffling_data_loader_tpu.runtime import (
+                service as _service,
+            )
+
+            shared_keys = [
+                _service.cache_key(f, columns, narrow_to_32)
+                for f in filenames
+            ]
+        else:
+            # The cross-epoch shared tier: claims hit the process-level
+            # registry (cache-hot across shuffle() calls) and resolved
+            # refs are promoted into it at run end instead of freed.
+            session = runtime.get_context().store.session
+            with _SHARED_CACHE_LOCK:
+                # Entries keyed by a dead session are unreachable (their
+                # segments died with the session's cleanup) — sweep them
+                # so a driver cycling runtime sessions can't grow the
+                # registry forever.
+                for key in [k for k in _SHARED_CACHE if k[0] != session]:
+                    del _SHARED_CACHE[key]
+            shared_keys = [
+                _shared_cache_key(session, f, columns, narrow_to_32)
+                for f in filenames
+            ]
     decode_cache = _DecodeCache(
-        enabled=cache_decoded, shared_keys=shared_keys
+        enabled=cache_decoded,
+        shared_keys=shared_keys,
+        service_job=job if shared_keys is not None else None,
     )
     if resume_state is not None and cache_decoded:
         # Re-attach the preempted run's surviving decode-cache segments
@@ -3924,7 +4205,21 @@ def shuffle(
                 # in-flight windows quiesce at their reducer barriers.
                 break
             throttle_start = timeit.default_timer()
-            _status_epoch(epoch, state="waiting-admission")
+            _status_epoch(epoch, state="waiting-admission", job=jid)
+            if job is not None:
+                # Service-plane admission (ISSUE 15): hold a NEW window
+                # back while the shared shm budget is over the
+                # admission watermark and other jobs are in flight —
+                # concurrent windows must shape to the ledger, not
+                # thrash the evictor. Bounded wait, and a job with no
+                # window in flight is always admitted (progress).
+                from ray_shuffling_data_loader_tpu.runtime import (
+                    service as _service,
+                )
+
+                _service.admit_epoch(
+                    job, epoch, sum(1 for t in threads if t.is_alive())
+                )
             # The admission span IS the window throttle: its duration is
             # how long this epoch waited for the oldest in-flight epoch to
             # drain (max_concurrent_epochs backpressure) — on the trace
@@ -3935,7 +4230,7 @@ def shuffle(
             with telemetry.context(epoch=epoch):
                 with telemetry.trace_span("epoch:admission", cat="queue"):
                     batch_consumer.wait_until_ready(epoch)
-            _status_epoch(epoch, state="admitted")
+            _status_epoch(epoch, state="admitted", job=jid)
             if stats_collector is not None:
                 stats_collector.call_oneway(
                     "epoch_throttle",
@@ -3966,6 +4261,7 @@ def shuffle(
                     plan=plan,
                     journal=journal,
                     est=est,
+                    job=job,
                 )
             )
         for t in threads:
@@ -3985,7 +4281,7 @@ def shuffle(
                 journal=journal.path,
             )
             _metrics.safe_inc("recovery.suspended_runs")
-            _status_end_trial(error="suspended")
+            _status_end_trial(error="suspended", job=jid)
             # No resume is in progress once the run is suspended: a
             # stuck gauge would page resume_stalled forever in an
             # embedding driver that catches RunSuspended and lives on.
@@ -4010,6 +4306,7 @@ def shuffle(
                 range(start_epoch, num_epochs),
                 stats_collector=stats_collector,
                 plan_label=_label_of_plan(plan),
+                job=audit_scope,
             )
             if journal is not None:
                 # Epoch-reconcile journal barrier: the per-epoch digest
@@ -4038,13 +4335,13 @@ def shuffle(
                 jmod.end_run(journal, status="failed")
             except Exception:
                 pass
-        _status_end_trial(error=f"{type(exc).__name__}: {exc}")
+        _status_end_trial(error=f"{type(exc).__name__}: {exc}", job=jid)
         telemetry.emit_event(
             "trial.failed", _flush=True,
             error=f"{type(exc).__name__}: {exc}"[:200],
         )
         raise
-    _status_end_trial()
+    _status_end_trial(job=jid)
     duration = timeit.default_timer() - start
     telemetry.emit_event(
         "trial.done", duration_s=round(duration, 3), _flush=True

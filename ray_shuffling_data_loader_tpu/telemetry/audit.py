@@ -73,11 +73,28 @@ _enabled: Optional[bool] = None  # tri-state: None = not yet read from env
 _lock = threading.Lock()
 _records: List[dict] = []
 _verdicts: List[dict] = []
-_emitted_epochs: set = set()
-_sample_counts: Dict[int, int] = {}  # epoch -> keys sampled so far
+_emitted_epochs: set = set()  # (job, epoch) pairs with metrics emitted
+_sample_counts: Dict[Tuple, int] = {}  # (job, epoch) -> keys sampled
 _faults: Dict[Tuple[str, int], int] = {}
 _atexit_registered = False
 _warned_no_key = False
+
+
+def _ambient_job() -> Optional[str]:
+    """The ambient service-plane job id (ISSUE 15), read from the trace
+    context via ``sys.modules`` — a single-job process that never
+    entered a job context gets None and records stay exactly as before
+    (no import, no field)."""
+    import sys as _sys
+
+    tr = _sys.modules.get("ray_shuffling_data_loader_tpu.telemetry.trace")
+    if tr is None:
+        return None
+    try:
+        job = tr.current_context().get("job")
+    except Exception:
+        return None
+    return None if job is None else str(job)
 
 
 class AuditError(AssertionError):
@@ -291,6 +308,11 @@ def _digest_record(
         "sum": d.sum,
         **extra,
     }
+    job = _ambient_job()
+    if job is not None:
+        # Multi-job service (ISSUE 15): scope the digest to its tenant
+        # so concurrent jobs' same-numbered epochs never fold together.
+        rec["job"] = job
     if offset is not None:
         rec["offset"] = int(offset)
         rec["seq"] = d.seq
@@ -339,8 +361,11 @@ def record_deliver(
         if keys is not None:
             # Sample extras are attached BEFORE the append: a record must
             # never mutate after it becomes visible to a concurrent flush.
+            # The sample cap is per (job, epoch): two concurrent jobs'
+            # rank-0 streams must each keep a full quality sample.
+            skey = (_ambient_job(), int(epoch))
             with _lock:
-                taken = _sample_counts.get(int(epoch), 0)
+                taken = _sample_counts.get(skey, 0)
                 want = _sample_cap() - taken
             if want > 0:
                 sample = np.asarray(keys)[:want]
@@ -349,7 +374,7 @@ def record_deliver(
                     for k in sample.tolist()
                 ]
                 with _lock:
-                    _sample_counts[int(epoch)] = taken + len(sample)
+                    _sample_counts[skey] = taken + len(sample)
         _digest_record("deliver", epoch, columns, offset=offset, **extra)
     except Exception:
         logger.warning("audit: deliver digest failed", exc_info=True)
@@ -477,7 +502,7 @@ def reset(clear_spool: bool = False) -> None:
                         pass
 
 
-def begin_run(carry: bool = False) -> None:
+def begin_run(carry: bool = False, job: Optional[str] = None) -> None:
     """Mark the start of one audited shuffle run: previous records (local
     and spooled) would otherwise fold into this run's digests. Called by
     ``shuffle()`` when auditing is on — one audited run per spool dir at
@@ -488,7 +513,37 @@ def begin_run(carry: bool = False) -> None:
     first half of this run's digests, and clearing them would make
     every partially-delivered epoch reconcile as a false mismatch. The
     local buffer/verdict state still resets (this is a fresh process's
-    run boundary)."""
+    run boundary).
+
+    ``job`` (the multi-job service, ISSUE 15): a job-scoped run must
+    NOT clear shared state while a CONCURRENT tenant's in-flight
+    records live in the same buffer and spool — its records are
+    job-stamped and its reconcile is job-filtered, and job ids are
+    never reused. But a resident service driver running tenants
+    sequentially would otherwise grow the spool without bound (every
+    finished job's records are provably dead), so when this job is the
+    SOLE live tenant session-wide the classic full reset runs —
+    bounded state, identical semantics."""
+    if job is not None:
+        if not carry:
+            try:
+                from ray_shuffling_data_loader_tpu.runtime import (
+                    service as _service,
+                )
+
+                # <= 1: this job itself registered before begin_run.
+                if _service.live_jobs_count() <= 1:
+                    reset(clear_spool=True)
+                    return
+            except Exception:
+                pass  # can't prove sole tenancy: keep everything
+        with _lock:
+            _emitted_epochs.difference_update(
+                {k for k in _emitted_epochs if k[0] == job}
+            )
+            for k in [k for k in _sample_counts if k[0] == job]:
+                del _sample_counts[k]
+        return
     reset(clear_spool=not carry)
 
 
@@ -497,18 +552,18 @@ def seed_sample_count(epoch: int, taken: int) -> None:
     run already took ``taken`` sample keys for ``epoch`` (they ride its
     spooled deliver records), so this process's cap accounting must
     start there, not at zero — the combined sample stays one capped
-    prefix of the rank-0 stream."""
+    prefix of the rank-0 stream. Keyed by the ambient job like the
+    records themselves."""
+    skey = (_ambient_job(), int(epoch))
     with _lock:
-        _sample_counts[int(epoch)] = max(
-            _sample_counts.get(int(epoch), 0), int(taken)
-        )
+        _sample_counts[skey] = max(_sample_counts.get(skey, 0), int(taken))
 
 
 def sample_count(epoch: int) -> int:
     """Sample keys taken so far for ``epoch`` (journal barrier reads
     this so a resumed run can seed it back)."""
     with _lock:
-        return _sample_counts.get(int(epoch), 0)
+        return _sample_counts.get((_ambient_job(), int(epoch)), 0)
 
 
 def _load_records() -> List[dict]:
@@ -653,19 +708,25 @@ def _emit_metrics(verdict: dict) -> None:
     if not _metrics.enabled():
         return
     epoch = verdict["epoch"]
+    job = verdict.get("job")
     with _lock:
-        if epoch in _emitted_epochs:
+        if (job, epoch) in _emitted_epochs:
             return
-        _emitted_epochs.add(epoch)
+        _emitted_epochs.add((job, epoch))
+    # Per-job label only on job-scoped runs: single-job series keep
+    # their exact historical shape (the zero-overhead-off contract).
+    jl: Dict[str, Any] = {"job": job} if job is not None else {}
     reg = _metrics.registry
-    reg.counter("audit.rows_mapped").inc(verdict["rows_mapped"])
-    reg.counter("audit.rows_reduced").inc(verdict["rows_reduced"])
-    reg.counter("audit.rows_delivered").inc(verdict["rows_delivered"])
+    reg.counter("audit.rows_mapped", **jl).inc(verdict["rows_mapped"])
+    reg.counter("audit.rows_reduced", **jl).inc(verdict["rows_reduced"])
+    reg.counter("audit.rows_delivered", **jl).inc(
+        verdict["rows_delivered"]
+    )
     # Resolve up front so a clean run reports 0.0, not a missing key.
-    mism = reg.counter("audit.digest_mismatch")
+    mism = reg.counter("audit.digest_mismatch", **jl)
     if verdict["ok"] is False:
         mism.inc()
-    reg.gauge("audit.epoch_ok", epoch=epoch).set(
+    reg.gauge("audit.epoch_ok", epoch=epoch, **jl).set(
         1.0 if verdict["ok"] else 0.0
     )
     # Shuffle-quality gauges carry the run's plan family (ISSUE 12):
@@ -684,13 +745,16 @@ def _emit_metrics(verdict: dict) -> None:
     ):
         value = verdict.get(name)
         if value is not None:
-            reg.gauge(f"audit.{name}", epoch=epoch, plan=plan).set(value)
+            reg.gauge(f"audit.{name}", epoch=epoch, plan=plan, **jl).set(
+                value
+            )
 
 
 def reconcile(
     epochs: Optional[Sequence[int]] = None,
     stats_collector=None,
     plan_label: Optional[str] = None,
+    job=None,
 ) -> List[dict]:
     """Fold every visible record into per-epoch verdicts: map-side ==
     reduce-side == delivered-side coverage (and consumed-side when every
@@ -705,7 +769,17 @@ def reconcile(
     it resolved rather than this process's env, so an offline or
     env-divergent reconcile cannot mislabel the quality gauges; None
     falls back to this process's env, and on any parse failure the
-    verdicts carry ``unknown`` (never a silently-wrong default)."""
+    verdicts carry ``unknown`` (never a silently-wrong default).
+
+    ``job`` (the multi-job service, ISSUE 15): reconcile exactly ONE
+    tenant's records — a concurrent job's same-numbered epochs are a
+    different stream, and folding them together would report a false
+    mismatch on two correct runs. A sequence of ids is one tenant's
+    RESUME CHAIN (job ids change across restarts; the preempted
+    attempts' carried records stamp the old ids) — the verdicts carry
+    the newest id. ``None`` keeps the historical behavior (every
+    record folds), which is correct exactly when the process runs one
+    job at a time."""
     if plan_label is None:
         try:
             from ray_shuffling_data_loader_tpu.utils import (
@@ -717,6 +791,14 @@ def reconcile(
             plan_label = "unknown"
     flush()  # our own records join the spool view
     recs = _load_records()
+    if job is not None:
+        if isinstance(job, str):
+            wanted = {job}
+        else:
+            chain = [str(j) for j in job]
+            wanted = set(chain)
+            job = chain[-1]  # verdicts/gauges carry the newest attempt
+        recs = [r for r in recs if r.get("job") in wanted]
     by_epoch: Dict[int, List[dict]] = {}
     for r in recs:
         by_epoch.setdefault(int(r.get("epoch", -1)), []).append(r)
@@ -741,16 +823,17 @@ def reconcile(
         staged = _fold(sides["staged"])
         mismatch: List[str] = []
         if not sides["map"] and not sides["reduce"] and not sides["deliver"]:
-            verdicts.append(
-                {
-                    "epoch": epoch,
-                    "ok": None,
-                    "detail": "no records",
-                    "rows_mapped": 0,
-                    "rows_reduced": 0,
-                    "rows_delivered": 0,
-                }
-            )
+            verdict_nr: Dict[str, Any] = {
+                "epoch": epoch,
+                "ok": None,
+                "detail": "no records",
+                "rows_mapped": 0,
+                "rows_reduced": 0,
+                "rows_delivered": 0,
+            }
+            if job is not None:
+                verdict_nr["job"] = job
+            verdicts.append(verdict_nr)
             prev_sample = None
             continue
         if not sides["map"] and not sides["reduce"]:
@@ -759,18 +842,19 @@ def reconcile(
             # a shared RSDL_AUDIT_DIR). That is an incomplete audit, not
             # a data defect — flagging it as a mismatch would abort
             # healthy strict-mode runs.
-            verdicts.append(
-                {
-                    "epoch": epoch,
-                    "ok": None,
-                    "detail": "map/reduce records missing (is "
-                    "RSDL_AUDIT_DIR on a filesystem shared with the "
-                    "workers?)",
-                    "rows_mapped": 0,
-                    "rows_reduced": 0,
-                    "rows_delivered": delivered.count,
-                }
-            )
+            verdict_inc: Dict[str, Any] = {
+                "epoch": epoch,
+                "ok": None,
+                "detail": "map/reduce records missing (is "
+                "RSDL_AUDIT_DIR on a filesystem shared with the "
+                "workers?)",
+                "rows_mapped": 0,
+                "rows_reduced": 0,
+                "rows_delivered": delivered.count,
+            }
+            if job is not None:
+                verdict_inc["job"] = job
+            verdicts.append(verdict_inc)
             prev_sample = None
             continue
         if reduced.coverage() != mapped.coverage():
@@ -816,6 +900,8 @@ def reconcile(
             ),
             "plan": plan_label,
         }
+        if job is not None:
+            verdict["job"] = job
         verdict.update(_quality(sample, prev_sample))
         verdict.update(_entropy(sides["map"]))
         prev_sample = sample or None
@@ -835,7 +921,14 @@ def reconcile(
                 delivered.hex(),
             )
     with _lock:
-        _verdicts[:] = verdicts
+        if job is None:
+            _verdicts[:] = verdicts
+        else:
+            # Replace only this tenant's verdicts: a concurrent job's
+            # reconcile must not clobber another's last view.
+            _verdicts[:] = [
+                v for v in _verdicts if v.get("job") != job
+            ] + verdicts
     bad = [v["epoch"] for v in verdicts if v["ok"] is False]
     if bad and strict():
         raise AuditError(

@@ -86,6 +86,7 @@ _atexit_registered = False
 # drops out of the view (all segments freed) must be zeroed, not left
 # showing its final residency forever.
 _published_pairs: set = set()
+_published_job_pairs: set = set()
 
 
 def epoch_sort_key(epoch: Any) -> Tuple[int, int]:
@@ -126,6 +127,19 @@ def _ambient_epoch() -> Optional[int]:
         return None
 
 
+def _ambient_job() -> Optional[str]:
+    """The ambient service-plane job id (ISSUE 15) — per-job residency
+    attribution for the multi-tenant ``/capacity`` view. None outside
+    a job context (single-job records keep their exact shape)."""
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import trace as _trace
+
+        job = _trace.current_context().get("job")
+        return None if job is None else str(job)
+    except Exception:
+        return None
+
+
 def note(
     op: str,
     object_id: str,
@@ -156,6 +170,9 @@ def note(
                 epoch = _ambient_epoch()
             if epoch is not None:
                 rec["epoch"] = int(epoch)
+            job = _ambient_job()
+            if job is not None:
+                rec["job"] = job
         _register_atexit()
         with _lock:
             _records.append(rec)
@@ -293,10 +310,11 @@ def load_records(path: Optional[str] = None) -> List[dict]:
 
 
 def reset(clear_spool: bool = False) -> None:
-    global _published_pairs, _fold_cache
+    global _published_pairs, _published_job_pairs, _fold_cache
     with _lock:
         _records.clear()
         _published_pairs = set()
+        _published_job_pairs = set()
         _fold_cache = None
     with _touch_lock:
         _touch_last.clear()
@@ -319,15 +337,18 @@ def reset(clear_spool: bool = False) -> None:
 
 
 class _Seg:
-    __slots__ = ("nbytes", "tier", "epoch", "ts", "links", "last_touch")
+    __slots__ = (
+        "nbytes", "tier", "epoch", "ts", "links", "last_touch", "job",
+    )
 
-    def __init__(self, nbytes, tier, epoch, ts, links):
+    def __init__(self, nbytes, tier, epoch, ts, links, job=None):
         self.nbytes = nbytes
         self.tier = tier
         self.epoch = epoch
         self.ts = ts
         self.links = links
         self.last_touch = ts  # creation counts as the first access
+        self.job = job  # owning service job, None single-job
 
 
 # Live-fold memo: (op count, folded view) — the sampler tick, /status,
@@ -454,6 +475,7 @@ def _fold(
                 _epoch_key(rec),
                 float(rec.get("ts", 0.0)),
                 set(rec.get("ids") or [rid]),
+                job=rec.get("job"),
             )
             if rid in segs:  # duplicate create (retried task): replace
                 _drop(rid)
@@ -533,9 +555,23 @@ def _fold(
         if tier in totals:
             for field in totals[tier]:
                 totals[tier][field] += cell.get(field, 0)
+    # Per-job residency rollup (ISSUE 15): the multi-tenant service's
+    # ``/capacity`` answer to "who holds the budget". Only live
+    # segments carry a job; single-job ledgers produce an empty map.
+    jobs: Dict[str, Dict[str, Dict[str, int]]] = {}
+    for seg in segs.values():
+        if seg.job is None:
+            continue
+        cell = jobs.setdefault(str(seg.job), {}).setdefault(
+            seg.tier, {"resident_bytes": 0, "segments": 0}
+        )
+        cell["resident_bytes"] += seg.nbytes
+        cell["segments"] += 1
+
     out: Dict[str, Any] = {
         "epochs": epochs,
         "totals": totals,
+        "jobs": jobs,
         "live_segments": len(segs),
         "ops": len(records),
     }
@@ -548,6 +584,7 @@ def _fold(
                     "nbytes": seg.nbytes,
                     "tier": seg.tier,
                     "epoch": seg.epoch,
+                    "job": seg.job,
                     "ts": seg.ts,
                     "last_touch": seg.last_touch,
                 }
@@ -710,6 +747,21 @@ def publish_metrics(full: Optional[Dict[str, Any]] = None) -> None:
         # only on the sampler tick thread; _published_pairs is its
         # private previous-tick snapshot
         _published_pairs = pairs
+        global _published_job_pairs
+        job_pairs = set()
+        for jid, tiers in (full.get("jobs") or {}).items():
+            for tier, cell in tiers.items():
+                job_pairs.add((jid, tier))
+                reg.gauge(
+                    "capacity.job_resident_bytes", job=jid, tier=tier
+                ).set(cell.get("resident_bytes", 0))
+        for jid, tier in _published_job_pairs - job_pairs:
+            reg.gauge(
+                "capacity.job_resident_bytes", job=jid, tier=tier
+            ).set(0)
+        # rsdl-lint: disable=lock-discipline -- sampler-tick-private,
+        # same as _published_pairs above
+        _published_job_pairs = job_pairs
         for tier in TIERS:
             tot = full.get("totals", {}).get(tier) or {}
             reg.gauge("capacity.tier_resident_bytes", tier=tier).set(
@@ -744,5 +796,6 @@ def status_section(limit: int = 12) -> Dict[str, Any]:
         "host": full.get("host"),
         "shm_used_frac": full.get("shm_used_frac"),
         "live_segments": full.get("live_segments"),
+        "jobs": full.get("jobs") or {},
         "epochs": {e: epochs[e] for e in latest},
     }

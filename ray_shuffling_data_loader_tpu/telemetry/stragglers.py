@@ -111,11 +111,14 @@ def record_task(
     duration_s: float,
     nbytes: int = 0,
     epoch: Optional[int] = None,
+    job: Optional[str] = None,
 ) -> None:
     """One completed task's record, buffered locally (the task-done
     flush drains it). Also observes ``task.duration_seconds{stage=}``
     so the cumulative distribution rides the ordinary metrics spool.
-    Caller gates on ``metrics.enabled()``; never raises."""
+    ``job`` is the service-plane tenant (ISSUE 15) so multi-job
+    straggler views can attribute per job. Caller gates on
+    ``metrics.enabled()``; never raises."""
     try:
         stage = stage_name(fn_name)
         rec: Dict[str, Any] = {
@@ -129,6 +132,8 @@ def record_task(
             rec["nbytes"] = int(nbytes)
         if epoch is not None:
             rec["epoch"] = int(epoch)
+        if job is not None:
+            rec["job"] = str(job)
         with _lock:
             _records.append(rec)
         _metrics.registry.histogram(

@@ -113,9 +113,10 @@ def refresh_from_env() -> None:
     """Forget the cached enabled state and buffer limit; the next check
     re-reads the env (test harness hook — fixtures restore the env then
     call this)."""
-    global _enabled, _max_events_cached
+    global _enabled, _max_events_cached, _service_armed_cached
     _enabled = None
     _max_events_cached = None
+    _service_armed_cached = None
 
 
 def spool_dir() -> Optional[str]:
@@ -187,6 +188,20 @@ def set_context(**kv: Any) -> None:
         _base_ctx.update(kv)
 
 
+_service_armed_cached: Optional[bool] = None
+
+
+def _service_armed() -> bool:
+    """Is the multi-job service plane armed (``RSDL_SERVICE``)? One
+    cached env read — NOT an import of the service module: context
+    propagation must stay import-free on its hot path."""
+    global _service_armed_cached
+    if _service_armed_cached is None:
+        raw = os.environ.get("RSDL_SERVICE", "").strip().lower()
+        _service_armed_cached = raw not in ("", "off", "0", "false", "no")
+    return _service_armed_cached
+
+
 def outbound_context() -> Optional[Dict[str, Any]]:
     """The context to ship with a cross-process call, or None when there
     is nothing to ship (both telemetry halves off, or the merged context
@@ -194,13 +209,17 @@ def outbound_context() -> Optional[Dict[str, Any]]:
     boundaries. The METRICS half needs (trial, epoch) identity too —
     task-duration records, the event log, and the capacity ledger all
     attribute by epoch (ISSUE 7/9) — so context ships whenever either
-    half is on; with both off this stays one cached boolean check."""
+    half is on; with both off this stays one cached boolean check.
+    The service plane (ISSUE 15) ships it too even with telemetry off:
+    worker-side audit digests attribute to a job only through this
+    context, and a multi-job audit without job identity would fold
+    every tenant into one verdict."""
     if not enabled():
         from ray_shuffling_data_loader_tpu.telemetry import (
             metrics as _metrics,
         )
 
-        if not _metrics.enabled():
+        if not _metrics.enabled() and not _service_armed():
             return None
     return current_context() or None
 

@@ -180,6 +180,19 @@ if [ "$tier" != "slow" ]; then
     RSDL_FAULTS_SEED=1313 \
     python -m pytest tests/test_resume.py tests/test_checkpoint.py \
       -m "not slow" -q -x
+  # Service lane (ISSUE 15): the multi-tenant shuffle service — two
+  # concurrent jobs under a low-prob xN-capped fault schedule with
+  # STRICT per-job audit (the two-job concurrency test proves per-job
+  # ok=true AND delivered_seq digests bit-identical to solo same-seed
+  # runs; the chaos leg proves one job's crashed reducer never touches
+  # the neighbor's epochs), plus the name-collision regression,
+  # fair-share/admission units, cross-job cache-hot, and the
+  # zero-overhead-off fresh-interpreter proof. The suite arms
+  # RSDL_SERVICE itself per test (function-scoped runtimes); the
+  # lane-level schedule rides into every spawned worker.
+  RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=1515 \
+    python -m pytest tests/test_service.py -m "not slow" -q -x
   # Temporal + decision obs smoke (ISSUES 7/9), exit-code gated:
   # against a MID-FLIGHT shuffle with the obs endpoint up, /timeseries
   # must serve a non-empty rate series, `rsdl_top --once --json` must

@@ -704,6 +704,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(epoch, stage) median (default 4)",
     )
     parser.add_argument(
+        "--job", default=None,
+        help="multi-job service (ISSUE 15): restrict the events / "
+        "task-records / capacity-ledger joins to ONE job (exact job "
+        "id, or a substring matching it) so per-job views don't "
+        "interleave concurrent tenants' same-numbered epochs",
+    )
+    parser.add_argument(
         "--threshold-pct", type=float, default=10.0,
         help="max tolerated throughput drop vs baseline (%%, default 10)",
     )
@@ -756,9 +763,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return records
 
-    event_records = _temporal(args.events, "events-", "kind", "events")
-    task_records = _temporal(
-        args.task_records, "tasks-", "dur_s", "task records"
+    def _job_filter(records):
+        """Keep one tenant's records. Job-stamped records must match;
+        unstamped ones (session-level ops — store samples, cleanup)
+        are kept: dropping them would hide session-wide capacity."""
+        if records is None or not args.job:
+            return records
+        return [
+            r
+            for r in records
+            if "job" not in r or args.job in str(r.get("job"))
+        ]
+
+    event_records = _job_filter(
+        _temporal(args.events, "events-", "kind", "events")
+    )
+    task_records = _job_filter(
+        _temporal(args.task_records, "tasks-", "dur_s", "task records")
     )
     ts_samples = _temporal(
         ts_path, "timeseries", "metrics", "timeseries"
@@ -770,8 +791,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         sub = _os.path.join(cap_path, "capacity")
         if _os.path.isdir(sub):
             cap_path = sub
-    capacity_records = _temporal(
-        cap_path, "ledger-", "op", "capacity ledger"
+    capacity_records = _job_filter(
+        _temporal(cap_path, "ledger-", "op", "capacity ledger")
     )
     try:
         events: List[dict] = []

@@ -136,19 +136,55 @@ def _prom_alias(base: str) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _match_job(jobs: Dict[str, Any], wanted: str) -> Optional[str]:
+    """Resolve a ``--job`` filter against the per-job map: exact id
+    first, then unique substring (job names prefix the ids)."""
+    if wanted in jobs:
+        return wanted
+    hits = [k for k in jobs if wanted in k]
+    return hits[0] if len(hits) == 1 else None
+
+
 def render(frame: Dict[str, Any]) -> str:
     status = frame.get("status") or {}
     healthz = frame.get("healthz") or {}
     lines: List[str] = []
     shuffle = (status.get("providers") or {}).get("shuffle") or {}
-    epoch_window = status.get("in_flight_epochs") or []
+    job_filter = frame.get("job_filter")
+    job_note = ""
+    if job_filter:
+        # Multi-job service (ISSUE 15): focus the trial panel on ONE
+        # tenant's view instead of interleaving every job's epochs.
+        jobs = shuffle.get("jobs") or {}
+        key = _match_job(jobs, job_filter)
+        if key is not None:
+            shuffle = jobs[key]
+            job_note = f"  job={key}"
+        else:
+            job_note = f"  job={job_filter}(no match)"
+    epoch_window = (
+        shuffle.get("in_flight_epochs")
+        if job_filter
+        else status.get("in_flight_epochs")
+    ) or []
     lines.append(
         f"rsdl_top  {time.strftime('%H:%M:%S', time.localtime(frame['ts']))}"
         f"  {frame['url']}"
         f"  up={healthz.get('ok', '?')}"
         f"  uptime={_fmt(healthz.get('uptime_s'))}s"
         f"  trial_running={shuffle.get('running', '-')}"
+        + job_note
     )
+    service = (status.get("providers") or {}).get("service") or {}
+    if service.get("jobs"):
+        parts = []
+        for rec in service["jobs"][-6:]:
+            parts.append(
+                f"{rec.get('job_id')}"
+                f"[w={rec.get('weight')}"
+                f",{'run' if rec.get('running') else 'done'}]"
+            )
+        lines.append("jobs     " + "  ".join(parts)[:115])
     epochs = shuffle.get("epochs") or {}
     parts = []
     for e in sorted(epochs, key=lambda x: int(x)):
@@ -322,8 +358,23 @@ def render(frame: Dict[str, Any]) -> str:
             + (f" epoch={task['epoch']}" if "epoch" in task else "")
         )
 
-    # Events tail.
+    # Events tail (job-filtered when --job is set: job-stamped records
+    # must match; UNstamped ones are session-level — store/evictor/obs
+    # — and stay visible, the same policy as epoch_report --job). The
+    # by_kind header is recomputed from the filtered set so the counts
+    # and the tail below them can never disagree.
     events = frame.get("events") or {}
+    if job_filter:
+        recs = [
+            r
+            for r in (events.get("events") or [])
+            if "job" not in r or job_filter in str(r.get("job"))
+        ]
+        by_kind_f: Dict[str, int] = {}
+        for r in recs:
+            kind = str(r.get("kind", "?"))
+            by_kind_f[kind] = by_kind_f.get(kind, 0) + 1
+        events = dict(events, events=recs, by_kind=by_kind_f)
     lines.append("")
     by_kind = events.get("by_kind") or {}
     lines.append(
@@ -388,12 +439,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true",
         help="emit the raw frame as JSON instead of the dashboard",
     )
+    parser.add_argument(
+        "--job", default=None,
+        help="focus on ONE service job (exact job id or unique "
+        "substring): the trial panel shows that job's epochs and the "
+        "events tail is filtered to it (multi-job service, ISSUE 15)",
+    )
     args = parser.parse_args(argv)
     base = (args.url or default_url()).rstrip("/")
 
     while True:
         try:
             frame = collect(base, args.window)
+            if args.job:
+                frame["job_filter"] = args.job
         except (urllib.error.URLError, OSError, ValueError) as exc:
             print(f"rsdl_top: {base} unreachable: {exc}", file=sys.stderr)
             return 1
