@@ -308,6 +308,45 @@ def _attach_obs_summaries(result: dict) -> None:
         pass
 
 
+def _ledger_append(result: dict) -> None:
+    """Append this bench invocation to the durable run ledger (ISSUE
+    16, telemetry/runledger.py) and embed the record id in the bench
+    JSON (``ledger_record``) so an artifact line and its ledger row
+    cross-reference each other. Called on success AND the watchdog/
+    error paths — a failed capture is exactly what the next run's
+    ``--regress`` comparison needs to see. Check-then-import keeps the
+    plane zero-overhead with RSDL_RUN_LEDGER unset; never raises."""
+    if not os.environ.get("RSDL_RUN_LEDGER"):
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import runledger
+
+        if not runledger.enabled():
+            return
+        extra = {
+            "bench": {
+                k: result.get(k)
+                for k in ("metric", "value", "unit", "plane",
+                          "vs_baseline", "backend", "target_context")
+                if result.get(k) is not None
+            }
+        }
+        value = result.get("value")
+        unit = str(result.get("unit") or "")
+        if isinstance(value, (int, float)) and value and "GB/s" in unit:
+            extra["throughput"] = {"bytes_per_s": float(value) * 1e9}
+        rec_id = runledger.record_run(
+            "failed" if result.get("error") else "done",
+            kind="bench",
+            error=result.get("error"),
+            extra=extra,
+        )
+        if rec_id:
+            result["ledger_record"] = rec_id
+    except Exception:
+        pass
+
+
 def _error_result(platform, msg: str) -> dict:
     """The failure shape of the one-JSON-line contract (shared by the
     stall watchdog and main()'s last-resort handler so the contract has
@@ -542,6 +581,7 @@ def _measure_peak_h2d_gbps(platform: str, budget_s: float = 300.0) -> float:
             else "H2D probe thread exited without a result"
         )
         result = _error_result(platform, msg)
+        _ledger_append(result)
         print(json.dumps(result), flush=True)
         _export_telemetry_for_exit()
         # Nonzero so rc-keyed tooling (tpu_watch.sh's "rc=$?" log) records
@@ -1099,6 +1139,7 @@ def run_bench(platform: str, num_chips: int, tpu_error):
                 )
                 if tpu_error is not None:
                     result["tpu_error"] = str(tpu_error)[:300]
+                _ledger_append(result)
                 print(json.dumps(result), flush=True)
                 if profile_dir:
                     # The trace of the wedged run is the one artifact
@@ -2472,6 +2513,7 @@ def main() -> None:
                 "unit": "s",
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }
+        _ledger_append(result)
         print(json.dumps(result), flush=True)
         sys.exit(1 if "error" in result else 0)
 
@@ -2494,6 +2536,7 @@ def main() -> None:
                 "unit": "s",
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }
+        _ledger_append(result)
         print(json.dumps(result), flush=True)
         sys.exit(1 if "error" in result else 0)
 
@@ -2514,6 +2557,7 @@ def main() -> None:
                 "unit": "GB/s",
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }
+        _ledger_append(result)
         print(json.dumps(result), flush=True)
         sys.exit(1 if "error" in result else 0)
 
@@ -2644,6 +2688,7 @@ def main() -> None:
             result["telemetry_final"] = _metrics_export.aggregate()
         except Exception as exc:
             result["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    _ledger_append(result)
     print(json.dumps(result), flush=True)
 
 

@@ -201,6 +201,10 @@ KNOBS: Tuple[Knob, ...] = (
          "shm-used fraction above which new epoch windows wait"),
     Knob("RSDL_SERVICE_ADMIT_TIMEOUT_S", "float", "30", "public",
          "bounded admission wait before a window proceeds anyway"),
+    Knob("RSDL_RUN_LEDGER", "path", "off", "public",
+         "durable run-ledger NDJSON (1/on/true/auto = "
+         "<runtime_dir>/runs/ledger.ndjson, anything else = explicit "
+         "path)"),
     # -- suspend / resume ---------------------------------------------------
     Knob("RSDL_JOURNAL", "path", "off", "public",
          "driver write-ahead journal dir"),

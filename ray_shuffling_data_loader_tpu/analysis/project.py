@@ -46,6 +46,7 @@ GATED_PLANES = {
         "trace",
         "phases",
         "obs_server",
+        "runledger",
     )
 } | {
     f"{PACKAGE}.runtime.{m}"

@@ -416,6 +416,7 @@ class FairShareScheduler:
         self._notify = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._closed = False
+        self._lag_published: set = set()
 
     # The scheduler duck-type surface shuffle_epoch sees.
     @property
@@ -523,10 +524,12 @@ class FairShareScheduler:
                     j: q for j, q in self._pending.items() if q
                 }
                 if not queues:
+                    self._publish_vtime_lag_locked()
                     return
                 total = sum(self._inflight.values())
                 if self._multi_tenant_locked() and total >= self.width:
                     _metrics.safe_inc("service.tasks_throttled")
+                    self._publish_vtime_lag_locked()
                     return
                 job_id = min(
                     queues,
@@ -541,6 +544,7 @@ class FairShareScheduler:
                 ) + 1.0 / self._weights.get(job_id, 1.0)
                 thunk, proxy = queues[job_id].popleft()
                 self._inflight[job_id] = self._inflight.get(job_id, 0) + 1
+                self._publish_vtime_lag_locked()
             try:
                 inner_fut = thunk()
             except BaseException as exc:
@@ -566,6 +570,39 @@ class FairShareScheduler:
                 self._released.append((inner_fut, job_id, proxy))
             if inner_fut.done():
                 self._notify.set()
+
+    def _publish_vtime_lag_locked(self) -> None:
+        """Per-job dispatch-lag gauges: how far each active job's
+        virtual clock trails the most-advanced active clock,
+        ``service.dispatch_vtime_lag{job=}``. A job with no queued
+        tasks publishes 0 (it is not waiting on dispatch, whatever its
+        clock says); departed jobs' gauges are zeroed so a stale series
+        cannot hold the fair_share_starved alert open. Caller holds
+        ``self._lock``; metrics-gated, never raises."""
+        if not _metrics.enabled():
+            return
+        try:
+            reg = _metrics.registry
+            active = set(self._inflight) | {
+                j for j, q in self._pending.items() if q
+            }
+            lead = max(
+                (self._vtime.get(j, 0.0) for j in active), default=0.0
+            )
+            for job_id in active:
+                lag = (
+                    lead - self._vtime.get(job_id, 0.0)
+                    if self._pending.get(job_id)
+                    else 0.0
+                )
+                reg.gauge(
+                    "service.dispatch_vtime_lag", job=job_id
+                ).set(round(lag, 4))
+            for job_id in self._lag_published - active:
+                reg.gauge("service.dispatch_vtime_lag", job=job_id).set(0.0)
+            self._lag_published = active
+        except Exception:
+            pass
 
     def _dec_inflight_locked(self, job_id: str) -> None:
         n = self._inflight.get(job_id, 0) - 1
@@ -595,6 +632,8 @@ class FairShareScheduler:
                     else:
                         still.append(entry)
                 self._released = still
+                if finished:
+                    self._publish_vtime_lag_locked()
                 idle = (
                     not self._released
                     and not any(q for q in self._pending.values())
@@ -750,9 +789,13 @@ def admit_epoch(job: Job, epoch: int, in_flight: int) -> float:
     if waited > 0.05:
         try:
             if _metrics.enabled():
-                _metrics.registry.counter(
+                # Histogram (ISSUE 16): the SLO plane's
+                # admission_wait_long rule keys on the windowed MEAN
+                # wait per tenant, which a bare counter cannot give it;
+                # count/sum/min/max also feed the /jobs rollup.
+                _metrics.registry.histogram(
                     "service.admission_wait_seconds", job=job.job_id
-                ).inc(waited)
+                ).observe(waited)
         except Exception:
             pass
     return waited
@@ -982,6 +1025,29 @@ def claimed_cache_ids() -> set:
             oid = entry.get("id")
             if oid:
                 out.add(str(oid))
+    return out
+
+
+def job_cache_claims() -> Dict[str, int]:
+    """``{job_id: shared-cache entries claimed}`` across the on-disk
+    registry and the in-process view — the ``/jobs`` fleet view's
+    cache-claims column."""
+    seen = set()
+    out: Dict[str, int] = {}
+    try:
+        with _registry_locked() as data:
+            entries = list((data or {}).values())
+    except Exception:
+        entries = []
+    with _cache_lock:
+        entries += list(_cache_mem.values())
+    for entry in entries:
+        oid = entry.get("id")
+        if oid in seen:
+            continue  # the same entry, seen via both views
+        seen.add(oid)
+        for job_id in entry.get("claims") or {}:
+            out[job_id] = out.get(job_id, 0) + 1
     return out
 
 

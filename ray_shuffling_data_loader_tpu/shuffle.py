@@ -255,6 +255,35 @@ def _status_end_trial(
             status["error"] = error[:300]
 
 
+def _ledger_record(
+    status: str,
+    duration_s: Optional[float] = None,
+    error: Optional[str] = None,
+    plan=None,
+    job_id: Optional[str] = None,
+    audit_verdicts=None,
+) -> None:
+    """Append this run's record to the durable run ledger
+    (telemetry/runledger.py). Check-then-import keeps the plane
+    zero-overhead with RSDL_RUN_LEDGER unset; a ledger failure never
+    changes the run's outcome (this sits on the failure paths too)."""
+    if not os.environ.get("RSDL_RUN_LEDGER"):
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import runledger
+
+        runledger.record_run(
+            status,
+            duration_s=duration_s,
+            error=error,
+            plan_label=_label_of_plan(plan) if plan is not None else None,
+            job_id=job_id,
+            audit_verdicts=audit_verdicts,
+        )
+    except Exception:
+        pass
+
+
 class BatchConsumer:
     """Interface for consumers of shuffle outputs (reference
     ``shuffle.py:11-43``)."""
@@ -4198,6 +4227,7 @@ def _shuffle_impl(
         _seed_decode_cache_from_journal(decode_cache, resume_state)
     start = timeit.default_timer()
     threads = []
+    audit_verdicts = None
     try:
         for epoch in range(start_epoch, num_epochs):
             if jmod is not None and jmod.suspend_requested():
@@ -4282,6 +4312,14 @@ def _shuffle_impl(
             )
             _metrics.safe_inc("recovery.suspended_runs")
             _status_end_trial(error="suspended", job=jid)
+            # Ledger record BEFORE the possible os._exit(0) below —
+            # a preempted run's partial-epoch telemetry is exactly
+            # what the post-hoc regression question needs.
+            _ledger_record(
+                "suspended",
+                duration_s=timeit.default_timer() - start,
+                plan=plan, job_id=jid,
+            )
             # No resume is in progress once the run is suspended: a
             # stuck gauge would page resume_stalled forever in an
             # embedding driver that catches RunSuspended and lives on.
@@ -4340,11 +4378,22 @@ def _shuffle_impl(
             "trial.failed", _flush=True,
             error=f"{type(exc).__name__}: {exc}"[:200],
         )
+        _ledger_record(
+            "failed",
+            duration_s=timeit.default_timer() - start,
+            error=f"{type(exc).__name__}: {exc}",
+            plan=plan, job_id=jid,
+            audit_verdicts=audit_verdicts,
+        )
         raise
     _status_end_trial(job=jid)
     duration = timeit.default_timer() - start
     telemetry.emit_event(
         "trial.done", duration_s=round(duration, 3), _flush=True
+    )
+    _ledger_record(
+        "done", duration_s=duration, plan=plan, job_id=jid,
+        audit_verdicts=audit_verdicts,
     )
     if stats_collector is not None:
         stats_collector.call_oneway("trial_done", duration)

@@ -749,16 +749,38 @@ def publish_metrics(full: Optional[Dict[str, Any]] = None) -> None:
         _published_pairs = pairs
         global _published_job_pairs
         job_pairs = set()
+        # Each tenant's share of the used shm budget: shm_used_frac
+        # scaled by the job's slice of total shm residency — the
+        # per-job capacity_near_limit signal (a tenant holding >90% of
+        # a near-full budget is the one to page).
+        frac = full.get("shm_used_frac")
+        shm_total = sum(
+            (tiers.get("shm") or {}).get("resident_bytes", 0)
+            for tiers in (full.get("jobs") or {}).values()
+        )
         for jid, tiers in (full.get("jobs") or {}).items():
             for tier, cell in tiers.items():
                 job_pairs.add((jid, tier))
                 reg.gauge(
                     "capacity.job_resident_bytes", job=jid, tier=tier
                 ).set(cell.get("resident_bytes", 0))
+            if frac is not None and shm_total > 0:
+                share = (
+                    (tiers.get("shm") or {}).get("resident_bytes", 0)
+                    / shm_total
+                )
+                reg.gauge("capacity.job_shm_frac", job=jid).set(
+                    round(float(frac) * share, 4)
+                )
         for jid, tier in _published_job_pairs - job_pairs:
             reg.gauge(
                 "capacity.job_resident_bytes", job=jid, tier=tier
             ).set(0)
+        for jid in (
+            {j for j, _t in _published_job_pairs}
+            - {j for j, _t in job_pairs}
+        ):
+            reg.gauge("capacity.job_shm_frac", job=jid).set(0)
         # rsdl-lint: disable=lock-discipline -- sampler-tick-private,
         # same as _published_pairs above
         _published_job_pairs = job_pairs

@@ -190,10 +190,13 @@ def load(
     since: Optional[float] = None,
     kind: Optional[str] = None,
     limit: Optional[int] = None,
+    job: Optional[str] = None,
 ) -> List[dict]:
     """Every event from the spool plus the local buffer, sorted by
     timestamp. ``since`` filters to ``ts >= since``; ``kind`` to exact
-    kind; ``limit`` keeps the *latest* N after filtering."""
+    kind; ``job`` to events stamped with that tenant's job id (the
+    ambient ``job_context`` field); ``limit`` keeps the *latest* N
+    after filtering."""
     out: List[dict] = []
     directory = spool_dir()
     if directory and os.path.isdir(directory):
@@ -221,6 +224,8 @@ def load(
         out = [r for r in out if float(r.get("ts", 0.0)) >= since]
     if kind is not None:
         out = [r for r in out if r.get("kind") == kind]
+    if job is not None:
+        out = [r for r in out if r.get("job") == job]
     out.sort(key=lambda r: float(r.get("ts", 0.0)))
     if limit is not None and limit >= 0:
         out = out[-limit:]

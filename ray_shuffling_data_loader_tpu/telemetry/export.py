@@ -76,14 +76,42 @@ def spool_dir() -> Optional[str]:
 def source_identity() -> Dict[str, Any]:
     """This process's identity on its spool record: the fault plane's
     process role (driver/task/actor — the same tag ``RSDL_FAULTS``
-    ``/role`` filters key on), hostname, and pid."""
+    ``/role`` filters key on), hostname, pid, and — when the service
+    plane is armed — the job this process is working for, so per-source
+    breakdowns attribute to tenants (ISSUE 16)."""
     try:
         from ray_shuffling_data_loader_tpu.runtime import faults
 
         role = faults.role()
     except Exception:
         role = "driver"
-    return {"role": role, "host": socket.gethostname(), "pid": os.getpid()}
+    ident: Dict[str, Any] = {
+        "role": role, "host": socket.gethostname(), "pid": os.getpid(),
+    }
+    job = _current_job_id()
+    if job:
+        ident["job"] = job
+    return ident
+
+
+def _current_job_id() -> Optional[str]:
+    """The service job id this process works for, if any: the driver's
+    ambient job context when the service plane is loaded and armed,
+    else the ``RSDL_JOB_ID`` handed to spawned workers/trainer ranks.
+    sys.modules only — identity stamping must never pull the service
+    plane in."""
+    import sys as _sys
+
+    svc = _sys.modules.get("ray_shuffling_data_loader_tpu.runtime.service")
+    if svc is not None:
+        try:
+            if svc.enabled():
+                job = svc.current_job()
+                if job is not None:
+                    return str(job.job_id)
+        except Exception:
+            pass
+    return os.environ.get("RSDL_JOB_ID") or None
 
 
 def _spool_path(directory: str, ident: Dict[str, Any]) -> str:
@@ -237,10 +265,14 @@ def _merge_entry(cur: Dict[str, Any], new: Dict[str, Any], ts: float) -> None:
             cur["_ts"] = ts
 
 
-def _with_source_label(key: str, source: str) -> str:
-    """Inject ``source=<source>`` into a canonical snapshot key, keeping
-    label order sorted (so the result matches :func:`.metrics.format_key`
-    output) and any labeled-histogram name suffix in place."""
+def _with_source_label(
+    key: str, source: str, job: Optional[str] = None
+) -> str:
+    """Inject ``source=<source>`` (and the source's ``job=`` identity,
+    when it has one and the key does not already carry a job label)
+    into a canonical snapshot key, keeping label order sorted (so the
+    result matches :func:`.metrics.format_key` output) and any
+    labeled-histogram name suffix in place."""
     brace, close = key.find("{"), key.rfind("}")
     if 0 <= brace < close:
         name, suffix = key[:brace], key[close + 1:]
@@ -249,8 +281,12 @@ def _with_source_label(key: str, source: str) -> str:
             for part in key[brace + 1:close].split(",")
         ]
         pairs.append(("source", source))
+        if job and all(k != "job" for k, _ in pairs):
+            pairs.append(("job", job))
         inner = ",".join(f"{k}={v}" for k, v in sorted(pairs))
         return f"{name}{{{inner}}}{suffix}"
+    if job:
+        return f"{key}{{job={job},source={source}}}"
     return f"{key}{{source={source}}}"
 
 
@@ -291,7 +327,10 @@ def aggregate_typed(
     me = source_identity()
 
     def fold(
-        typed: Dict[str, Dict[str, Any]], ts: float, source: Optional[str]
+        typed: Dict[str, Dict[str, Any]],
+        ts: float,
+        source: Optional[str],
+        job: Optional[str] = None,
     ) -> None:
         for key, entry in typed.items():
             cur = merged.get(key)
@@ -300,7 +339,7 @@ def aggregate_typed(
             else:
                 _merge_entry(cur, entry, ts)
             if per_source and source is not None:
-                skey = _with_source_label(key, source)
+                skey = _with_source_label(key, source, job=job)
                 merged[skey] = {**entry, "_ts": ts}
 
     for rec in load_records(max_age_s=max_age_s):
@@ -313,11 +352,17 @@ def aggregate_typed(
         ):
             continue  # the live registry below supersedes our own file
         label = f"{src.get('role', 'unknown')}-{src.get('pid', '0')}"
-        fold(rec.get("metrics", {}), float(rec.get("ts", 0.0)), label)
+        fold(
+            rec.get("metrics", {}), float(rec.get("ts", 0.0)), label,
+            job=src.get("job"),
+        )
     if include_local and _metrics.enabled():
         local = _metrics.registry.typed_snapshot()
         if local:
-            fold(local, time.time(), f"{me['role']}-{me['pid']}")
+            fold(
+                local, time.time(), f"{me['role']}-{me['pid']}",
+                job=me.get("job"),
+            )
     return merged
 
 

@@ -1,6 +1,6 @@
 """Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``,
 ``/timeseries``, ``/events``, ``/stragglers``, ``/capacity``,
-``/critical``, ``/alerts``.
+``/critical``, ``/alerts``, ``/jobs``.
 
 One stdlib ``http.server`` on a daemon thread inside the driver process,
 env-gated by ``RSDL_OBS_PORT`` — so a running shuffle can be *watched*
@@ -33,10 +33,13 @@ Endpoints:
   ring buffer, counter deltas already turned into rates. ``name``
   accepts either registry names (``shuffle.map_rows``) or their
   Prometheus aliases (``rsdl_shuffle_map_rows``); ``sources=1``
-  includes the per-source breakdown keys.
+  includes the per-source breakdown keys; ``job=<id>`` keeps only one
+  tenant's labeled keys (ISSUE 16).
 * ``GET /events?since=&kind=&limit=`` — the structured event log
   (:mod:`.events`): epoch starts, stage retries, recoveries,
   failovers, spills, producer deaths, evictions — newest last.
+  ``job=<id>`` filters to events stamped with that tenant's ambient
+  job id.
 * ``GET /stragglers`` — the full straggler/skew analysis
   (:mod:`.stragglers`): per-stage p99/median skew, slowest-host
   attribution, flagged outliers, and live wedged-worker flags.
@@ -49,8 +52,16 @@ Endpoints:
   shares, the current critical-path stage, stall-by-cause — the same
   interval math ``tools/epoch_report.py`` runs post-hoc.
 * ``GET /alerts`` — the SLO alert engine's state (:mod:`.slo`): every
-  rule's live state/value, active alerts, recent fire/resolve
+  rule's live state/value (one row per per-job instance for
+  tenant-scoped rules), active alerts, recent fire/resolve
   transitions.
+* ``GET /jobs`` — the fleet view (ISSUE 16): every tenant the session
+  knows about — service registry records (weight, pid-liveness,
+  decode-cache claims) folded with the live trial tracker's epoch
+  windows, per-job delivered bytes + current delivery rate, resident
+  store bytes, admission-wait totals, fair-share vtime lag, and the
+  SLO rules currently firing against the job. Works degraded without
+  the service plane: trial-tracker jobs still appear.
 
 **Status providers** are how subsystems publish live state without this
 module knowing about them: ``register_status_provider(name, fn)`` where
@@ -362,7 +373,164 @@ def _status_body() -> dict:
             }
     else:
         status["cluster"] = {"agents": [], "draining": [], "retired": []}
+    # Fleet rollup (ISSUE 16): a compact all-tenants line so a /status
+    # consumer sees EVERY running job, not just the newest one the
+    # top-level shuffle mirror tracks. The full per-tenant view is
+    # /jobs.
+    try:
+        fleet_jobs = _jobs_body()["jobs"]
+        status["fleet"] = {
+            "jobs": len(fleet_jobs),
+            "running": [
+                {
+                    "job_id": row.get("job_id"),
+                    "name": row.get("name"),
+                    "in_flight_epochs": row.get("in_flight_epochs"),
+                    "active_alerts": row.get("active_alerts"),
+                }
+                for row in fleet_jobs
+                if row.get("running")
+            ],
+        }
+    except Exception as exc:
+        status["fleet"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
     return status
+
+
+def _key_labels(key: str) -> Dict[str, str]:
+    """Label pairs of a flattened registry key (``name{k=v,...}`` or
+    ``name{k=v}_count``), {} for unlabeled keys."""
+    brace = key.find("{")
+    if brace < 0:
+        return {}
+    close = key.rfind("}")
+    if close < brace:
+        return {}
+    out: Dict[str, str] = {}
+    for part in key[brace + 1:close].split(","):
+        k, _, v = part.partition("=")
+        if k:
+            out[k] = v
+    return out
+
+
+def _base_of(key: str) -> str:
+    brace = key.find("{")
+    return key if brace < 0 else key[:brace]
+
+
+def _jobs_body() -> dict:
+    """The ``/jobs`` fleet view: one row per tenant, folded from the
+    service registry (when armed), the live trial tracker, the
+    aggregated registry's ``job=``-labeled series, and the SLO
+    engine's per-job instances."""
+    import sys as _sys
+
+    providers = _provider_snapshots()
+    flat = _export.aggregate(max_age_s=_stale_cutoff())
+    jobs: Dict[str, Dict[str, Any]] = {}
+
+    def entry(jid: str) -> Dict[str, Any]:
+        return jobs.setdefault(jid, {"job_id": jid})
+
+    service_mode = None
+    svc = _sys.modules.get("ray_shuffling_data_loader_tpu.runtime.service")
+    if svc is not None:
+        try:
+            if svc.enabled():
+                service_mode = svc.mode()
+                claims = svc.job_cache_claims()
+                for rec in svc.jobs_snapshot():
+                    jid = str(rec.get("job_id"))
+                    row = entry(jid)
+                    row["name"] = rec.get("name")
+                    row["weight"] = rec.get("weight")
+                    row["pid"] = rec.get("pid")
+                    row["created_ts"] = rec.get("created_ts")
+                    row["running"] = bool(svc._record_live(rec))
+                    row["cache_claims"] = claims.get(jid, 0)
+        except Exception:
+            pass
+    # The trial tracker: epoch windows + shape, including the
+    # single-job "_default" entry when the service plane is off.
+    shuffle_snap = providers.get("shuffle") or {}
+    tracked = shuffle_snap.get("jobs")
+    if not tracked and shuffle_snap.get("epochs") is not None:
+        tracked = {"_default": shuffle_snap}
+    for jid, snap in (tracked or {}).items():
+        row = entry(str(jid))
+        row.setdefault("running", bool(snap.get("running")))
+        for field in ("num_epochs", "num_files", "num_reducers",
+                      "num_trainers", "start_epoch", "started_ts",
+                      "ended_ts", "error"):
+            if snap.get(field) is not None:
+                row[field] = snap[field]
+        epochs = snap.get("epochs") or {}
+        row["in_flight_epochs"] = snap.get("in_flight_epochs") or []
+        row["epochs_done"] = sum(
+            1 for st in epochs.values() if st.get("state") == "done"
+        )
+    # job=-labeled registry series: delivered bytes, resident bytes,
+    # admission waits, fair-share lag.
+    for key, value in flat.items():
+        labels = _key_labels(key)
+        jid = labels.get("job")
+        if not jid or "source" in labels:
+            continue
+        base = _base_of(key)
+        row = entry(jid)
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            continue
+        if base == "service.delivered_bytes":
+            row["delivered_bytes"] = row.get("delivered_bytes", 0) \
+                + int(value)
+        elif base == "capacity.job_resident_bytes":
+            tier = labels.get("tier")
+            tiers = row.setdefault("resident_bytes", {})
+            tiers[tier or "all"] = tiers.get(tier or "all", 0) + int(value)
+        elif base == "service.dispatch_vtime_lag":
+            row["dispatch_vtime_lag"] = value
+        elif key.endswith("_count") \
+                and base.startswith("service.admission_wait_seconds"):
+            row.setdefault("admission", {})["waits"] = int(value)
+        elif key.endswith("_sum") \
+                and base.startswith("service.admission_wait_seconds"):
+            row.setdefault("admission", {})["wait_s"] = round(value, 3)
+    # Current delivery rate from the sampler ring (absent when no
+    # sampler runs — e.g. a driver without RSDL_TS_PERIOD_S).
+    try:
+        for key, points in _timeseries.series(
+            name="service.delivered_bytes", include_sources=False,
+        ).items():
+            jid = _key_labels(key).get("job")
+            if not jid or not points:
+                continue
+            rate = points[-1].get("rate")
+            if rate is not None:
+                entry(jid)["delivered_rate_bps"] = round(float(rate), 1)
+    except Exception:
+        pass
+    # The SLO engine's per-job instances (same process only).
+    try:
+        for jid, names in _slo.active_alerts_by_job().items():
+            entry(jid)["active_alerts"] = names
+    except Exception:
+        pass
+    for row in jobs.values():
+        row.setdefault("active_alerts", [])
+        row.setdefault("running", False)
+    order = sorted(
+        jobs,
+        key=lambda j: (float(jobs[j].get("created_ts")
+                             or jobs[j].get("started_ts") or 0.0), j),
+    )
+    return {
+        "ts": time.time(),
+        "service_mode": service_mode,
+        "jobs": [jobs[j] for j in order],
+    }
 
 
 def _qparam(params: Dict[str, list], name: str, cast, default=None):
@@ -382,14 +550,17 @@ def _timeseries_body(params: Dict[str, list]) -> dict:
     window_s = _qparam(params, "window", float)
     step_s = _qparam(params, "step", float)
     include_sources = bool(_qparam(params, "sources", int, 0))
+    job = _qparam(params, "job", str)
     series = _timeseries.series(
         name=name,
         window_s=window_s,
         step_s=step_s,
         include_sources=include_sources,
+        job=job,
     )
     return {
         "name": name,
+        "job": job,
         "window_s": window_s,
         "step_s": step_s,
         "period_s": _timeseries.period_s(),
@@ -403,10 +574,12 @@ def _events_body(params: Dict[str, list]) -> dict:
     since = _qparam(params, "since", float)
     kind = _qparam(params, "kind", str)
     limit = _qparam(params, "limit", int, 200)
-    records = _events.load(since=since, kind=kind, limit=limit)
+    job = _qparam(params, "job", str)
+    records = _events.load(since=since, kind=kind, limit=limit, job=job)
     return {
         "since": since,
         "kind": kind,
+        "job": job,
         "count": len(records),
         "by_kind": _events.counts(records),
         "events": records,
@@ -506,6 +679,14 @@ def _make_handler():
                         "application/json",
                         json.dumps(
                             _slo.alerts_body(), default=str
+                        ).encode(),
+                    )
+                elif path == "/jobs":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            _jobs_body(), default=str
                         ).encode(),
                     )
                 else:

@@ -18,6 +18,11 @@ paging pipeline both consume.
                                        # absence: staleness bound
      "for_s": 0,                       # condition must HOLD this long
      "only_in_flight": false,          # evaluate only mid-trial
+     "per_job": false,                 # expand per live tenant
+     "per_job_metric": null,           # per-job instances' metric
+                                       # (default: "metric")
+     "field": "rate",                  # rate rules: ring point field
+                                       # ("rate" | "window_mean")
      "severity": "warn"}               # free-form label
 
 * ``threshold`` — predicate over the *current aggregated value*
@@ -30,6 +35,18 @@ paging pipeline both consume.
   entirely, or (with ``window_s``) when the ring has no point for it
   within the window: the "the thing that should be reporting is not"
   predicate a dead producer or wedged spool shows up as.
+
+**Tenant scope (ISSUE 16).** A rule with ``per_job: true`` expands into
+one independent ok → pending → firing → resolved instance per *live
+job* each tick (the service registry when armed, the shuffle live-trial
+tracker otherwise, falling back to the ``job=`` labels present in the
+aggregate so external registries still work). Each instance evaluates
+``per_job_metric`` (default: the rule's ``metric``) restricted to that
+tenant's ``job=``-labeled series — one stalled tenant pages as
+``alert.active{rule,job}`` without dragging its neighbors into the
+blast radius, and its ``alert.fired``/``alert.resolved`` events carry
+the job id. With no live jobs a per-job rule degrades to the single
+global instance, so service-off runs behave exactly as before.
 
 **Sources.** ``RSDL_SLO_RULES`` is either inline JSON (a list of rule
 objects) or a path to a JSON rules file. User rules merge over the
@@ -82,10 +99,15 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {
         # No reducer produced a row for a sustained window while a
         # trial is mid-flight: the producer plane is stalled (dead
-        # producer, wedged window, exhausted retries).
+        # producer, wedged window, exhausted retries). Per-job
+        # instances watch each tenant's delivered-bytes counter (the
+        # deliver path stamps job= explicitly), so one tenant's stall
+        # pages that tenant alone.
         "name": "producer_stalled",
         "kind": "rate",
         "metric": "shuffle.reduce_rows",
+        "per_job": True,
+        "per_job_metric": "service.delivered_bytes",
         "op": "==", "value": 0.0,
         "window_s": 30.0, "for_s": 15.0,
         "only_in_flight": True,
@@ -95,10 +117,13 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         # Some consumer spent more than half its recent wall-clock
         # stalled (both causes summed within each source process;
         # "max-source" takes the worst consumer — a cluster-wide sum
-        # would scale with trainer count, not health).
+        # would scale with trainer count, not health). Per-job
+        # instances key on the spool's job-stamped source series, so a
+        # stalled tenant is named rather than averaged away.
         "name": "stall_over_budget",
         "kind": "rate",
         "metric": "stall_seconds",
+        "per_job": True,
         "fold": "max-source",
         "op": ">", "value": 0.5,
         "window_s": 60.0, "for_s": 10.0,
@@ -108,9 +133,14 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {
         # The shm tier is near its session budget: the next segments
         # spill to disk — the evictor's (ROADMAP 5) wake-up signal.
+        # Per-job instances watch each tenant's share of the used
+        # budget (capacity.job_shm_frac), so the tenant actually
+        # holding the memory is the one named.
         "name": "capacity_near_limit",
         "kind": "threshold",
         "metric": "capacity.shm_used_frac",
+        "per_job": True,
+        "per_job_metric": "capacity.job_shm_frac",
         "op": ">", "value": 0.9,
         "for_s": 0.0,
         "severity": "warn",
@@ -172,13 +202,51 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "for_s": 60.0,
         "severity": "page",
     },
+    {
+        # A tenant's epoch windows are spending a long time queued at
+        # the capacity admission gate (service.admit_epoch): the mean
+        # wait across its recent admissions is over budget. Windowed
+        # histogram mean — one historic spike does not page forever,
+        # and an idle tenant (no new admissions) resolves naturally.
+        "name": "admission_wait_long",
+        "kind": "rate",
+        "metric": "service.admission_wait_seconds",
+        "field": "window_mean",
+        "op": ">", "value": 5.0,
+        "window_s": 120.0, "for_s": 0.0,
+        "per_job": True,
+        "only_in_flight": True,
+        "severity": "warn",
+    },
+    {
+        # The fair-share dispatcher's virtual clock for this tenant
+        # trails the most-advanced active clock by a sustained margin
+        # while the tenant still has queued tasks: the job is starved
+        # (weight misconfiguration, or a neighbor monopolizing
+        # dispatch).
+        "name": "fair_share_starved",
+        "kind": "threshold",
+        "metric": "service.dispatch_vtime_lag",
+        "op": ">", "value": 8.0,
+        "for_s": 10.0,
+        "per_job": True,
+        "only_in_flight": True,
+        "severity": "warn",
+    },
 ]
 
 _HISTORY_CAP = 64
 
 _lock = threading.Lock()
 _rules_cache: Optional[List[Dict[str, Any]]] = None
+# Instance state, keyed by rule name for global instances and
+# ``"{rule}|{job}"`` for per-job ones (the instance's job id also lives
+# at state["job"]).
 _states: Dict[str, Dict[str, Any]] = {}
+# Lifetime fire counts per instance key — kept apart from _states so a
+# departed tenant's counts survive its instance cleanup (bench and the
+# run ledger read these at run end, after jobs have ended).
+_fired_totals: Dict[str, int] = {}
 _history: List[Dict[str, Any]] = []
 
 
@@ -189,6 +257,7 @@ def reset() -> None:
     with _lock:
         _rules_cache = None
         _states.clear()
+        _fired_totals.clear()
         _history.clear()
 
 
@@ -238,32 +307,66 @@ def rules() -> List[Dict[str, Any]]:
 # ---------------------------------------------------------------------------
 
 
+def _split_key(key: str) -> Tuple[str, Dict[str, str], str]:
+    """``(base, labels, suffix)`` of a flat aggregated key: labels
+    parsed from the ``{k=v,...}`` segment, ``suffix`` the flattened-
+    histogram component trailing the label block —
+    ``stall_seconds{cause=staging,source=t-1}`` →
+    ``("stall_seconds", {...}, "")``, ``h{job=a}_sum`` →
+    ``("h", {"job": "a"}, "_sum")``."""
+    brace, close = key.find("{"), key.rfind("}")
+    if not (0 <= brace < close):
+        return key, {}, ""
+    labels: Dict[str, str] = {}
+    for part in key[brace + 1:close].split(","):
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return key[:brace], labels, key[close + 1:]
+
+
 def _metric_matches(key: str, name: str) -> bool:
-    base = key.split("{", 1)[0]
-    if name in (key, base):
+    base, _labels, suffix = _split_key(key)
+    if name in (key, base, base + suffix):
         return True
-    # Accept the Prometheus alias so rules can use scrape names.
-    return name == _timeseries._prom_name(base)
+    # Accept the Prometheus alias so rules can use scrape names; with
+    # the suffix so a rule can pin one flattened-histogram component
+    # (rsdl_x_max) instead of summing all four.
+    if name == _timeseries._prom_name(base):
+        return True
+    return bool(suffix) and name == _timeseries._prom_name(base + suffix)
 
 
 def _aggregate_value(
-    name: str, flat: Optional[Dict[str, float]] = None
+    name: str,
+    flat: Optional[Dict[str, float]] = None,
+    job: Optional[str] = None,
 ) -> Optional[float]:
     """Sum of every aggregated key matching ``name`` (exact key, base
-    name, or rsdl_ alias); None when nothing matches. Per-source
-    breakdown keys are excluded — they would double-count."""
+    name, or rsdl_ alias); None when nothing matches. ``job`` keeps
+    only that tenant's ``job=``-labeled series. Per-source breakdown
+    keys are excluded (they would double-count the cluster-merged
+    series) — except as the fallback for a job filter, where a metric
+    may carry its tenant only through the spool's job-stamped source
+    keys and no merged ``job=`` series exists."""
     if flat is None:
         try:
-            flat = _export.aggregate()
+            flat = _export.aggregate(per_source=job is not None)
         except Exception:
             return None
     total: Optional[float] = None
+    from_sources: Optional[float] = None
     for key, value in flat.items():
-        if "source=" in key:
+        if not _metric_matches(key, name):
             continue
-        if _metric_matches(key, name):
-            total = (total or 0.0) + float(value)
-    return total
+        _base, labels, _suffix = _split_key(key)
+        if job is not None and labels.get("job") != job:
+            continue
+        if "source" in labels:
+            if job is not None:
+                from_sources = (from_sources or 0.0) + float(value)
+            continue
+        total = (total or 0.0) + float(value)
+    return total if total is not None else from_sources
 
 
 def _source_of(key: str) -> Optional[str]:
@@ -279,36 +382,66 @@ def _source_of(key: str) -> Optional[str]:
 
 def _window_rate(name: str, window_s: float,
                  now: Optional[float] = None,
-                 fold: str = "sum") -> Optional[float]:
-    """Mean per-second rate of ``name`` over the trailing window of
-    the ring. ``fold="sum"`` (default): per sample, matching keys'
-    rates sum cluster-wide, then samples average. ``fold="max-source"``:
-    the same mean computed per source process, returning the WORST
-    source — the right shape for share-of-wall-clock budgets like
-    stall seconds/second, where a cluster-wide sum scales with the
-    consumer count instead of measuring any one consumer's health.
-    None when the ring holds no rated point for the metric (unknown —
-    a rule must not fire on ignorance)."""
+                 fold: str = "sum",
+                 job: Optional[str] = None,
+                 field: str = "rate") -> Optional[float]:
+    """Mean of a ring point field for ``name`` over the trailing
+    window. ``fold="sum"`` (default): per sample, matching keys fold
+    cluster-wide, then samples average. ``fold="max-source"``: the same
+    mean computed per source process, returning the WORST source — the
+    right shape for share-of-wall-clock budgets like stall
+    seconds/second, where a cluster-wide sum scales with the consumer
+    count instead of measuring any one consumer's health. ``job``
+    keeps only that tenant's ``job=``-labeled series (merged series
+    preferred; job-stamped source series back-fill when none exist).
+    ``field`` picks the sampled point field: ``"rate"`` folds by sum,
+    anything else (``"window_mean"`` — a histogram's per-observation
+    mean over new observations) folds by max. None when the ring holds
+    no such point for the metric (unknown — a rule must not fire on
+    ignorance)."""
     per_source = fold == "max-source"
     series = _timeseries.series(
         name=name, window_s=window_s, now=now,
-        include_sources=per_source,
+        include_sources=per_source or job is not None,
+        job=job,
     )
-    # {group: {ts: summed rate}} — one group ("") for the cluster sum,
-    # one per source label otherwise.
-    groups: Dict[str, Dict[float, float]] = {}
+    # {group: {ts: folded value}} — merged keys under "", plus one
+    # group per source label.
+    base_groups: Dict[str, Dict[float, float]] = {}
+    src_groups: Dict[str, Dict[float, float]] = {}
     for key, points in series.items():
         src = _source_of(key)
-        if per_source:
-            if src is None:
-                continue  # cluster-merged key would double-count
-        elif src is not None:
-            continue
-        by_ts = groups.setdefault(src or "", {})
+        by_ts = (
+            src_groups.setdefault(src, {})
+            if src is not None
+            else base_groups.setdefault("", {})
+        )
         for p in points:
-            if "rate" in p:
-                ts = float(p["ts"])
-                by_ts[ts] = by_ts.get(ts, 0.0) + float(p["rate"])
+            if p.get(field) is None:
+                continue
+            ts = float(p["ts"])
+            val = float(p[field])
+            if field == "rate":
+                by_ts[ts] = by_ts.get(ts, 0.0) + val
+            else:
+                by_ts[ts] = max(by_ts.get(ts, val), val)
+    if per_source:
+        groups = src_groups
+    elif base_groups or job is None:
+        # Merged series win; without a job filter, source series are
+        # per-process copies of them and would double-count.
+        groups = base_groups
+    else:
+        # The job filter matched only job-stamped source series: fold
+        # them into one logical group so the tenant still gets a value.
+        merged: Dict[float, float] = {}
+        for by_ts in src_groups.values():
+            for ts, val in by_ts.items():
+                if field == "rate":
+                    merged[ts] = merged.get(ts, 0.0) + val
+                else:
+                    merged[ts] = max(merged.get(ts, val), val)
+        groups = {"": merged} if merged else {}
     means = [
         sum(by_ts.values()) / len(by_ts)
         for by_ts in groups.values()
@@ -320,41 +453,105 @@ def _window_rate(name: str, window_s: float,
 
 
 def _metric_fresh_in_ring(name: str, window_s: float,
-                          now: Optional[float] = None) -> bool:
-    series = _timeseries.series(name=name, window_s=window_s, now=now)
+                          now: Optional[float] = None,
+                          job: Optional[str] = None) -> bool:
+    series = _timeseries.series(
+        name=name, window_s=window_s, now=now,
+        include_sources=job is not None, job=job,
+    )
     return any(points for points in series.values())
 
 
-def _trial_in_flight() -> bool:
+def _trial_in_flight(job: Optional[str] = None) -> bool:
+    """Whether a shuffle trial is mid-flight — for ``job``, THAT
+    tenant's trial specifically (a registered-but-idle job must not
+    trip only_in_flight rules; a job this process cannot see stays
+    False rather than borrowing the global state)."""
     import sys as _sys
 
     shuffle_mod = _sys.modules.get("ray_shuffling_data_loader_tpu.shuffle")
     if shuffle_mod is None:
         return False
     try:
-        return bool(shuffle_mod.live_status().get("running"))
+        status = shuffle_mod.live_status()
+        if job is None:
+            return bool(status.get("running"))
+        jobs = status.get("jobs") or {}
+        if job in jobs:
+            return bool(jobs[job].get("running"))
+        return False
     except Exception:
         return False
+
+
+def _live_job_ids(flat: Dict[str, float]) -> List[str]:
+    """The tenant set a ``per_job`` rule expands over. The service
+    plane's liveness-checked registry wins when armed; the shuffle
+    live-trial tracker is next; with neither loaded (unit tests,
+    external metric registries) the ``job=`` labels present in the
+    aggregate. Empty means "no tenants": per-job rules degrade to
+    their global instance."""
+    import sys as _sys
+
+    svc = _sys.modules.get("ray_shuffling_data_loader_tpu.runtime.service")
+    if svc is not None:
+        try:
+            if svc.enabled():
+                return sorted(
+                    str(rec.get("job_id"))
+                    for rec in svc.jobs_snapshot()
+                    if rec.get("job_id") and svc._record_live(rec)
+                )
+        except Exception:
+            pass
+    shuffle_mod = _sys.modules.get("ray_shuffling_data_loader_tpu.shuffle")
+    if shuffle_mod is not None:
+        try:
+            jobs = shuffle_mod.live_status().get("jobs") or {}
+            ids = sorted(
+                j for j, st in jobs.items()
+                if st.get("running") and j != "_default"
+            )
+            if ids:
+                return ids
+        except Exception:
+            pass
+    ids = set()
+    for key, value in flat.items():
+        base, labels, _suffix = _split_key(key)
+        if base.startswith("alert."):
+            continue  # our own job-labeled gauges must not keep a
+            # departed tenant alive
+        jid = labels.get("job")
+        if jid and "source" not in labels and value:
+            ids.add(jid)
+    return sorted(ids)
 
 
 def _condition(
     rule: Dict[str, Any],
     flat: Optional[Dict[str, float]],
     now: float,
+    job: Optional[str] = None,
 ) -> Tuple[Optional[bool], Optional[float]]:
-    """(condition, observed value) for one rule; condition None means
-    "unknown" (no data) — treated as not-firing for threshold/rate."""
+    """(condition, observed value) for one rule instance; condition
+    None means "unknown" (no data) — treated as not-firing for
+    threshold/rate. A per-job instance evaluates ``per_job_metric``
+    (default: the rule's ``metric``) restricted to that tenant."""
     kind = str(rule.get("kind", "threshold"))
-    metric = str(rule.get("metric", ""))
+    if job is not None:
+        metric = str(rule.get("per_job_metric") or rule.get("metric", ""))
+    else:
+        metric = str(rule.get("metric", ""))
     op = _OPS.get(str(rule.get("op", ">")))
     target = float(rule.get("value", 0.0))
     if kind == "absence":
         window_s = rule.get("window_s")
-        value = _aggregate_value(metric, flat)
+        value = _aggregate_value(metric, flat, job=job)
         if value is None:
             return True, None
         if window_s and not _metric_fresh_in_ring(
-            metric, float(window_s), now=now
+            metric, float(window_s), now=now, job=job
         ):
             return True, value
         return False, value
@@ -364,11 +561,13 @@ def _condition(
         rate = _window_rate(
             metric, float(rule.get("window_s", 60.0)), now=now,
             fold=str(rule.get("fold", "sum")),
+            job=job,
+            field=str(rule.get("field", "rate")),
         )
         if rate is None:
             return None, None
         return op(rate, target), rate
-    value = _aggregate_value(metric, flat)
+    value = _aggregate_value(metric, flat, job=job)
     if value is None:
         return None, None
     return op(value, target), value
@@ -383,10 +582,15 @@ def _rule_row(rule: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, Any]:
     """The one ``/alerts`` row shape — shared by :func:`evaluate` and
     :func:`alerts_body` so the page served mid-tick and between ticks
     cannot drift."""
+    job = state.get("job")
+    metric = rule.get("metric")
+    if job is not None:
+        metric = rule.get("per_job_metric") or metric
     return {
         "name": str(rule["name"]),
         "kind": rule.get("kind", "threshold"),
-        "metric": rule.get("metric"),
+        "metric": metric,
+        "job": job,
         "op": rule.get("op"),
         "threshold": rule.get("value"),
         "severity": rule.get("severity", "warn"),
@@ -400,18 +604,31 @@ def _rule_row(rule: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _active_name(row: Dict[str, Any]) -> str:
+    """The ``active`` list entry: the rule name, instance-qualified
+    (``rule|job``) for per-job instances."""
+    job = row.get("job")
+    return f"{row['name']}|{job}" if job else str(row["name"])
+
+
 def _emit(kind: str, rule: Dict[str, Any], state: Dict[str, Any]) -> None:
     try:
         from ray_shuffling_data_loader_tpu import telemetry as _t
 
+        metric = rule.get("metric")
+        extra: Dict[str, Any] = {}
+        if state.get("job"):
+            extra["job"] = state["job"]
+            metric = rule.get("per_job_metric") or metric
         _t.emit_event(
             kind,
             _flush=True,
             rule=rule["name"],
             severity=rule.get("severity", "warn"),
-            metric=rule.get("metric"),
+            metric=metric,
             value=state.get("value"),
             threshold=rule.get("value"),
+            **extra,
         )
     except Exception:
         pass
@@ -420,78 +637,139 @@ def _emit(kind: str, rule: Dict[str, Any], state: Dict[str, Any]) -> None:
 def evaluate(now: Optional[float] = None) -> Dict[str, Any]:
     """One engine tick: evaluate every rule against the aggregated
     registry + timeseries ring, advance the ok → pending → firing →
-    resolved state machine, emit fire/resolve events + gauges. Called
-    by the sampler tick; returns the ``/alerts`` body. Never raises."""
+    resolved state machine, emit fire/resolve events + gauges. A
+    ``per_job`` rule expands into one independent instance per live
+    job (state key ``rule|job``, gauge ``alert.active{rule,job}``,
+    job-stamped events); with no live jobs it degrades to the single
+    global instance. Called by the sampler tick; returns the
+    ``/alerts`` body. Never raises."""
     now = time.time() if now is None else float(now)
     try:
-        flat = _export.aggregate()
+        flat = _export.aggregate(per_source=True)
     except Exception:
         flat = {}
     in_flight = _trial_in_flight()
+    jobs = _live_job_ids(flat)
     reg = _metrics.registry if _metrics.enabled() else None
     rows: List[Dict[str, Any]] = []
+    seen_keys = set()
     for rule in rules():
         name = str(rule["name"])
-        with _lock:
-            state = _states.setdefault(
-                name, {"state": "ok", "since": None, "fired_count": 0}
-            )
-        try:
-            if rule.get("only_in_flight") and not in_flight:
-                cond, value = False, None
-            else:
-                cond, value = _condition(rule, flat, now)
-        except Exception:
-            cond, value = None, None
-        with _lock:
-            state["value"] = value
-            for_s = float(rule.get("for_s", 0.0))
-            st = state["state"]
-            if cond:
-                if st == "ok":
-                    state["state"] = "pending"
-                    state["since"] = now
-                    st = "pending"
-                if st == "pending" and now - state["since"] >= for_s:
-                    state["state"] = "firing"
-                    state["fired_ts"] = now
-                    state["fired_count"] += 1
-                    _history.append(
-                        {"ts": now, "rule": name, "event": "fired",
-                         "value": value}
-                    )
-                    del _history[:-_HISTORY_CAP]
-                    _emit("alert.fired", rule, state)
-                    if reg is not None:
-                        reg.counter("alert.fired_total", rule=name).inc()
-            else:
-                if st == "firing":
-                    state["state"] = "ok"
-                    state["since"] = None
-                    state["resolved_ts"] = now
-                    _history.append(
-                        {"ts": now, "rule": name, "event": "resolved",
-                         "value": value}
-                    )
-                    del _history[:-_HISTORY_CAP]
-                    _emit("alert.resolved", rule, state)
-                elif st == "pending":
-                    state["state"] = "ok"
-                    state["since"] = None
-            if reg is not None:
-                reg.gauge("alert.active", rule=name).set(
-                    1.0 if state["state"] == "firing" else 0.0
+        if rule.get("per_job") and jobs:
+            instances: List[Tuple[str, Optional[str]]] = [
+                (f"{name}|{j}", j) for j in jobs
+            ]
+        else:
+            instances = [(name, None)]
+        for skey, job in instances:
+            seen_keys.add(skey)
+            with _lock:
+                state = _states.setdefault(
+                    skey, {"state": "ok", "since": None, "fired_count": 0}
                 )
-            rows.append(_rule_row(rule, state))
+                if job is not None:
+                    state["job"] = job
+            try:
+                if rule.get("only_in_flight") and not (
+                    in_flight if job is None else _trial_in_flight(job)
+                ):
+                    cond, value = False, None
+                else:
+                    cond, value = _condition(rule, flat, now, job=job)
+            except Exception:
+                cond, value = None, None
+            labels = {"rule": name}
+            if job is not None:
+                labels["job"] = job
+            with _lock:
+                state["value"] = value
+                for_s = float(rule.get("for_s", 0.0))
+                st = state["state"]
+                if cond:
+                    if st == "ok":
+                        state["state"] = "pending"
+                        state["since"] = now
+                        st = "pending"
+                    if st == "pending" and now - state["since"] >= for_s:
+                        state["state"] = "firing"
+                        state["fired_ts"] = now
+                        state["fired_count"] += 1
+                        _fired_totals[skey] = _fired_totals.get(skey, 0) + 1
+                        entry = {"ts": now, "rule": name, "event": "fired",
+                                 "value": value}
+                        if job is not None:
+                            entry["job"] = job
+                        _history.append(entry)
+                        del _history[:-_HISTORY_CAP]
+                        _emit("alert.fired", rule, state)
+                        if reg is not None:
+                            reg.counter("alert.fired_total", **labels).inc()
+                else:
+                    if st == "firing":
+                        state["state"] = "ok"
+                        state["since"] = None
+                        state["resolved_ts"] = now
+                        entry = {"ts": now, "rule": name,
+                                 "event": "resolved", "value": value}
+                        if job is not None:
+                            entry["job"] = job
+                        _history.append(entry)
+                        del _history[:-_HISTORY_CAP]
+                        _emit("alert.resolved", rule, state)
+                    elif st == "pending":
+                        state["state"] = "ok"
+                        state["since"] = None
+                if reg is not None:
+                    reg.gauge("alert.active", **labels).set(
+                        1.0 if state["state"] == "firing" else 0.0
+                    )
+                rows.append(_rule_row(rule, state))
+    _drop_stale_instances(seen_keys, now, reg)
     with _lock:
         history = list(_history)
     return {
         "ts": now,
         "trial_in_flight": in_flight,
+        "jobs": jobs,
         "rules": rows,
-        "active": [r["name"] for r in rows if r["active"]],
+        "active": [_active_name(r) for r in rows if r["active"]],
         "history": history,
     }
+
+
+def _drop_stale_instances(seen_keys, now, reg) -> None:
+    """Retire state for instances the tick no longer evaluates — a
+    per-job instance whose tenant left the live set, or a global
+    instance superseded by per-job expansion. A firing one resolves on
+    the way out (gauge to 0, event emitted): a departed tenant must
+    not hold a page open forever. Lifetime fire counts survive in
+    ``_fired_totals``."""
+    with _lock:
+        stale = [(k, _states.pop(k)) for k in list(_states)
+                 if k not in seen_keys]
+    by_name = {str(r["name"]): r for r in rules()}
+    for key, state in stale:
+        rname = key.split("|", 1)[0]
+        labels = {"rule": rname}
+        if state.get("job"):
+            labels["job"] = state["job"]
+        if state.get("state") == "firing":
+            state["state"] = "ok"
+            state["resolved_ts"] = now
+            entry = {"ts": now, "rule": rname, "event": "resolved",
+                     "value": state.get("value")}
+            if state.get("job"):
+                entry["job"] = state["job"]
+            with _lock:
+                _history.append(entry)
+                del _history[:-_HISTORY_CAP]
+            _emit("alert.resolved", by_name.get(rname, {"name": rname}),
+                  state)
+        if reg is not None:
+            try:
+                reg.gauge("alert.active", **labels).set(0.0)
+            except Exception:
+                pass
 
 
 def alerts_body() -> Dict[str, Any]:
@@ -505,26 +783,42 @@ def alerts_body() -> Dict[str, Any]:
         return evaluate()
     rows: List[Dict[str, Any]] = []
     for rule in rules():
+        name = str(rule["name"])
         with _lock:
-            state = dict(_states.get(str(rule["name"])) or {})
-        rows.append(_rule_row(rule, state))
+            keys = sorted(
+                k for k in _states
+                if k == name or k.startswith(name + "|")
+            ) or [name]
+            states = [dict(_states.get(k) or {}) for k in keys]
+        for state in states:
+            rows.append(_rule_row(rule, state))
     return {
         "ts": time.time(),
         "rules": rows,
-        "active": [r["name"] for r in rows if r["active"]],
+        "active": [_active_name(r) for r in rows if r["active"]],
         "history": history,
     }
 
 
 def fired_counts() -> Dict[str, int]:
-    """``{rule: times fired}`` over this engine's lifetime — what
-    ``bench.py`` embeds in ``telemetry_final``."""
+    """``{rule or rule|job: times fired}`` over this engine's lifetime
+    (kept apart from instance state, so a departed tenant's counts
+    survive its cleanup) — what ``bench.py`` embeds in
+    ``telemetry_final`` and the run ledger records."""
     with _lock:
-        return {
-            name: int(state.get("fired_count", 0))
-            for name, state in _states.items()
-            if state.get("fired_count")
-        }
+        return {key: int(n) for key, n in _fired_totals.items() if n}
+
+
+def active_alerts_by_job() -> Dict[str, List[str]]:
+    """``{job_id: [firing rule names]}`` over the per-job instances —
+    the fleet view's (``/jobs``) alert column."""
+    out: Dict[str, List[str]] = {}
+    with _lock:
+        for key, state in _states.items():
+            job = state.get("job")
+            if job and state.get("state") == "firing":
+                out.setdefault(job, []).append(key.split("|", 1)[0])
+    return {job: sorted(names) for job, names in out.items()}
 
 
 def status_section() -> Dict[str, Any]:

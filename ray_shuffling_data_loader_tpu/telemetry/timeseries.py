@@ -266,18 +266,33 @@ def _key_matches(key: str, name: Optional[str]) -> bool:
     return name == base or name == _prom_name(base) or name == key
 
 
+def _key_label(key: str, label: str) -> Optional[str]:
+    """The value of one label in a ``name{k=v,...}`` key, else None."""
+    brace, close = key.find("{"), key.rfind("}")
+    if not (0 <= brace < close):
+        return None
+    for part in key[brace + 1:close].split(","):
+        k, _, v = part.partition("=")
+        if k == label:
+            return v
+    return None
+
+
 def series(
     name: Optional[str] = None,
     window_s: Optional[float] = None,
     step_s: Optional[float] = None,
     include_sources: bool = False,
     now: Optional[float] = None,
+    job: Optional[str] = None,
 ) -> Dict[str, List[dict]]:
     """Per-key point lists from the ring: ``{key: [{"ts", "value",
     "rate", ...}, ...]}``. ``name`` matches the registry key base name
     OR its Prometheus alias (``shuffle.map_rows`` ==
     ``rsdl_shuffle_map_rows``); ``window_s`` keeps the trailing
-    window; ``step_s`` downsamples to at most one point per step.
+    window; ``step_s`` downsamples to at most one point per step;
+    ``job`` keeps only that tenant's ``job=``-labeled keys (the
+    ``/timeseries?job=`` fleet filter).
     ``source=``-labeled per-source keys are excluded unless asked for
     (they multiply the payload by the process count)."""
     now = time.time() if now is None else float(now)
@@ -292,6 +307,8 @@ def series(
             if not include_sources and "source=" in key:
                 continue
             if not _key_matches(key, name):
+                continue
+            if job is not None and _key_label(key, "job") != job:
                 continue
             if step_s and key in last_kept and (
                 ts - last_kept[key] < float(step_s)

@@ -201,7 +201,23 @@ if [ "$tier" != "slow" ]; then
   # SLO rule must FIRE and RESOLVE on /alerts (both transitions event-
   # logged), and /events must carry the full epoch lifecycle afterwards
   # (tools/obs_smoke.py asserts all of it; its exit code is the gate).
+  # The fleet plane rides along (ISSUE 16): the smoke arms the service
+  # plane, so /jobs must list the running tenant mid-flight and the
+  # job=-filtered /events must return the tenant's stamped events (and
+  # nothing for a bogus id).
   RSDL_METRICS=1 python tools/obs_smoke.py
+  # Run-ledger regression gate (ISSUE 16), gated BOTH ways against the
+  # committed fixture pair: the clean base..head must exit 0, the
+  # fixture with an injected throughput drop + stall rise must exit
+  # non-zero.
+  python tools/run_ledger.py \
+    --ledger tests/fixtures/run_ledger/clean.ndjson --regress 0..1
+  if python tools/run_ledger.py \
+    --ledger tests/fixtures/run_ledger/regressed.ndjson \
+    --regress 0..1 > /dev/null; then
+    echo "run_ledger --regress failed to flag the regressed fixture" >&2
+    exit 1
+  fi
   # TCP-plane lane (ISSUE 5/6): the two-process loopback "two-host"
   # bench at a small shape — a worker host joins over real TCP (own shm
   # dir), the windowed-fetch microbench runs all framings (legacy

@@ -1,0 +1,350 @@
+"""Durable run-ledger tests (ISSUE 16): gate + path resolution,
+append/read roundtrip (torn lines skipped), record building with the
+telemetry-derived sections present exactly when their planes are armed,
+the ``tools/run_ledger.py`` CLI (list/show/diff and the ``--regress``
+CI gate, both directions, on the checked-in fixtures), the shuffle()
+integration (one record per run, plan + shape stamped), and the
+zero-overhead-off contract (a fresh interpreter running a shuffle with
+the gate unset never imports the plane and writes no file)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.telemetry import runledger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "fixtures", "run_ledger")
+_CLI = os.path.join(_REPO, "tools", "run_ledger.py")
+
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, _CLI, *argv],
+        capture_output=True, text=True, timeout=60, cwd=_REPO,
+        env={**os.environ, "PYTHONPATH": _REPO},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Gate + path resolution
+# ---------------------------------------------------------------------------
+
+
+def test_gate_and_path_resolution(monkeypatch, tmp_path):
+    for off in (None, "", "0", "off", "false", "no", "OFF"):
+        if off is None:
+            monkeypatch.delenv("RSDL_RUN_LEDGER", raising=False)
+        else:
+            monkeypatch.setenv("RSDL_RUN_LEDGER", off)
+        assert not runledger.enabled()
+        assert runledger.ledger_path() is None
+    # Auto values resolve under the runtime dir (session-scoped).
+    monkeypatch.setenv("RSDL_RUN_LEDGER", "auto")
+    monkeypatch.setenv("RSDL_RUNTIME_DIR", str(tmp_path / "rt"))
+    assert runledger.enabled()
+    assert runledger.ledger_path() == str(
+        tmp_path / "rt" / "runs" / "ledger.ndjson"
+    )
+    monkeypatch.delenv("RSDL_RUNTIME_DIR")
+    assert runledger.ledger_path() == os.path.join(
+        ".", "runs", "ledger.ndjson"
+    )
+    # Anything else is the explicit, durable path.
+    explicit = tmp_path / "durable.ndjson"
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(explicit))
+    assert runledger.enabled()
+    assert runledger.ledger_path() == str(explicit)
+
+
+def test_record_run_off_is_noop(monkeypatch, tmp_path):
+    monkeypatch.delenv("RSDL_RUN_LEDGER", raising=False)
+    monkeypatch.setenv("RSDL_RUNTIME_DIR", str(tmp_path))
+    assert runledger.record_run("done") is None
+    assert not (tmp_path / "runs").exists()
+
+
+# ---------------------------------------------------------------------------
+# Append/read roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_append_read_roundtrip(monkeypatch, tmp_path):
+    path = tmp_path / "runs" / "ledger.ndjson"  # parent auto-created
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(path))
+    rid1 = runledger.append_record({"id": "run-aaa-1", "status": "done"})
+    rid2 = runledger.record_run("failed", error="boom", kind="bench")
+    assert rid1 == "run-aaa-1" and rid2
+    # A torn trailing line (crash mid-write) must not poison the read.
+    with open(path, "a") as f:
+        f.write('{"id": "run-torn')
+    records = runledger.read(str(path))
+    assert [r["id"] for r in records] == [rid1, rid2]
+    assert records[1]["status"] == "failed"
+    assert records[1]["error"] == "boom"
+    assert records[1]["kind"] == "bench"
+    assert records[1]["knobs"]["RSDL_RUN_LEDGER"] == str(path)
+
+
+def test_concurrent_appends_interleave_whole_lines(monkeypatch, tmp_path):
+    path = tmp_path / "ledger.ndjson"
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(path))
+    payload = {"blob": "x" * 4096}
+
+    def spam(tag):
+        for i in range(20):
+            runledger.append_record(
+                {"id": f"run-{tag}-{i}", "status": "done", **payload}
+            )
+
+    threads = [
+        threading.Thread(target=spam, args=(t,)) for t in ("a", "b", "c")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    records = runledger.read(str(path))
+    assert len(records) == 60  # no torn/interleaved lines lost
+    assert len({r["id"] for r in records}) == 60
+
+
+# ---------------------------------------------------------------------------
+# Record building
+# ---------------------------------------------------------------------------
+
+
+def test_build_record_harvests_armed_planes(monkeypatch, tmp_path):
+    from ray_shuffling_data_loader_tpu.telemetry import events, metrics, slo
+
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(tmp_path / "l.ndjson"))
+    monkeypatch.setenv("RSDL_METRICS", "1")
+    monkeypatch.setenv("RSDL_METRICS_DIR", str(tmp_path / "spool"))
+    metrics.refresh_from_env()
+    metrics.reset()
+    events.reset()
+    slo.reset()
+    try:
+        metrics.registry.counter(
+            "service.delivered_bytes", job="j-1"
+        ).inc(1000)
+        metrics.registry.counter("stall_seconds", cause="upstream").inc(2.5)
+        metrics.registry.counter("stall_seconds", cause="staging").inc(1.5)
+        rec = runledger.build_record(
+            "done",
+            duration_s=10.0,
+            plan_label="rowwise",
+            job_id="j-1",
+            audit_verdicts=[{"epoch": 0, "ok": True}],
+            extra={"bench": {"metric": "tp"}},
+        )
+        assert rec["status"] == "done" and rec["kind"] == "shuffle"
+        assert rec["id"].startswith("run-")
+        assert rec["pid"] == os.getpid()
+        assert rec["duration_s"] == 10.0
+        assert rec["plan"] == "rowwise"
+        assert rec["job"] == {"id": "j-1", "name": None}
+        assert rec["throughput"]["delivered_bytes"] == 1000
+        assert rec["throughput"]["bytes_per_s"] == 100.0
+        assert rec["stall_by_cause"] == {"staging": 1.5, "upstream": 2.5}
+        assert rec["audit"] == {
+            "ok": True, "verdicts": [{"epoch": 0, "ok": True}],
+        }
+        assert rec["bench"] == {"metric": "tp"}  # extra merged top-level
+        assert rec["knobs"]["RSDL_METRICS"] == "1"
+        assert "alerts_fired" not in rec  # nothing fired
+        # One failing verdict folds the audit section to ok=False.
+        rec = runledger.build_record(
+            "done", audit_verdicts=[{"ok": True}, {"ok": False}],
+        )
+        assert rec["audit"]["ok"] is False
+    finally:
+        metrics.reset()
+        events.reset()
+        slo.reset()
+        monkeypatch.undo()
+        metrics.refresh_from_env()
+
+
+def test_build_record_dark_planes_degrade(monkeypatch, tmp_path):
+    """Metrics off: the record still carries identity + outcome, with
+    every telemetry-derived section absent rather than empty."""
+    from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(tmp_path / "l.ndjson"))
+    monkeypatch.delenv("RSDL_METRICS", raising=False)
+    metrics.refresh_from_env()
+    try:
+        rec = runledger.build_record("failed", error="x" * 500)
+        assert rec["status"] == "failed"
+        assert len(rec["error"]) == 300  # clipped
+        for section in ("throughput", "stall_by_cause", "epochs",
+                        "critical", "capacity", "alerts_fired", "audit"):
+            assert section not in rec, section
+    finally:
+        monkeypatch.undo()
+        metrics.refresh_from_env()
+
+
+# ---------------------------------------------------------------------------
+# CLI: list / show / diff / --regress (both directions)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_and_show():
+    clean = os.path.join(_FIXTURES, "clean.ndjson")
+    out = _cli("--ledger", clean, "list")
+    assert out.returncode == 0, out.stderr
+    assert "run-18f2a3b4c00-4242" in out.stdout
+    assert out.stdout.strip().count("\n") == 1  # two records, one line each
+    out = _cli("--ledger", clean, "show", "run-18f2a4")  # unique prefix
+    assert out.returncode == 0, out.stderr
+    rec = json.loads(out.stdout)
+    assert rec["id"] == "run-18f2a4c5d00-4243"
+    out = _cli("--ledger", clean, "show", "-1")  # newest by index
+    assert json.loads(out.stdout)["id"] == "run-18f2a4c5d00-4243"
+    out = _cli("--ledger", clean, "show", "run-nope")
+    assert out.returncode == 3
+
+
+def test_cli_diff_names_changed_fields():
+    regressed = os.path.join(_FIXTURES, "regressed.ndjson")
+    out = _cli("--ledger", regressed, "diff", "0", "1")
+    assert out.returncode == 0, out.stderr
+    assert "throughput" in out.stdout
+    assert "stall[spill]" in out.stdout
+    assert "knob RSDL_STORE_CAPACITY_FRACTION" in out.stdout
+
+
+def test_cli_regress_gate_both_ways(tmp_path):
+    clean = os.path.join(_FIXTURES, "clean.ndjson")
+    regressed = os.path.join(_FIXTURES, "regressed.ndjson")
+    out = _cli("--ledger", clean, "--regress", "0..1")
+    assert out.returncode == 0, out.stdout + out.stderr
+    out = _cli("--ledger", regressed, "--regress", "0..1")
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSION" in out.stdout
+    assert "throughput dropped" in out.stdout
+    assert "stall seconds rose" in out.stdout
+    # Exit 3 ("nothing to compare") stays distinct from exit 1.
+    out = _cli("--ledger", clean, "--regress", "0..run-nope")
+    assert out.returncode == 3
+    empty = tmp_path / "empty.ndjson"
+    empty.write_text("")
+    out = _cli("--ledger", str(empty), "--regress", "0..1")
+    assert out.returncode == 3
+    # A failed head over a done base is a regression by itself.
+    failed = tmp_path / "failed.ndjson"
+    with open(clean) as f:
+        base_line = f.readline()
+    head = json.loads(base_line)
+    head.update(id="run-ffff-1", status="failed")
+    head.pop("throughput", None)
+    failed.write_text(base_line + json.dumps(head) + "\n")
+    out = _cli("--ledger", str(failed), "--regress", "0..1")
+    assert out.returncode == 1
+    assert "head run failed" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# shuffle() integration: one record per run
+# ---------------------------------------------------------------------------
+
+
+def test_shuffle_run_appends_one_record(monkeypatch, tmp_path):
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import (
+        BatchConsumer,
+        shuffle,
+    )
+
+    ledger = tmp_path / "ledger.ndjson"
+    monkeypatch.setenv("RSDL_RUN_LEDGER", str(ledger))
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    files = [generate_file(0, 0, 256, 1, str(data_dir))[0]]
+
+    class _Consumer(BatchConsumer):
+        def consume(self, rank, epoch, batches):
+            pass
+
+        def producer_done(self, rank, epoch):
+            pass
+
+        def wait_until_ready(self, epoch):
+            pass
+
+        def wait_until_all_epochs_done(self):
+            pass
+
+    runtime.init(num_workers=1)
+    try:
+        shuffle(
+            files, _Consumer(), num_epochs=1, num_reducers=2,
+            num_trainers=1, seed=5,
+        )
+    finally:
+        runtime.shutdown()
+    records = runledger.read(str(ledger))
+    assert len(records) == 1, records
+    rec = records[0]
+    assert rec["kind"] == "shuffle"
+    assert rec["status"] == "done"
+    assert rec["duration_s"] > 0
+    assert rec["plan"]  # the resolved plan family is stamped
+    assert rec["run"]["num_epochs"] == 1
+    assert rec["run"]["num_reducers"] == 2
+    assert rec["knobs"]["RSDL_RUN_LEDGER"] == str(ledger)
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_ledger_off_never_imports_plane(tmp_path):
+    """RSDL_RUN_LEDGER unset: a fresh interpreter running a whole
+    shuffle never loads the runledger module and creates no runs/
+    directory anywhere under its cwd."""
+    code = """
+import os, sys
+for k in list(os.environ):
+    if k.startswith("RSDL_"):
+        del os.environ[k]
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+class C(BatchConsumer):
+    def consume(self, rank, epoch, batches): pass
+    def producer_done(self, rank, epoch): pass
+    def wait_until_ready(self, epoch): pass
+    def wait_until_all_epochs_done(self): pass
+
+files = [generate_file(0, 0, 128, 1, os.getcwd())[0]]
+runtime.init(num_workers=1)
+shuffle(files, C(), num_epochs=1, num_reducers=1, num_trainers=1, seed=1)
+runtime.shutdown()
+assert (
+    "ray_shuffling_data_loader_tpu.telemetry.runledger" not in sys.modules
+), "run ledger imported on a ledger-off run"
+assert not os.path.exists("runs"), "ledger file created while off"
+print("LEDGER_ZERO_OVERHEAD_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": _REPO},
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "LEDGER_ZERO_OVERHEAD_OK" in out.stdout

@@ -311,6 +311,134 @@ def test_headroom_low_and_drain_stuck_fire_and_resolve(slo_env, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Tenant-scoped instances (ISSUE 16): per_job expansion
+# ---------------------------------------------------------------------------
+
+
+def test_per_job_rule_fires_only_the_stalled_tenant(slo_env):
+    """A ``per_job`` threshold rule expands over the ``job=`` labels in
+    the aggregate: the tenant over the bound fires ``rule|job`` (a
+    job-labeled ``alert.active`` gauge plus job-stamped events) while
+    its neighbor stays ok; recovering in place resolves that instance
+    alone."""
+    _rule(name="deep", kind="threshold", metric="q.depth", op=">",
+          value=5, per_job=True)
+    metrics.registry.gauge("q.depth", job="a").set(10)
+    metrics.registry.gauge("q.depth", job="b").set(1)
+    out = slo.evaluate(now=100.0)
+    assert out["jobs"] == ["a", "b"]
+    assert out["active"] == ["deep|a"]
+    rows = {r["job"]: r for r in out["rules"] if r["name"] == "deep"}
+    assert rows["a"]["active"] and rows["a"]["value"] == 10.0
+    assert rows["b"]["state"] == "ok" and rows["b"]["value"] == 1.0
+    snap = metrics.registry.snapshot()
+    assert snap["alert.active{job=a,rule=deep}"] == 1.0
+    assert snap["alert.active{job=b,rule=deep}"] == 0.0
+    fired = _alert_events("alert.fired")
+    assert fired and fired[-1]["rule"] == "deep"
+    assert fired[-1]["job"] == "a"
+    assert slo.active_alerts_by_job() == {"a": ["deep"]}
+    # a recovers (series still live, back under the bound): in-place
+    # resolve, b untouched the whole time.
+    metrics.registry.gauge("q.depth", job="a").set(2)
+    out = slo.evaluate(now=101.0)
+    assert out["active"] == []
+    assert metrics.registry.snapshot()[
+        "alert.active{job=a,rule=deep}"
+    ] == 0.0
+    resolved = _alert_events("alert.resolved")
+    assert resolved and resolved[-1]["job"] == "a"
+    assert not [r for r in events.load() if r.get("job") == "b"]
+    assert slo.fired_counts() == {"deep|a": 1}
+    assert slo.active_alerts_by_job() == {}
+
+
+def test_per_job_stale_instance_resolves_on_departure(slo_env):
+    """A firing per-job instance whose tenant leaves the live set is
+    retired on the next tick: resolve event emitted, gauge zeroed —
+    a departed tenant must not hold a page open — and its lifetime
+    fire count survives the cleanup."""
+    _rule(name="deep", kind="threshold", metric="q.depth", op=">",
+          value=5, per_job=True)
+    metrics.registry.gauge("q.depth", job="a").set(10)
+    metrics.registry.gauge("q.depth", job="b").set(1)
+    assert slo.evaluate(now=100.0)["active"] == ["deep|a"]
+    # Tenant a departs: its series zeroes out of the label harvest.
+    metrics.registry.gauge("q.depth", job="a").set(0)
+    out = slo.evaluate(now=101.0)
+    assert out["jobs"] == ["b"]
+    assert out["active"] == []
+    assert metrics.registry.snapshot()[
+        "alert.active{job=a,rule=deep}"
+    ] == 0.0
+    resolved = [r for r in _alert_events("alert.resolved")
+                if r.get("job") == "a"]
+    assert resolved and resolved[-1]["rule"] == "deep"
+    assert slo.fired_counts() == {"deep|a": 1}
+    assert slo.active_alerts_by_job() == {}
+
+
+def test_per_job_metric_points_instances_at_tenant_series(slo_env):
+    """``per_job_metric`` swaps the expanded instances onto a different
+    (job-labeled) series than the rule's global metric — the
+    producer_stalled / capacity_near_limit default shape."""
+    _rule(name="mix", kind="threshold", metric="global.x", op=">",
+          value=0, per_job=True, per_job_metric="tenant.x")
+    metrics.registry.gauge("tenant.x", job="a").set(3)
+    metrics.registry.gauge("tenant.x", job="b").set(0)
+    metrics.registry.gauge("tenant.busy", job="b").set(1)  # b stays live
+    metrics.registry.gauge("global.x").set(99)  # must NOT leak in
+    out = slo.evaluate(now=100.0)
+    assert out["jobs"] == ["a", "b"]
+    assert out["active"] == ["mix|a"]
+    rows = {r["job"]: r for r in out["rules"] if r["name"] == "mix"}
+    assert rows["a"]["metric"] == "tenant.x"
+    assert rows["a"]["value"] == 3.0
+    assert rows["b"]["value"] == 0.0
+
+
+def test_per_job_degrades_to_global_without_tenants(slo_env):
+    """With no live jobs a per_job rule is the single global instance
+    (service-off runs behave exactly as before); a tenant appearing
+    supersedes it — the global instance retires, resolving on the way
+    out."""
+    _rule(name="deep", kind="threshold", metric="q.depth", op=">",
+          value=5, per_job=True)
+    metrics.registry.gauge("q.depth").set(10)
+    out = slo.evaluate(now=100.0)
+    assert out["jobs"] == []
+    assert out["active"] == ["deep"]
+    assert metrics.registry.snapshot()["alert.active{rule=deep}"] == 1.0
+    metrics.registry.gauge("q.depth", job="a").set(1)
+    out = slo.evaluate(now=101.0)
+    assert out["jobs"] == ["a"]
+    assert out["active"] == []
+    assert metrics.registry.snapshot()["alert.active{rule=deep}"] == 0.0
+    assert [r for r in _alert_events("alert.resolved")
+            if r.get("rule") == "deep" and "job" not in r]
+    assert slo.fired_counts() == {"deep": 1}
+
+
+def test_per_job_rate_rule_window_mean_field(slo_env):
+    """The admission_wait_long shape: a per-job rate rule with
+    ``field=window_mean`` over a job-labeled histogram fires for the
+    tenant whose recent observations average over budget only."""
+    _rule(name="adm", kind="rate", metric="w.wait", op=">", value=5.0,
+          window_s=120.0, per_job=True, field="window_mean")
+    metrics.registry.histogram("w.wait", job="a").observe(30.0)
+    metrics.registry.histogram("w.wait", job="b").observe(0.1)
+    timeseries.sample_now(now=1000.0)
+    metrics.registry.histogram("w.wait", job="a").observe(30.0)
+    metrics.registry.histogram("w.wait", job="b").observe(0.1)
+    timeseries.sample_now(now=1010.0)
+    out = slo.evaluate(now=1010.5)
+    assert out["active"] == ["adm|a"]
+    snap = metrics.registry.snapshot()
+    assert snap["alert.active{job=a,rule=adm}"] == 1.0
+    assert snap["alert.active{job=b,rule=adm}"] == 0.0
+
+
+# ---------------------------------------------------------------------------
 # Chaos integration: a wedge fault fires (and resolves) the default
 # wedged_worker alert (ISSUE 9 acceptance)
 # ---------------------------------------------------------------------------

@@ -16,7 +16,11 @@ it is MID-FLIGHT, asserts the acceptance surface end to end:
    gauge clears — fire/resolve both visible on ``/alerts`` and as
    ``alert.fired``/``alert.resolved`` events on ``/events``;
 5. after completion, ``/events`` carries the full epoch lifecycle
-   (``epoch.start``/``epoch.done`` per epoch, one ``trial.done``).
+   (``epoch.start``/``epoch.done`` per epoch, one ``trial.done``);
+6. (ISSUE 16) with the service plane armed, ``/jobs`` lists the
+   running tenant mid-flight, and after completion
+   ``/events?job=<id>`` returns that tenant's stamped events while a
+   bogus job id returns none (the fleet filter actually filters).
 
 Run from the repo root (``run_ci_tests.sh`` obs lane)::
 
@@ -46,6 +50,9 @@ def main() -> int:
     # Sample fast so a short CI shuffle yields several ring entries.
     os.environ.setdefault("RSDL_TS_PERIOD_S", "0.2")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Service plane on (ISSUE 16): the shuffle auto-registers a tenant,
+    # so /jobs and the job= event filter have a real job id to show.
+    os.environ.setdefault("RSDL_SERVICE", "auto")
     # The deliberately-tripped rule (ISSUE 9): a threshold on a gauge
     # this script flips mid-flight — rides alongside the default pack.
     os.environ["RSDL_SLO_RULES"] = json.dumps([
@@ -84,8 +91,13 @@ def main() -> int:
 
         def wait_until_all_epochs_done(self):
             assert self.done.wait(timeout=180)
+            assert release.wait(timeout=180)
 
     errors = []
+    # The mid-flight assertions below race a ~seconds-long run; the
+    # consumer holds shuffle() open (so the tenant stays *running* on
+    # /jobs) until the main thread releases it.
+    release = threading.Event()
 
     def _run():
         try:
@@ -154,6 +166,19 @@ def main() -> int:
     assert "wedged_worker" in rule_names, rule_names
     assert "smoke_trip" in rule_names, rule_names
 
+    # Fleet view, mid-flight (ISSUE 16): the auto-registered service
+    # tenant shows on /jobs as running, with a real job id.
+    jobs_deadline = time.time() + 60
+    smoke_jid = None
+    while time.time() < jobs_deadline and smoke_jid is None:
+        rows = get("/jobs").get("jobs") or []
+        running_rows = [r for r in rows if r.get("running")]
+        if running_rows:
+            smoke_jid = running_rows[0]["job_id"]
+        else:
+            time.sleep(0.2)
+    assert smoke_jid, "no running tenant on /jobs mid-flight"
+
     # Trip the custom rule, wait for it to FIRE on /alerts, clear the
     # gauge, wait for it to RESOLVE — both transitions event-logged.
     from ray_shuffling_data_loader_tpu.telemetry import metrics
@@ -165,6 +190,7 @@ def main() -> int:
     resolved = _wait_alert_state(get, "smoke_trip", active=False)
     assert resolved, "smoke_trip never resolved"
 
+    release.set()
     thread.join(timeout=180)
     assert not thread.is_alive() and not errors, errors
     kinds = get("/events")["by_kind"]
@@ -173,6 +199,14 @@ def main() -> int:
     assert kinds.get("trial.done") == 1, kinds
     assert kinds.get("alert.fired", 0) >= 1, kinds
     assert kinds.get("alert.resolved", 0) >= 1, kinds
+    # The job= filter filters (ISSUE 16): the real tenant's stamped
+    # events come back, a bogus id returns nothing.
+    job_events = get(f"/events?job={smoke_jid}")
+    assert job_events["count"] > 0, "no events for the tenant's job id"
+    assert all(
+        e.get("job") == smoke_jid for e in job_events["events"]
+    ), "job filter leaked other tenants' events"
+    assert get("/events?job=no-such-job")["count"] == 0
     print(
         "obs smoke ok: rate=%.1f rows/s, critical=%s, events=%s"
         % (rate_seen["rate"], crit_path, kinds)

@@ -18,7 +18,14 @@ Usage::
     python tools/rsdl_top.py                    # live, 2 s refresh
     python tools/rsdl_top.py --once             # one frame (CI smoke)
     python tools/rsdl_top.py --once --json      # machine-readable frame
+    python tools/rsdl_top.py --fleet            # per-tenant panel (/jobs)
     python tools/rsdl_top.py --url http://host:9100 --interval 5
+
+``--fleet`` (ISSUE 16) swaps the single-trial dashboard for the
+service-wide per-tenant table: one row per job with its epoch window,
+delivered bytes + current rate, resident store bytes, decode-cache
+claims, admission waits, fair-share vtime lag, and any SLO alerts
+firing against the tenant.
 
 Exit codes: 0 on a rendered frame, 1 when the endpoint is unreachable
 (so ``--once`` doubles as an is-the-obs-plane-up gate).
@@ -107,6 +114,7 @@ def collect(base: str, window_s: float) -> Dict[str, Any]:
         ("capacity", "/capacity"),
         ("critical", "/critical"),
         ("alerts", "/alerts"),
+        ("jobs", "/jobs"),
     ):
         try:
             frame[key] = _get_json(base, path)
@@ -399,6 +407,75 @@ def render(frame: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(frame: Dict[str, Any]) -> str:
+    """The ``--fleet`` panel: one row per tenant from ``/jobs``."""
+    page = frame.get("jobs") or {}
+    rows = page.get("jobs") or []
+    healthz = frame.get("healthz") or {}
+    lines: List[str] = []
+    running = sum(1 for r in rows if r.get("running"))
+    lines.append(
+        "rsdl_top --fleet  "
+        f"{time.strftime('%H:%M:%S', time.localtime(frame['ts']))}"
+        f"  {frame['url']}"
+        f"  up={healthz.get('ok', '?')}"
+        f"  mode={page.get('service_mode') or '-'}"
+        f"  jobs={len(rows)} ({running} running)"
+    )
+    if page.get("error"):
+        lines.append(f"  /jobs error: {page['error']}")
+        return "\n".join(lines)
+    if not rows:
+        lines.append("  (no tenants known to this session)")
+        return "\n".join(lines)
+    lines.append(
+        "  job                    w  run  epochs   in-flight"
+        "    delivered      rate  resident   cache  adm(n/s)"
+        "   vlag  alerts"
+    )
+    for row in rows:
+        jid = str(row.get("job_id", "?"))
+        done = row.get("epochs_done")
+        total = row.get("num_epochs")
+        epochs = (
+            f"{done}/{total}" if done is not None and total is not None
+            else (str(done) if done is not None else "-")
+        )
+        window = row.get("in_flight_epochs")
+        resident = row.get("resident_bytes") or {}
+        resident_total = (
+            sum(resident.values()) if isinstance(resident, dict) else None
+        )
+        adm = row.get("admission") or {}
+        adm_txt = (
+            f"{adm.get('waits', 0)}/{adm.get('wait_s', 0.0):.1f}s"
+            if adm else "-"
+        )
+        alerts = row.get("active_alerts") or []
+        lines.append(
+            f"  {jid:<22}"
+            f"{_fmt(row.get('weight')):>3}"
+            f"{('yes' if row.get('running') else 'no'):>5}"
+            f"{epochs:>8}"
+            f"  {str(window if window else []):<10}"
+            f"{_fmt_bytes(row.get('delivered_bytes')):>11}"
+            f"{_fmt_bytes(row.get('delivered_rate_bps')) + '/s' if row.get('delivered_rate_bps') is not None else '-':>10}"
+            f"{_fmt_bytes(resident_total):>10}"
+            f"{_fmt(row.get('cache_claims')):>8}"
+            f"{adm_txt:>10}"
+            f"{_fmt(row.get('dispatch_vtime_lag')):>7}"
+            f"  {'ALERT: ' + ','.join(alerts) if alerts else '-'}"
+        )
+        if row.get("error"):
+            lines.append(f"      error: {str(row['error'])[:100]}")
+    # The engine-wide view below the table: firing instances + history.
+    alerts_page = frame.get("alerts") or {}
+    active = alerts_page.get("active") or []
+    if active:
+        lines.append("  active alerts: " + ", ".join(active))
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # Main
 # ---------------------------------------------------------------------------
@@ -440,6 +517,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="emit the raw frame as JSON instead of the dashboard",
     )
     parser.add_argument(
+        "--fleet", action="store_true",
+        help="render the service-wide per-tenant table (/jobs) instead "
+        "of the single-trial dashboard (ISSUE 16)",
+    )
+    parser.add_argument(
         "--job", default=None,
         help="focus on ONE service job (exact job id or unique "
         "substring): the trial panel shows that job's epochs and the "
@@ -463,7 +545,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # ANSI clear + home; keeps the frame flicker-free enough
                 # without curses.
                 sys.stdout.write("\x1b[2J\x1b[H")
-            print(render(frame))
+            print(render_fleet(frame) if args.fleet else render(frame))
         if args.once:
             return 0
         try:
