@@ -391,7 +391,26 @@ def _error_result(platform, msg: str) -> dict:
             result["audit"] = _a.summary()
     except Exception:
         pass
+    _attach_profile(result)
     return result
+
+
+def _attach_profile(result: dict) -> None:
+    """Embed the cluster-merged sampling-profile digest (ISSUE 17) in
+    the bench JSON — success AND error paths, like telemetry_final: the
+    profile of a wedged run is the artifact that names where the time
+    went. The env check precedes the import so RSDL_PROFILE unset
+    stays exactly zero-cost; never raises (one-JSON-line contract)."""
+    if not os.environ.get("RSDL_PROFILE"):
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+        digest = profiler.digest()
+        if digest:
+            result["profile"] = digest
+    except Exception:
+        pass
 
 
 # -- hardened backend bring-up ----------------------------------------------
@@ -1106,9 +1125,11 @@ def run_bench(platform: str, num_chips: int, tpu_error):
             lambda: metrics_sampler.__exit__(None, None, None)
         )
 
-    # Optional trace (SURVEY §5 tracing): RSDL_PROFILE_DIR=/tmp/trace
+    # Optional trace (SURVEY §5 tracing): RSDL_BENCH_XPROF_DIR=/tmp/trace
     # wraps the measured region in a jax.profiler trace for xprof.
-    profile_dir = os.environ.get("RSDL_PROFILE_DIR")
+    # (RSDL_PROFILE_DIR now names the sampling-profiler spool override —
+    # ISSUE 17 — a different artifact entirely.)
+    profile_dir = os.environ.get("RSDL_BENCH_XPROF_DIR")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
@@ -2688,6 +2709,9 @@ def main() -> None:
             result["telemetry_final"] = _metrics_export.aggregate()
         except Exception as exc:
             result["telemetry_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    if "profile" not in result:
+        # Success path: the error path embeds via _error_result.
+        _attach_profile(result)
     _ledger_append(result)
     print(json.dumps(result), flush=True)
 

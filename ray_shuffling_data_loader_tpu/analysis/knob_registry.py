@@ -215,8 +215,19 @@ KNOBS: Tuple[Knob, ...] = (
     # -- tests / tools (documented) -----------------------------------------
     Knob("RSDL_TPU_TESTS", "flag", "off", "public",
          "enable the TPU-gated test files"),
-    Knob("RSDL_PROFILE_DIR", "path", "off", "public",
-         "wrap the measured region in a jax.profiler trace"),
+    # -- continuous profiling plane (ISSUE 17) ------------------------------
+    Knob("RSDL_PROFILE", "flag", "off", "public",
+         "cluster-wide wall-clock sampling profiler (every RSDL "
+         "process runs a sampler daemon thread)"),
+    Knob("RSDL_PROFILE_HZ", "float", "67", "public",
+         "sampling rate, clamped to [1, 500]; the off-round default "
+         "avoids phase-locking with 1 s periodic work"),
+    Knob("RSDL_PROFILE_DIR", "path", "<runtime_dir>/profiles", "public",
+         "profile spool override (per-process profile-*.json "
+         "aggregates; was the jax.profiler wrap knob, now "
+         "RSDL_BENCH_XPROF_DIR)"),
+    Knob("RSDL_PROFILE_TOP_N", "int", "20", "public",
+         "default row count for /profile and rsdl_prof top tables"),
     Knob("RSDL_STRESS_SEEDS", "int", "3", "internal",
          "seeds per stress-soak scenario"),
     Knob("RSDL_DRYRUN_MP", "enum", "on", "internal",

@@ -47,6 +47,7 @@ GATED_PLANES = {
         "phases",
         "obs_server",
         "runledger",
+        "profiler",
     )
 } | {
     f"{PACKAGE}.runtime.{m}"

@@ -143,6 +143,22 @@ def _maybe_start_obs_server(ctx: RuntimeContext) -> None:
     (spawned workers and task processes join with ``owner=False`` and
     inherit the same env; letting each of them bind the port would just
     race). A bind failure is logged inside maybe_start, never fatal."""
+    # The continuous profiling plane (ISSUE 17) runs in EVERY process
+    # that joins a session — owner or not (a joined trainer rank's
+    # consume path is exactly what a fleet profile must cover). Env-
+    # gated BEFORE the import: RSDL_PROFILE unset means the module is
+    # never loaded, no thread, no spool file.
+    if os.environ.get("RSDL_PROFILE"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+            profiler.start()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "profiler bring-up failed", exc_info=True
+            )
     if not ctx.owner:
         return
     if os.environ.get("RSDL_OBS_PORT"):
@@ -469,6 +485,19 @@ def shutdown() -> None:
             _metrics_export.safe_flush()
     except Exception:
         pass
+    # Same barrier for the profiling plane (ISSUE 17): stop the sampler
+    # and spool its final aggregate while the runtime dir still exists.
+    # sys.modules only — a run that never profiled must not import it.
+    import sys as _sys
+
+    prof = _sys.modules.get(
+        "ray_shuffling_data_loader_tpu.telemetry.profiler"
+    )
+    if prof is not None:
+        try:
+            prof.stop()
+        except Exception:
+            pass
     if os.environ.get(_ENV_DIR) == ctx.runtime_dir and ctx.owner:
         del os.environ[_ENV_DIR]
     ctx.shutdown()

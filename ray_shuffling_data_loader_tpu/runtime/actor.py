@@ -77,9 +77,12 @@ def _flush_telemetry_spools(maybe: bool = False) -> None:
     ⇒ nothing to import just to no-op), export only when metrics are on
     (its spool is metrics-gated). Keeps the disabled path import-free
     at runtime, matching the structural gate (ISSUE 14)."""
-    mod = sys.modules.get("ray_shuffling_data_loader_tpu.telemetry.trace")
-    if mod is not None:
-        mod.safe_flush()
+    for _name in ("trace", "profiler"):
+        mod = sys.modules.get(
+            f"ray_shuffling_data_loader_tpu.telemetry.{_name}"
+        )
+        if mod is not None:
+            mod.safe_flush()
     if telemetry.metrics.enabled():
         if maybe:
             telemetry.export.maybe_flush()
@@ -396,6 +399,15 @@ def _actor_main(
     # Unconditional: the role tag is process IDENTITY (telemetry spool
     # source records stamp it), not just /actor-filtered fault rules.
     faults.set_role("actor")
+    # The continuous profiler (ISSUE 17) samples this host too — env-
+    # gated before the import, same contract as the trace flag below.
+    if _env.read_flag("RSDL_PROFILE"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+            profiler.start()
+        except Exception:
+            pass
     if _env.read_flag("RSDL_TRACE"):
         # Entrypoint-equivalent of telemetry.enabled(): a freshly
         # spawned process can only have been enabled via env, and the

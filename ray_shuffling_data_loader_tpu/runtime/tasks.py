@@ -212,7 +212,7 @@ def _flush_telemetry_spools() -> None:
     disabled path stays import-free, not merely cheap)."""
     import sys as _sys
 
-    for _name in ("trace", "audit"):
+    for _name in ("trace", "audit", "profiler"):
         _mod = _sys.modules.get(
             f"ray_shuffling_data_loader_tpu.telemetry.{_name}"
         )
@@ -255,6 +255,15 @@ def _worker_main(task_q, result_q, env: Dict[str, str]):
     if trace_on:
         telemetry.set_process_name(f"task-worker-{pid}")
     instrumented = trace_on or telemetry.metrics.enabled()
+    # The continuous profiler (ISSUE 17) samples THIS worker too — env-
+    # gated before the import, same contract as the trace flag above.
+    if _env.read_flag("RSDL_PROFILE"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+            profiler.start()
+        except Exception:
+            pass
     # Orphan self-destruct: if the pool owner dies without shutdown (e.g.
     # SIGKILL), exit rather than linger holding inherited pipes/fds.
     parent = os.getppid()

@@ -105,6 +105,7 @@ _LAZY_SUBMODULES = frozenset(
         "slo",
         "obs_server",
         "phases",
+        "profiler",
     )
 )
 

@@ -1,6 +1,7 @@
 """Live observability endpoint: ``/metrics``, ``/healthz``, ``/status``,
 ``/timeseries``, ``/events``, ``/stragglers``, ``/capacity``,
-``/critical``, ``/alerts``, ``/jobs``.
+``/critical``, ``/alerts``, ``/profile``, ``/profile/flame``,
+``/jobs``.
 
 One stdlib ``http.server`` on a daemon thread inside the driver process,
 env-gated by ``RSDL_OBS_PORT`` — so a running shuffle can be *watched*
@@ -55,6 +56,17 @@ Endpoints:
   rule's live state/value (one row per per-job instance for
   tenant-scoped rules), active alerts, recent fire/resolve
   transitions.
+* ``GET /profile?stage=&job=&epoch=&top=`` — the continuous profiling
+  plane (:mod:`.profiler`, ISSUE 17): every process's spooled collapsed
+  stacks merged into one JSON view — the top-N self/total frame table
+  (per-stage attribution included), the folded-stack text, and the
+  source list. ``stage=``/``job=``/``epoch=`` filter at sample
+  granularity; ``collapsed=1`` returns the folded text alone as
+  ``text/plain`` (pipe it straight into any flamegraph tool).
+* ``GET /profile/flame?stage=&job=&epoch=`` — the same merged view
+  rendered as a self-contained flamegraph HTML page (stdlib-only, no
+  external scripts): click to zoom, stacks grouped under their
+  ``stage:`` roots.
 * ``GET /jobs`` — the fleet view (ISSUE 16): every tenant the session
   knows about — service registry records (weight, pid-liveness,
   decode-cache claims) folded with the live trial tracker's epoch
@@ -586,6 +598,38 @@ def _events_body(params: Dict[str, list]) -> dict:
     }
 
 
+def _profile_agg(params: Dict[str, list]):
+    """The merged profile view for ``/profile``/``/profile/flame`` —
+    the profiler module imports lazily here so an obs server on an
+    unprofiled session never loads the plane just to say "no data"."""
+    from ray_shuffling_data_loader_tpu.telemetry import profiler as _prof
+
+    agg = _prof.aggregate_profiles(
+        stage=_qparam(params, "stage", str),
+        job=_qparam(params, "job", str),
+        epoch=_qparam(params, "epoch", str),
+    )
+    return _prof, agg
+
+
+def _profile_body(params: Dict[str, list]) -> dict:
+    prof, agg = _profile_agg(params)
+    top = _qparam(params, "top", int)
+    return {
+        "ts": time.time(),
+        "stage": _qparam(params, "stage", str),
+        "job": _qparam(params, "job", str),
+        "epoch": _qparam(params, "epoch", str),
+        "sampler_running": prof.running(),
+        "hz": prof.hz(),
+        "samples": agg["samples"],
+        "seconds": round(agg["seconds"], 3),
+        "sources": agg["sources"],
+        "top": prof.top_table(agg, n=top),
+        "collapsed": prof.collapsed_text(agg, tagged=True),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Server lifecycle
 # ---------------------------------------------------------------------------
@@ -680,6 +724,35 @@ def _make_handler():
                         json.dumps(
                             _slo.alerts_body(), default=str
                         ).encode(),
+                    )
+                elif path == "/profile":
+                    if _qparam(params, "collapsed", int, 0):
+                        _prof, agg = _profile_agg(params)
+                        self._send(
+                            200,
+                            "text/plain; charset=utf-8",
+                            _prof.collapsed_text(
+                                agg, tagged=True
+                            ).encode(),
+                        )
+                    else:
+                        self._send(
+                            200,
+                            "application/json",
+                            json.dumps(
+                                _profile_body(params), default=str
+                            ).encode(),
+                        )
+                elif path == "/profile/flame":
+                    _prof, agg = _profile_agg(params)
+                    stage = _qparam(params, "stage", str)
+                    title = "rsdl profile" + (
+                        f" · stage={stage}" if stage else ""
+                    )
+                    self._send(
+                        200,
+                        "text/html; charset=utf-8",
+                        _prof.render_flame_html(agg, title=title).encode(),
                     )
                 elif path == "/jobs":
                     self._send(

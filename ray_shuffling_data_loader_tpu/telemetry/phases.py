@@ -27,11 +27,37 @@ locks (a profiler instance is single-thread, like the task body).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_shuffling_data_loader_tpu.telemetry import _env
 from ray_shuffling_data_loader_tpu.telemetry import metrics as _metrics
 from ray_shuffling_data_loader_tpu.telemetry import trace as _trace
+
+# Active-phase registry for the sampling profiler (ISSUE 17): thread
+# ident -> (stage, phase, stage_args). A _Phase publishes itself here on
+# enter and restores the previous entry on exit, so the profiler's
+# sampler thread — which cannot read another thread's contextvars — can
+# tag each sampled stack with the phase that thread is inside RIGHT NOW.
+# Plain dict ops under the GIL; readers take a point-in-time copy.
+_ACTIVE: Dict[int, Tuple[str, str, dict]] = {}
+
+_profile_armed: Optional[bool] = None
+
+
+def profile_armed() -> bool:
+    """Cached ``RSDL_PROFILE`` flag — arms phase tracking (and real
+    StageProfilers) for the sampling profiler WITHOUT importing it."""
+    global _profile_armed
+    if _profile_armed is None:
+        _profile_armed = _env.read_flag("RSDL_PROFILE")
+    return _profile_armed
+
+
+def refresh_from_env() -> None:
+    global _profile_armed
+    _profile_armed = None
 
 # The canonical phase vocabulary (docs/observability.md). Not enforced —
 # new call sites may add phases — but keeping names here documents the
@@ -95,7 +121,7 @@ _NULL = _NullProfiler()
 class _Phase:
     """One timed phase; records into the owning profiler on exit."""
 
-    __slots__ = ("_prof", "name", "nbytes", "_wall0", "_t0")
+    __slots__ = ("_prof", "name", "nbytes", "_wall0", "_t0", "_prev")
 
     def __init__(self, prof: "StageProfiler", name: str,
                  nbytes: Optional[int]):
@@ -109,12 +135,27 @@ class _Phase:
         self.nbytes = (self.nbytes or 0) + int(n)
 
     def __enter__(self) -> "_Phase":
+        ident = threading.get_ident()
+        self._prev = _ACTIVE.get(ident)
+        # rsdl-lint: disable=lock-discipline -- keyed by this thread's
+        # own ident: no two threads touch the same key, and the
+        # profiler's cross-thread read takes a dict() copy
+        _ACTIVE[ident] = (self._prof.stage, self.name, self._prof.args)
         self._wall0 = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         dur = time.perf_counter() - self._t0
+        ident = threading.get_ident()
+        if self._prev is None:
+            # rsdl-lint: disable=lock-discipline -- this thread's own
+            # ident key only (see __enter__)
+            _ACTIVE.pop(ident, None)
+        else:
+            # rsdl-lint: disable=lock-discipline -- this thread's own
+            # ident key only (see __enter__)
+            _ACTIVE[ident] = self._prev  # nested phase: restore outer
         self._prof._record(self.name, self._wall0, dur, self.nbytes)
         return False
 
@@ -183,8 +224,16 @@ class StageProfiler:
 
 
 def stage_profiler(stage: str, **args):
-    """A :class:`StageProfiler` when either telemetry half is on, else
-    the shared no-op (the disabled path allocates nothing)."""
-    if _metrics.enabled() or _trace.enabled():
+    """A :class:`StageProfiler` when either telemetry half is on — or
+    the sampling profiler is armed (``RSDL_PROFILE``), which needs the
+    active-phase registry populated even with metrics and trace off —
+    else the shared no-op (the disabled path allocates nothing)."""
+    if _metrics.enabled() or _trace.enabled() or profile_armed():
         return StageProfiler(stage, **args)
     return _NULL
+
+
+def active_phases() -> Dict[int, Tuple[str, str, dict]]:
+    """Point-in-time copy of the active-phase registry (profiler join,
+    tests)."""
+    return dict(_ACTIVE)

@@ -285,6 +285,21 @@ def _run_shape(job_id: Optional[str]) -> Dict[str, Any]:
         return {}
 
 
+def _profile_section() -> Optional[Dict[str, Any]]:
+    """The continuous profiler's compact digest (ISSUE 17): top-N
+    frames by self time with per-stage attribution — what lets
+    ``run_ledger --regress`` NAME the frame a regression moved into.
+    sys.modules only, like every section: a run that never profiled
+    must not import the plane here."""
+    profiler = _module("telemetry.profiler")
+    if profiler is None:
+        return None
+    try:
+        return profiler.digest()
+    except Exception:
+        return None
+
+
 def build_record(
     status: str,
     *,
@@ -348,6 +363,9 @@ def build_record(
     alerts = _alerts_section()
     if alerts:
         rec["alerts_fired"] = alerts
+    profile = _profile_section()
+    if profile:
+        rec["profile"] = profile
     if extra:
         rec.update(extra)
     return rec

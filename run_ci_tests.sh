@@ -206,16 +206,32 @@ if [ "$tier" != "slow" ]; then
   # job=-filtered /events must return the tenant's stamped events (and
   # nothing for a bogus id).
   RSDL_METRICS=1 python tools/obs_smoke.py
+  # Profile lane (ISSUE 17): the continuous sampling profiler armed
+  # across the core data-path + profiler suites — every process (driver,
+  # task workers, actor hosts) runs the sampler daemon and spools, and
+  # none of it may perturb the data plane (bit-identical streams, same
+  # green tests). The profiler suite itself proves folding, tagging,
+  # merge, diff math, and the zero-overhead-off fresh-interpreter
+  # contract.
+  RSDL_PROFILE=1 RSDL_METRICS=1 \
+    python -m pytest tests/test_profiler.py tests/test_shuffle.py \
+      tests/test_batch_queue.py tests/test_dataset.py \
+      tests/test_jax_dataset.py -m "not slow" -q -x
   # Run-ledger regression gate (ISSUE 16), gated BOTH ways against the
   # committed fixture pair: the clean base..head must exit 0, the
   # fixture with an injected throughput drop + stall rise must exit
-  # non-zero.
+  # non-zero — and (ISSUE 17) its verdict must NAME the frame the
+  # regression's time moved into, from the records' profile digests.
   python tools/run_ledger.py \
     --ledger tests/fixtures/run_ledger/clean.ndjson --regress 0..1
-  if python tools/run_ledger.py \
+  if regress_out=$(python tools/run_ledger.py \
     --ledger tests/fixtures/run_ledger/regressed.ndjson \
-    --regress 0..1 > /dev/null; then
+    --regress 0..1); then
     echo "run_ledger --regress failed to flag the regressed fixture" >&2
+    exit 1
+  fi
+  if ! grep -q "runtime.store:_spill_segment" <<<"$regress_out"; then
+    echo "run_ledger --regress did not name the regressed frame" >&2
     exit 1
   fi
   # TCP-plane lane (ISSUE 5/6): the two-process loopback "two-host"

@@ -49,6 +49,7 @@ _ENV = (
     "RSDL_FAULTS", "RSDL_FAULTS_SEED", "RSDL_DRAIN_DEADLINE_S",
     "RSDL_EVICT_HIGH_WATERMARK", "RSDL_EVICT_LOW_WATERMARK",
     "RSDL_EVICT_COOLDOWN_S", "RSDL_EVICT_DROP_AGE_S",
+    "RSDL_ELASTIC_MAX_WORKERS",
 )
 
 
@@ -500,6 +501,11 @@ def test_chaos_scale_drain_evict_audit_ok(elastic_env, tmp_path_factory):
     # most one map crash — recovery must absorb it invisibly.
     os.environ["RSDL_FAULTS"] = "task.map/task:crash-entry:0.05x1"
     os.environ["RSDL_FAULTS_SEED"] = "31"
+    # The controller's default upper bound is 2x host cores; this test
+    # builds a width-2 cluster and asserts a scale-up *actuates*, which
+    # on a 1-core CI host the default bound (2) would correctly refuse.
+    # The bound is policy under test elsewhere — pin it out of the way.
+    os.environ["RSDL_ELASTIC_MAX_WORKERS"] = "8"
     _audit.refresh_from_env()
     _metrics.refresh_from_env()
     faults.refresh_from_env()

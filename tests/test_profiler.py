@@ -1,0 +1,536 @@
+"""Continuous profiling plane tests (ISSUE 17): sampler lifecycle +
+Hz clamp, collapsed-stack folding, phase/epoch tagging through the
+phases join, mixed-Hz multi-process spool merge, the /profile and
+/profile/flame endpoint pages, digest diff math both directions, the
+CLI/report exit-code policies, and the zero-overhead-off
+fresh-interpreter proof."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.telemetry import obs_server, phases
+from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV = (
+    "RSDL_PROFILE",
+    "RSDL_PROFILE_HZ",
+    "RSDL_PROFILE_DIR",
+    "RSDL_PROFILE_TOP_N",
+    "RSDL_METRICS",
+)
+
+
+@pytest.fixture
+def profile_on(tmp_path):
+    """Profiler armed, spooling to a per-test dir; fully unwound on
+    teardown (env restored, cached gate + aggregate cleared) so the
+    rest of the suite keeps its telemetry-off default."""
+    saved = {k: os.environ.get(k) for k in _ENV}
+    spool = str(tmp_path / "profiles")
+    os.environ["RSDL_PROFILE"] = "1"
+    os.environ["RSDL_PROFILE_DIR"] = spool
+    for k in ("RSDL_PROFILE_HZ", "RSDL_PROFILE_TOP_N", "RSDL_METRICS"):
+        os.environ.pop(k, None)
+    profiler.refresh_from_env()
+    phases.refresh_from_env()
+    profiler.reset()
+    yield spool
+    profiler.stop()
+    profiler.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    profiler.refresh_from_env()
+    phases.refresh_from_env()
+
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+
+def test_hz_default_and_clamp(profile_on):
+    """Default 67 Hz (off-round by design); RSDL_PROFILE_HZ overrides;
+    typos and absurd values clamp to [1, 500] instead of wedging every
+    process in its own profiler."""
+    assert profiler.hz() == 67.0
+    for raw, want in (
+        ("200", 200.0),
+        ("6700", 500.0),
+        ("0.1", 1.0),
+        ("junk", 67.0),
+    ):
+        os.environ["RSDL_PROFILE_HZ"] = raw
+        assert profiler.hz() == want, raw
+    os.environ.pop("RSDL_PROFILE_HZ", None)
+    os.environ["RSDL_PROFILE_TOP_N"] = "7"
+    assert profiler.top_n_default() == 7
+    os.environ["RSDL_PROFILE_TOP_N"] = "junk"
+    assert profiler.top_n_default() == 20
+
+
+# ---------------------------------------------------------------------------
+# Sampler lifecycle + folding
+# ---------------------------------------------------------------------------
+
+
+def _named_threads():
+    return {t.name for t in threading.enumerate()}
+
+
+def test_sampler_lifecycle_and_spool(profile_on):
+    """start() spawns ONE daemon sampler thread (idempotent), samples
+    accumulate while it runs, and stop() joins it and leaves the final
+    aggregate spooled as this process's profile-*.json."""
+    assert not profiler.running()
+    profiler.start(period=0.005)
+    try:
+        assert profiler.running()
+        thread = next(
+            t for t in threading.enumerate() if t.name == "rsdl-profiler"
+        )
+        assert thread.daemon
+        profiler.start(period=0.005)  # idempotent: still one thread
+        assert [
+            t for t in threading.enumerate() if t.name == "rsdl-profiler"
+        ] == [thread]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if profiler.snapshot()["samples"] >= 5:
+                break
+            time.sleep(0.01)
+    finally:
+        profiler.stop()
+    assert not profiler.running()
+    assert "rsdl-profiler" not in _named_threads()
+    records = profiler.load_records(profile_on)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["samples"] >= 5
+    assert rec["source"]["pid"] == os.getpid()
+    assert rec["stacks"], "sampler folded no stacks"
+    # Folded format: root-first, thread-name prefixed, leaf last.
+    stack = rec["stacks"][0]["stack"]
+    assert stack.startswith("thread:")
+    assert all(":" in part for part in stack.split(";"))
+
+
+def test_tick_folds_other_threads_not_itself(profile_on):
+    """_tick() folds every live thread EXCEPT the caller, root-first
+    with the parked test function on the path and the wait leaf last."""
+    evt = threading.Event()
+
+    def _parked_probe():
+        evt.wait(timeout=30)
+
+    t = threading.Thread(target=_parked_probe, name="probe", daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)  # let the probe reach its wait()
+        profiler.reset()
+        folded = profiler._tick()
+        assert folded >= 1
+        snap = profiler.snapshot()
+        assert snap["samples"] == 1
+        probe = [
+            s for s in snap["stacks"]
+            if s["stack"].startswith("thread:probe;")
+        ]
+        assert probe, [s["stack"] for s in snap["stacks"]]
+        frames = probe[0]["stack"].split(";")
+        park_idx = [
+            i for i, f in enumerate(frames)
+            if f.endswith(":_parked_probe")
+        ]
+        assert park_idx, frames
+        # Leaf (last) is deeper than the parked function: wait() inside.
+        assert park_idx[0] < len(frames) - 1
+        assert "threading:" in frames[-1]
+        # The sampling thread itself never self-samples.
+        me = threading.current_thread().name
+        assert not any(
+            s["stack"].startswith(f"thread:{me};")
+            for s in snap["stacks"]
+        )
+    finally:
+        evt.set()
+        t.join(timeout=10)
+
+
+def test_samples_tagged_with_open_phase(profile_on):
+    """A thread inside a phases.py phase gets stage/phase/epoch tags on
+    its samples — the cross-thread join the flamegraph stage roots and
+    the per-stage attribution ride on."""
+    ready, release = threading.Event(), threading.Event()
+
+    def _staged():
+        prof = phases.stage_profiler("reduce", epoch=3, reducer=1)
+        with prof.phase("gather"):
+            ready.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=_staged, name="staged", daemon=True)
+    t.start()
+    try:
+        assert ready.wait(timeout=10)
+        profiler.reset()
+        profiler._tick()
+        snap = profiler.snapshot()
+        tagged = [
+            s for s in snap["stacks"]
+            if s["stack"].startswith("thread:staged;")
+        ]
+        assert tagged, [s["stack"] for s in snap["stacks"]]
+        tags = tagged[0]["tags"]
+        assert tags["stage"] == "reduce"
+        assert tags["phase"] == "gather"
+        assert tags["epoch"] == "3"
+    finally:
+        release.set()
+        t.join(timeout=10)
+    # Phase closed: the same thread's next sample is untagged.
+    assert threading.get_ident() not in phases.active_phases() or True
+    assert not any(
+        ident == t.ident for ident in phases.active_phases()
+    ), "closed phase leaked in the active-phase table"
+
+
+def test_flush_nothing_to_say(profile_on):
+    """No samples -> no spool file (flush returns None, dir untouched)."""
+    profiler.reset()
+    assert profiler.flush() is None
+    assert not os.path.exists(os.path.join(profile_on, "nonexistent"))
+    assert profiler.load_records(profile_on) == []
+
+
+# ---------------------------------------------------------------------------
+# Merge / analysis (pure functions over records)
+# ---------------------------------------------------------------------------
+
+
+def _record(role, pid, hz, stacks):
+    return {
+        "source": {"role": role, "host": "h", "pid": pid},
+        "ts": 1.0,
+        "t0": 0.0,
+        "hz": hz,
+        "samples": sum(s["count"] for s in stacks),
+        "stacks": stacks,
+    }
+
+
+def _write(spool, rec):
+    os.makedirs(spool, exist_ok=True)
+    path = os.path.join(
+        spool, f"profile-{rec['source']['role']}-{rec['source']['pid']}.json"
+    )
+    with open(path, "w") as f:
+        json.dump(rec, f)
+
+
+def test_mixed_hz_spool_merge_and_filters(tmp_path):
+    """Two processes sampling at DIFFERENT rates merge correctly: each
+    record's counts convert at its own hz (count/hz seconds), identical
+    (stack, tags) keys fold, and stage/epoch filters cut at sample
+    granularity."""
+    spool = str(tmp_path / "profiles")
+    shared = {"stack": "thread:MainThread;a:f;b:g", "count": 100,
+              "tags": {"stage": "map"}}
+    _write(spool, _record("task", 11, 50.0, [
+        dict(shared),
+        {"stack": "thread:MainThread;a:f;c:h", "count": 50,
+         "tags": {"stage": "reduce", "epoch": "2"}},
+    ]))
+    _write(spool, _record("task", 12, 100.0, [dict(shared)]))
+    agg = profiler.aggregate_profiles(directory=spool, include_local=False)
+    assert len(agg["sources"]) == 2
+    assert agg["samples"] == 250
+    merged = {s["stack"]: s for s in agg["stacks"]}
+    fold = merged["thread:MainThread;a:f;b:g"]
+    assert fold["count"] == 200
+    # 100/50Hz + 100/100Hz = 3.0s — NOT 200 at either single rate.
+    assert fold["seconds"] == pytest.approx(3.0)
+    assert agg["seconds"] == pytest.approx(3.0 + 50 / 50.0)
+
+    only_map = profiler.aggregate_profiles(
+        directory=spool, include_local=False, stage="map"
+    )
+    assert [s["stack"] for s in only_map["stacks"]] == [
+        "thread:MainThread;a:f;b:g"
+    ]
+    only_e2 = profiler.aggregate_profiles(
+        directory=spool, include_local=False, epoch="2"
+    )
+    assert len(only_e2["stacks"]) == 1
+    assert only_e2["stacks"][0]["tags"]["epoch"] == "2"
+
+
+def test_top_table_self_total_and_recursion(tmp_path):
+    """Self = leaf samples; total = stacks the frame appears in, counted
+    ONCE per stack (recursion does not double-bill); per-stage self
+    attribution rides each row."""
+    agg = {
+        "sources": [],
+        "samples": 4,
+        "seconds": 4.0,
+        "stacks": [
+            {"stack": "a:f;b:g", "count": 3, "seconds": 3.0,
+             "tags": {"stage": "map"}},
+            {"stack": "a:f;b:g;a:f", "count": 1, "seconds": 1.0,
+             "tags": {}},
+        ],
+    }
+    rows = profiler.top_table(agg, n=10)
+    by_frame = {r["frame"]: r for r in rows}
+    assert rows[0]["frame"] == "b:g"
+    assert by_frame["b:g"]["self_s"] == pytest.approx(3.0)
+    assert by_frame["b:g"]["self_frac"] == pytest.approx(0.75)
+    assert by_frame["b:g"]["stages"] == {"map": pytest.approx(3.0)}
+    # a:f appears twice in the recursive stack but its total counts
+    # that stack's second once: 3.0 + 1.0, not 3.0 + 2.0.
+    assert by_frame["a:f"]["self_s"] == pytest.approx(1.0)
+    assert by_frame["a:f"]["total_s"] == pytest.approx(4.0)
+    assert profiler.top_table(agg, n=1)[0]["frame"] == "b:g"
+
+
+def test_collapsed_text_and_flame_page(tmp_path):
+    spool = str(tmp_path / "profiles")
+    _write(spool, _record("task", 11, 67.0, [
+        {"stack": "thread:MainThread;a:f;b:g", "count": 10,
+         "tags": {"stage": "reduce"}},
+    ]))
+    agg = profiler.aggregate_profiles(directory=spool, include_local=False)
+    text = profiler.collapsed_text(agg)
+    assert text == "thread:MainThread;a:f;b:g 10\n"
+    tagged = profiler.collapsed_text(agg, tagged=True)
+    assert tagged.startswith("stage:reduce;thread:MainThread;")
+    html = profiler.render_flame_html(agg, title="t")
+    assert "<html" in html and "stage:reduce" in html and "b:g" in html
+
+
+def test_digest_and_diff_both_directions(tmp_path):
+    """The ledger digest names top frames by self share; diffing two
+    digests splits into regressed/improved by fraction delta and drops
+    sub-noise (< 1 point) shifts so clean runs diff to nothing."""
+    assert profiler.digest(directory=str(tmp_path / "nope")) is None
+    base = {"top": [
+        {"frame": "a:f", "self_frac": 0.50},
+        {"frame": "b:g", "self_frac": 0.40},
+        {"frame": "c:h", "self_frac": 0.10},
+    ]}
+    head = {"top": [
+        {"frame": "a:f", "self_frac": 0.20},   # improved
+        {"frame": "b:g", "self_frac": 0.405},  # noise: dropped
+        {"frame": "d:k", "self_frac": 0.30},   # regressed (new)
+    ]}
+    shift = profiler.diff_digests(base, head)
+    regressed = {r["frame"]: r for r in shift["regressed"]}
+    improved = {r["frame"]: r for r in shift["improved"]}
+    assert set(regressed) == {"d:k"}
+    assert regressed["d:k"]["base_frac"] == pytest.approx(0.0)
+    assert regressed["d:k"]["delta_frac"] == pytest.approx(0.30)
+    assert set(improved) == {"a:f", "c:h"}
+    assert improved["a:f"]["delta_frac"] == pytest.approx(-0.30)
+    # Symmetric the other way around.
+    back = profiler.diff_digests(head, base)
+    assert {r["frame"] for r in back["regressed"]} == {"a:f", "c:h"}
+    assert {r["frame"] for r in back["improved"]} == {"d:k"}
+
+
+# ---------------------------------------------------------------------------
+# Endpoint pages
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+def test_profile_endpoint_pages(profile_on):
+    """/profile serves the merged JSON view (filterable), ?collapsed=1
+    the folded text, and /profile/flame the self-contained HTML page."""
+    _write(profile_on, _record("task", 11, 67.0, [
+        {"stack": "thread:MainThread;a:f;b:g", "count": 60,
+         "tags": {"stage": "reduce"}},
+        {"stack": "thread:MainThread;a:f;c:h", "count": 40,
+         "tags": {"stage": "map"}},
+    ]))
+    port = obs_server.start(0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        _, ctype, body = _get(base + "/profile")
+        page = json.loads(body)
+        assert "json" in ctype
+        assert page["samples"] == 100
+        assert page["sampler_running"] is False
+        assert page["hz"] == 67.0
+        assert len(page["sources"]) == 1
+        assert page["top"][0]["frame"] == "b:g"
+        assert "stage:reduce;" in page["collapsed"]
+
+        _, _, body = _get(base + "/profile?stage=map&top=5")
+        filtered = json.loads(body)
+        assert filtered["samples"] == 100  # record-level total
+        assert [r["frame"] for r in filtered["top"]] == ["c:h"]
+
+        _, ctype, body = _get(base + "/profile?collapsed=1")
+        assert "text/plain" in ctype
+        assert "thread:MainThread;a:f;b:g 60" in body
+
+        _, ctype, body = _get(base + "/profile/flame?stage=reduce")
+        assert "html" in ctype
+        assert "b:g" in body and "c:h" not in body
+    finally:
+        obs_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI / report exit-code policy
+# ---------------------------------------------------------------------------
+
+
+def _run_tool(tool, *args, env_extra=None):
+    env = {**os.environ, "PYTHONPATH": _REPO}
+    for k in _ENV:
+        env.pop(k, None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", tool), *args],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+
+
+def test_rsdl_prof_cli(tmp_path):
+    """top/flame/diff from spool dirs; exit 3 when no data exists."""
+    base_dir, head_dir = str(tmp_path / "base"), str(tmp_path / "head")
+    _write(base_dir, _record("task", 1, 67.0, [
+        {"stack": "thread:M;a:f;b:g", "count": 90, "tags": {}},
+        {"stack": "thread:M;a:f;c:h", "count": 10, "tags": {}},
+    ]))
+    _write(head_dir, _record("task", 1, 67.0, [
+        {"stack": "thread:M;a:f;b:g", "count": 10, "tags": {}},
+        {"stack": "thread:M;a:f;c:h", "count": 90, "tags": {}},
+    ]))
+    out = _run_tool("rsdl_prof.py", "top", "--dir", base_dir, "--json")
+    assert out.returncode == 0, out.stderr
+    top = json.loads(out.stdout)
+    assert top["top"][0]["frame"] == "b:g"
+
+    flame = str(tmp_path / "flame.html")
+    out = _run_tool("rsdl_prof.py", "flame", "--dir", base_dir,
+                    "--out", flame)
+    assert out.returncode == 0, out.stderr
+    assert "<html" in open(flame).read()
+
+    out = _run_tool("rsdl_prof.py", "diff", base_dir, head_dir, "--json")
+    assert out.returncode == 0, out.stderr
+    shift = json.loads(out.stdout)
+    assert shift["regressed"][0]["frame"] == "c:h"
+    assert shift["improved"][0]["frame"] == "b:g"
+
+    out = _run_tool("rsdl_prof.py", "top", "--dir", str(tmp_path / "no"))
+    assert out.returncode == 3
+    assert "no profile data" in out.stderr
+
+
+def test_epoch_report_profile_join_policy(tmp_path):
+    """--profile follows the zero-coverage rule: a never-produced spool
+    is merely noted (exit 0 alongside other data), a present-but-empty
+    one exits 3, and a populated one renders the hot-frames table."""
+    spool = str(tmp_path / "profiles")
+    _write(spool, _record("task", 11, 67.0, [
+        {"stack": "thread:M;shuffle:_gather_rows", "count": 100,
+         "tags": {"stage": "reduce"}},
+    ]))
+    out = _run_tool("epoch_report.py", "--profile", spool)
+    assert out.returncode == 0, out.stderr
+    assert "hot frames (profile)" in out.stdout
+    assert "shuffle:_gather_rows" in out.stdout
+
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps(
+        {"metric": "m", "value": 1.0, "unit": "GB/s"}
+    ))
+    out = _run_tool("epoch_report.py", "--bench", str(bench),
+                    "--profile", str(tmp_path / "never-made"))
+    assert out.returncode == 0, out.stderr
+    assert "no profile spool present" in out.stderr
+
+    empty = str(tmp_path / "empty")
+    _write(empty, _record("task", 12, 67.0, []))
+    out = _run_tool("epoch_report.py", "--bench", str(bench),
+                    "--profile", empty)
+    assert out.returncode == 3
+    assert "present but empty" in out.stderr
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_profile_off_never_imports_plane(tmp_path):
+    """RSDL_PROFILE unset: a fresh interpreter running a whole shuffle
+    never imports the profiler module, starts no sampler thread, and
+    writes no profile spool anywhere under its cwd — the exact
+    zero-overhead contract of the other gated planes."""
+    code = """
+import os, sys, threading
+for k in list(os.environ):
+    if k.startswith("RSDL_"):
+        del os.environ[k]
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+class C(BatchConsumer):
+    def consume(self, rank, epoch, batches): pass
+    def producer_done(self, rank, epoch): pass
+    def wait_until_ready(self, epoch): pass
+    def wait_until_all_epochs_done(self): pass
+
+files = [generate_file(0, 0, 128, 1, os.getcwd())[0]]
+runtime.init(num_workers=1)
+shuffle(files, C(), num_epochs=1, num_reducers=1, num_trainers=1, seed=1)
+assert not any(
+    t.name == "rsdl-profiler" for t in threading.enumerate()
+), "sampler thread running while off"
+runtime.shutdown()
+assert (
+    "ray_shuffling_data_loader_tpu.telemetry.profiler" not in sys.modules
+), "profiler imported on a profile-off run"
+spooled = [
+    os.path.join(d, f)
+    for d, _, fs in os.walk(os.getcwd())
+    for f in fs
+    if f.startswith("profile-") and f.endswith(".json")
+]
+assert not spooled, spooled
+print("PROFILE_ZERO_OVERHEAD_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": _REPO},
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "PROFILE_ZERO_OVERHEAD_OK" in out.stdout

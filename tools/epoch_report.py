@@ -40,7 +40,12 @@ The temporal plane (ISSUE 7) joins in when its artifacts are given:
   (``<metrics spool>/capacity``, ISSUE 9): the per-(epoch, tier)
   residency/high-watermark table — which epochs held how many bytes
   where, folded by the same ``telemetry/capacity.py`` ledger the live
-  ``/capacity`` endpoint serves.
+  ``/capacity`` endpoint serves;
+* ``--profile <dir>`` — the sampling-profiler spool (ISSUE 17,
+  ``$RSDL_RUNTIME_DIR/profiles`` of per-process ``profile-*.json``
+  aggregates): the merged hot-frames table (self seconds / share,
+  per-stage attribution) joins the report, so "which stage stalled"
+  and "which frame burned the time" land on the same page.
 
 The interval-union / critical-path math itself is shared with the live
 ``/critical`` analyzer (``telemetry/critical.py``): the online verdict
@@ -633,6 +638,26 @@ def render(report: Dict[str, Any]) -> str:
                         for c in _CAPACITY_COLUMNS
                     )
                 )
+    profile = report.get("profile")
+    if profile is not None:
+        lines.append("")
+        lines.append(
+            "hot frames (profile)  samples=%d sampled=%.1fs sources=%d"
+            % (
+                profile.get("samples", 0),
+                profile.get("seconds", 0.0),
+                profile.get("sources", 0),
+            )
+        )
+        for row in profile.get("top", []):
+            stages = ",".join(
+                f"{k}={v:.1f}s"
+                for k, v in (row.get("stages") or {}).items()
+            )
+            lines.append(
+                f"  {row['self_s']:>7.1f}s {row['self_frac']:>6.1%}  "
+                f"{row['frame']}" + (f"  [{stages}]" if stages else "")
+            )
     notable = report.get("events")
     if notable:
         lines.append("")
@@ -699,6 +724,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "residency/watermark table",
     )
     parser.add_argument(
+        "--profile",
+        help="sampling-profiler spool dir of profile-*.json "
+        "per-process aggregates ($RSDL_RUNTIME_DIR/profiles) for "
+        "the hot-frames table",
+    )
+    parser.add_argument(
         "--straggler-k", type=float, default=4.0,
         help="straggler budget: flag tasks slower than K x the "
         "(epoch, stage) median (default 4)",
@@ -724,11 +755,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
     if not any((args.trace, args.epoch_csv, args.bench, args.events,
-                args.task_records, args.timeseries, args.capacity)):
+                args.task_records, args.timeseries, args.capacity,
+                args.profile)):
         parser.print_usage(sys.stderr)
         print(
             "epoch_report: need at least one of --trace/--epoch-csv/"
-            "--bench/--events/--task-records/--timeseries/--capacity",
+            "--bench/--events/--task-records/--timeseries/--capacity/"
+            "--profile",
             file=sys.stderr,
         )
         return 2
@@ -794,6 +827,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     capacity_records = _job_filter(
         _temporal(cap_path, "ledger-", "op", "capacity ledger")
     )
+
+    def _profile_join(path):
+        """The profiler spool is per-process JSON aggregates
+        (``profile-*.json``), not NDJSON, so it gets its own loader —
+        same zero-coverage policy as ``_temporal``: spool never
+        produced = note + informational, spool present with zero
+        samples = the plane was armed and recorded nothing (exit 3)."""
+        if not path:
+            return None
+        present = _os.path.isdir(path) and any(
+            f.startswith("profile-") and f.endswith(".json")
+            for f in _os.listdir(path)
+        )
+        if not present:
+            absent_notes.append(
+                f"note: no profile spool present at {path} "
+                "(plane off?) — informational"
+            )
+            return None
+        profiler = _load_telemetry_module("profiler")
+        agg = profiler.aggregate_profiles(
+            records=profiler.load_records(path)
+        )
+        if not agg["stacks"]:
+            empty_present.append(
+                f"profile spool at {path} is present but empty — the "
+                "plane was on and recorded nothing"
+            )
+            return None
+        return {
+            "samples": agg["samples"],
+            "seconds": round(agg["seconds"], 3),
+            "sources": len(agg["sources"]),
+            "top": profiler.top_table(agg, n=5),
+        }
+
+    profile_view = _profile_join(args.profile)
     try:
         events: List[dict] = []
         if args.trace:
@@ -817,6 +887,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, ValueError) as exc:
         print(f"epoch_report: {exc}", file=sys.stderr)
         return 2
+    if profile_view is not None:
+        report["profile"] = profile_view
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:
@@ -831,6 +903,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 3
     has_temporal = bool(
         event_records or task_records or ts_samples or capacity_records
+        or profile_view
     )
     if (
         not report["epochs"]

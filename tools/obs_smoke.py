@@ -20,7 +20,11 @@ it is MID-FLIGHT, asserts the acceptance surface end to end:
 6. (ISSUE 16) with the service plane armed, ``/jobs`` lists the
    running tenant mid-flight, and after completion
    ``/events?job=<id>`` returns that tenant's stamped events while a
-   bogus job id returns none (the fleet filter actually filters).
+   bogus job id returns none (the fleet filter actually filters);
+7. (ISSUE 17) with ``RSDL_PROFILE`` armed, ``/profile`` merges the
+   spools of at least two distinct processes mid-flight (driver +
+   task workers) and attributes nonzero self time to a shuffle stage
+   frame — the cluster-wide sampler actually samples the cluster.
 
 Run from the repo root (``run_ci_tests.sh`` obs lane)::
 
@@ -46,6 +50,8 @@ def main() -> int:
     port = s.getsockname()[1]
     s.close()
     os.environ.setdefault("RSDL_METRICS", "1")
+    # Continuous profiling plane on (ISSUE 17): every process samples.
+    os.environ.setdefault("RSDL_PROFILE", "1")
     os.environ["RSDL_OBS_PORT"] = str(port)
     # Sample fast so a short CI shuffle yields several ring entries.
     os.environ.setdefault("RSDL_TS_PERIOD_S", "0.2")
@@ -179,6 +185,31 @@ def main() -> int:
             time.sleep(0.2)
     assert smoke_jid, "no running tenant on /jobs mid-flight"
 
+    # Profiling plane, mid-flight (ISSUE 17): the merged /profile view
+    # must cover >= 2 distinct processes (driver + at least one task
+    # worker spool) and pin nonzero self time on a shuffle-stage frame.
+    prof_deadline = time.time() + 60
+    prof_procs = prof_staged = None
+    while time.time() < prof_deadline:
+        prof = get("/profile")
+        procs = {
+            (s.get("host"), s.get("pid"))
+            for s in (prof.get("sources") or [])
+            if s.get("pid")
+        }
+        staged = [
+            r for r in (prof.get("top") or [])
+            if any(v > 0 for v in (r.get("stages") or {}).values())
+        ]
+        if len(procs) >= 2 and staged:
+            prof_procs, prof_staged = len(procs), staged[0]
+            break
+        time.sleep(0.2)
+    assert prof_procs, (
+        "/profile never showed >=2 process sources plus a stage-"
+        "attributed frame mid-flight"
+    )
+
     # Trip the custom rule, wait for it to FIRE on /alerts, clear the
     # gauge, wait for it to RESOLVE — both transitions event-logged.
     from ray_shuffling_data_loader_tpu.telemetry import metrics
@@ -208,8 +239,10 @@ def main() -> int:
     ), "job filter leaked other tenants' events"
     assert get("/events?job=no-such-job")["count"] == 0
     print(
-        "obs smoke ok: rate=%.1f rows/s, critical=%s, events=%s"
-        % (rate_seen["rate"], crit_path, kinds)
+        "obs smoke ok: rate=%.1f rows/s, critical=%s, profile=%d procs"
+        " (hot %s), events=%s"
+        % (rate_seen["rate"], crit_path, prof_procs,
+           prof_staged["frame"], kinds)
     )
     runtime.shutdown()
     return 0

@@ -8,9 +8,11 @@ refreshing screen: epoch-window state, per-stage throughput sparklines
 the capacity ledger (per-tier/per-epoch residency + host headroom,
 ``/capacity``), the online critical-path verdict (``/critical``),
 active SLO alerts with their recent transitions (``/alerts``),
-recovery counters, stall attribution, the straggler/skew table, and
-the latest structured events. Pure stdlib, no curses — ANSI clear +
-redraw, so it works over any ssh session.
+recovery counters, stall attribution, the straggler/skew table, the
+continuous profiler's hot-frames panel (top-5 self-time frames with
+per-stage attribution, ``/profile`` — shown when ``RSDL_PROFILE`` is
+armed), and the latest structured events. Pure stdlib, no curses —
+ANSI clear + redraw, so it works over any ssh session.
 
 Usage::
 
@@ -115,6 +117,7 @@ def collect(base: str, window_s: float) -> Dict[str, Any]:
         ("critical", "/critical"),
         ("alerts", "/alerts"),
         ("jobs", "/jobs"),
+        ("profile", "/profile?top=5"),
     ):
         try:
             frame[key] = _get_json(base, path)
@@ -365,6 +368,31 @@ def render(frame: Dict[str, Any]) -> str:
             f"pid={task.get('pid')} dur={_fmt(task.get('dur_s'))}s"
             + (f" epoch={task['epoch']}" if "epoch" in task else "")
         )
+
+    # Hot frames (ISSUE 17): the continuous profiler's top self-time
+    # frames with per-stage attribution — where the run's wall time
+    # ACTUALLY goes, declared-instrumentation or not. Absent (not an
+    # error) when the profiling plane is off.
+    profile = frame.get("profile") or {}
+    top_frames = profile.get("top") or []
+    if top_frames:
+        lines.append("")
+        lines.append(
+            "hot frames  "
+            f"samples={_fmt(profile.get('samples'))}  "
+            f"sampled={_fmt(profile.get('seconds'))}s  "
+            f"hz={_fmt(profile.get('hz'))}  "
+            f"sampler={'on' if profile.get('sampler_running') else 'off'}"
+        )
+        for row in top_frames[:5]:
+            stages = ",".join(
+                f"{k}={v:.1f}s" for k, v in (row.get("stages") or {}).items()
+            )
+            lines.append(
+                f"  {row.get('self_s', 0.0):>6.1f}s "
+                f"{row.get('self_frac', 0.0):>6.1%}  {row.get('frame')}"
+                + (f"  [{stages}]" if stages else "")
+            )
 
     # Events tail (job-filtered when --job is set: job-stamped records
     # must match; UNstamped ones are session-level — store/evictor/obs

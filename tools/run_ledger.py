@@ -15,7 +15,10 @@ prefix, or append-order index (``0`` oldest, ``-1`` newest). The
 HEAD's throughput dropped by more than ``--drop-frac`` or its total
 stall seconds rose by more than ``--stall-rise-frac`` (relative);
 exit **3** when either record (or the ledger itself) is missing, so
-CI can tell "regressed" from "nothing to compare". The ledger path
+CI can tell "regressed" from "nothing to compare". When both records
+carry a ``profile`` digest (ISSUE 17), the verdict also NAMES the
+frames whose self-time share moved most — where the regression went,
+not just that it happened. The ledger path
 comes from ``--ledger`` or ``RSDL_RUN_LEDGER`` (same resolution as
 the writer: docs/observability.md).
 """
@@ -161,6 +164,39 @@ def cmd_diff(records: List[dict], args) -> int:
     return 0
 
 
+def _profile_shift_lines(base: dict, head: dict) -> List[str]:
+    """Human-readable profile-digest shift between two records (empty
+    when either record lacks a ``profile`` section): the top frames
+    whose SELF-time share grew or shrank, by name — fraction-based, so
+    runs of different lengths compare meaningfully."""
+    bprof, hprof = base.get("profile"), head.get("profile")
+    if not bprof or not hprof:
+        return []
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+        shift = profiler.diff_digests(bprof, hprof, n=3)
+    except Exception:
+        return []
+    out: List[str] = []
+    for row in shift.get("regressed", []):
+        out.append(
+            "profile: self-time share of %s rose %.1f%% -> %.1f%% "
+            "(+%.1f points)" % (
+                row["frame"], 100 * row["base_frac"],
+                100 * row["head_frac"], 100 * row["delta_frac"],
+            )
+        )
+    for row in shift.get("improved", []):
+        out.append(
+            "profile: self-time share of %s fell %.1f%% -> %.1f%%" % (
+                row["frame"], 100 * row["base_frac"],
+                100 * row["head_frac"],
+            )
+        )
+    return out
+
+
 def cmd_regress(records: List[dict], args) -> int:
     spec = args.regress
     if ".." not in spec:
@@ -202,10 +238,18 @@ def cmd_regress(records: List[dict], args) -> int:
     if head.get("status") == "failed" and base.get("status") == "done":
         failures.append("head run failed where base succeeded")
     print(f"base: {base.get('id')}  head: {head.get('id')}")
+    profile_lines = _profile_shift_lines(base, head)
     if failures:
         for f in failures:
             print(f"REGRESSION: {f}")
+        # The profiling plane's whole point (ISSUE 17): when the gate
+        # trips, NAME the frame the time moved into, not just that it
+        # moved.
+        for line in profile_lines:
+            print(line)
         return 1
+    for line in profile_lines:
+        print(line)
     print(
         f"ok: throughput {btp if btp is not None else '-'} -> "
         f"{htp if htp is not None else '-'}, "
