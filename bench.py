@@ -1623,6 +1623,11 @@ def run_tcp_plane_bench() -> dict:
     # their inputs and off the wire — the opposite of what this bench
     # exists to measure).
     os.environ["RSDL_DISABLE_LOCALITY"] = "1"
+    # Telemetry federation (ISSUE 19) rides this leg by default: the
+    # worker host joins with its OWN runtime dir, so without the relay
+    # the driver-side telemetry_final/audit would silently lose every
+    # remote record. setdefault so RSDL_RELAY=off A/Bs the overhead.
+    os.environ.setdefault("RSDL_RELAY", "auto")
     # Worker-host processes fix their env at spawn: arm the zero-copy
     # plane cluster-wide NOW so the shuffle leg's remote reducers ride
     # it; the windowed-fetch microbench below toggles the DRIVER's gate
@@ -1677,6 +1682,39 @@ def run_tcp_plane_bench() -> dict:
         "windows": windows,
         "window_mb": window_mb,
     }
+
+    def _embed_final(res: dict) -> None:
+        """Federated final counters + relay status — success AND error
+        paths, and BEFORE the finally below tears the session down
+        (shutdown removes the spool tree the relayed records live in).
+        Never raises (one-JSON-line contract)."""
+        if _m.enabled():
+            try:
+                from ray_shuffling_data_loader_tpu.telemetry import (
+                    export as _export,
+                )
+
+                res["telemetry_final"] = _export.aggregate()
+                res["telemetry_source_hosts"] = sorted(
+                    {
+                        str((rec.get("source") or {}).get("host"))
+                        for rec in _export.load_records()
+                    }
+                )
+            except Exception:
+                pass
+        _relay = sys.modules.get(
+            "ray_shuffling_data_loader_tpu.telemetry.relay"
+        )
+        if _relay is not None:
+            try:
+                res["relay"] = {
+                    "mode": os.environ.get("RSDL_RELAY", ""),
+                    "status": _relay.status_section(),
+                }
+            except Exception:
+                pass
+
     try:
         deadline = time.monotonic() + 120
         while len(ctx.cluster.registry.call("hosts")) < 2:
@@ -1937,6 +1975,19 @@ def run_tcp_plane_bench() -> dict:
                 }
             except Exception:
                 pass
+        _embed_final(result)
+        return result
+    except Exception as exc:
+        # Error path: same federated embed — the remote counters of a
+        # failed run are the artifact that shows what the worker host
+        # was doing when it died. Embed BEFORE the finally's shutdown
+        # removes the spool tree, then return the error result (main
+        # exits non-zero on any "error" key).
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        result.setdefault("error", f"{type(exc).__name__}: {exc}"[:300])
+        _embed_final(result)
         return result
     finally:
         try:

@@ -228,6 +228,18 @@ KNOBS: Tuple[Knob, ...] = (
          "RSDL_BENCH_XPROF_DIR)"),
     Knob("RSDL_PROFILE_TOP_N", "int", "20", "public",
          "default row count for /profile and rsdl_prof top tables"),
+    # -- spool-federation plane (ISSUE 19) ----------------------------------
+    Knob("RSDL_RELAY", "enum", "off", "public",
+         "cross-host telemetry federation (auto | off): non-head hosts "
+         "ship spool deltas to a driver-side sink over the authed "
+         "transport"),
+    Knob("RSDL_RELAY_PERIOD_S", "float", "0.5", "public",
+         "shipper period between ships (flush barriers kick it sooner)"),
+    Knob("RSDL_RELAY_MAX_BATCH_BYTES", "int", "4194304", "public",
+         "per-ship payload cap; the rest goes next cycle"),
+    Knob("RSDL_RELAY_MAX_LAG_BYTES", "int", "67108864", "public",
+         "per-file backlog bound — past it the shipper drops forward "
+         "to a line boundary and counts relay.dropped_bytes_total"),
     Knob("RSDL_STRESS_SEEDS", "int", "3", "internal",
          "seeds per stress-soak scenario"),
     Knob("RSDL_DRYRUN_MP", "enum", "on", "internal",

@@ -48,6 +48,7 @@ GATED_PLANES = {
         "obs_server",
         "runledger",
         "profiler",
+        "relay",
     )
 } | {
     f"{PACKAGE}.runtime.{m}"

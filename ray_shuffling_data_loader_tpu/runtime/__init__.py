@@ -126,6 +126,21 @@ class RuntimeContext:
             except Exception:
                 pass
         self._owned_actors.clear()
+        # Federation teardown AFTER the pool/actors (their exit
+        # barriers flushed spools) and BEFORE the rmtree: the shipper's
+        # final ship moves shutdown-time records to the driver while
+        # they still exist. sys.modules only — a session that never
+        # relayed must not import the plane to no-op its stop.
+        import sys as _sys
+
+        _relay = _sys.modules.get(
+            "ray_shuffling_data_loader_tpu.telemetry.relay"
+        )
+        if _relay is not None:
+            try:
+                _relay.stop()
+            except Exception:
+                pass
         self.cluster = None
         if self.owner:
             self.store.cleanup()
@@ -207,6 +222,23 @@ def _maybe_start_obs_server(ctx: RuntimeContext) -> None:
 
             logging.getLogger(__name__).warning(
                 "elastic control-loop bring-up failed", exc_info=True
+            )
+    # The spool-federation plane (ISSUE 19): head sessions serve the
+    # relay sink, non-head sessions run the shipper that tails the
+    # local spool trees. RSDL_RELAY=auto|off, env-gated BEFORE the
+    # import — unset means no relay module, no shipper thread, no sink
+    # socket anywhere in the session.
+    mode = os.environ.get("RSDL_RELAY", "").strip().lower()
+    if mode and mode not in ("off", "0", "false"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import relay
+
+            relay.maybe_start(ctx)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "relay bring-up failed", exc_info=True
             )
 
 

@@ -88,6 +88,18 @@ def _flush_telemetry_spools(maybe: bool = False) -> None:
             telemetry.export.maybe_flush()
         else:
             telemetry.export.safe_flush()
+    # Flush-then-SHIP (ISSUE 19): with the federation plane armed, wake
+    # this host's relay shipper so the records just flushed reach the
+    # driver at the same barrier. Env-gated BEFORE the import — relay
+    # off means the module is never loaded here.
+    _mode = os.environ.get("RSDL_RELAY", "").strip().lower()
+    if _mode and _mode not in ("off", "0", "false"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import relay
+
+            relay.kick()
+        except Exception:
+            pass
 
 
 # Virtual thread ids for traced dispatches: concurrent dispatches all run

@@ -232,6 +232,18 @@ def _flush_telemetry_spools() -> None:
             capacity.safe_flush()
         except Exception:
             pass
+    # Flush-then-SHIP (ISSUE 19): with the federation plane armed, wake
+    # this host's relay shipper so a remote worker's records are durable
+    # at the driver at the same task-done barrier local ones are.
+    # Env-gated BEFORE the import — relay off stays import-free.
+    _mode = os.environ.get("RSDL_RELAY", "").strip().lower()
+    if _mode and _mode not in ("off", "0", "false"):
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import relay
+
+            relay.kick()
+        except Exception:
+            pass
 
 
 def _worker_main(task_q, result_q, env: Dict[str, str]):

@@ -198,7 +198,12 @@ def load_records(max_age_s: Optional[float] = None) -> List[dict]:
     ``max_age_s``, records whose ``ts`` is older than ``now - max_age_s``
     are dropped (stale-source expiry: a process that stopped flushing —
     wedged, or from an abandoned run sharing the spool — no longer
-    contributes)."""
+    contributes). Comparing a record's ``ts`` against this process's
+    clock is only sound when writer and reader share a clock, which is
+    why the relay sink restamps cross-host records with the *receiver*
+    clock at arrival (``producer_ts`` keeps the original; see
+    ``telemetry.relay._restamp``) — a skewed remote clock can neither
+    falsely expire a live source nor keep a dead one alive."""
     out: List[dict] = []
     directory = spool_dir()
     if not directory or not os.path.isdir(directory):
@@ -266,13 +271,20 @@ def _merge_entry(cur: Dict[str, Any], new: Dict[str, Any], ts: float) -> None:
 
 
 def _with_source_label(
-    key: str, source: str, job: Optional[str] = None
+    key: str,
+    source: str,
+    job: Optional[str] = None,
+    host: Optional[str] = None,
 ) -> str:
-    """Inject ``source=<source>`` (and the source's ``job=`` identity,
-    when it has one and the key does not already carry a job label)
-    into a canonical snapshot key, keeping label order sorted (so the
-    result matches :func:`.metrics.format_key` output) and any
-    labeled-histogram name suffix in place."""
+    """Inject ``source=<source>`` (plus the source's ``job=`` and
+    ``host=`` identities, when it has them and the key does not already
+    carry those labels) into a canonical snapshot key, keeping label
+    order sorted (so the result matches :func:`.metrics.format_key`
+    output) and any labeled-histogram name suffix in place. The host
+    label is what makes a federated ``/metrics`` view attributable:
+    relayed records carry their cluster host id (ISSUE 19), so two
+    hosts' per-source series never collide even when their roles and
+    pids do."""
     brace, close = key.find("{"), key.rfind("}")
     if 0 <= brace < close:
         name, suffix = key[:brace], key[close + 1:]
@@ -280,14 +292,16 @@ def _with_source_label(
             tuple(part.partition("=")[::2])
             for part in key[brace + 1:close].split(",")
         ]
-        pairs.append(("source", source))
-        if job and all(k != "job" for k, _ in pairs):
-            pairs.append(("job", job))
-        inner = ",".join(f"{k}={v}" for k, v in sorted(pairs))
-        return f"{name}{{{inner}}}{suffix}"
-    if job:
-        return f"{key}{{job={job},source={source}}}"
-    return f"{key}{{source={source}}}"
+    else:
+        name, suffix = key, ""
+        pairs = []
+    pairs.append(("source", source))
+    if job and all(k != "job" for k, _ in pairs):
+        pairs.append(("job", job))
+    if host and all(k != "host" for k, _ in pairs):
+        pairs.append(("host", host))
+    inner = ",".join(f"{k}={v}" for k, v in sorted(pairs))
+    return f"{name}{{{inner}}}{suffix}"
 
 
 def labeled_sum(
@@ -331,6 +345,7 @@ def aggregate_typed(
         ts: float,
         source: Optional[str],
         job: Optional[str] = None,
+        host: Optional[str] = None,
     ) -> None:
         for key, entry in typed.items():
             cur = merged.get(key)
@@ -339,7 +354,7 @@ def aggregate_typed(
             else:
                 _merge_entry(cur, entry, ts)
             if per_source and source is not None:
-                skey = _with_source_label(key, source, job=job)
+                skey = _with_source_label(key, source, job=job, host=host)
                 merged[skey] = {**entry, "_ts": ts}
 
     for rec in load_records(max_age_s=max_age_s):
@@ -354,14 +369,14 @@ def aggregate_typed(
         label = f"{src.get('role', 'unknown')}-{src.get('pid', '0')}"
         fold(
             rec.get("metrics", {}), float(rec.get("ts", 0.0)), label,
-            job=src.get("job"),
+            job=src.get("job"), host=src.get("host"),
         )
     if include_local and _metrics.enabled():
         local = _metrics.registry.typed_snapshot()
         if local:
             fold(
                 local, time.time(), f"{me['role']}-{me['pid']}",
-                job=me.get("job"),
+                job=me.get("job"), host=me["host"],
             )
     return merged
 

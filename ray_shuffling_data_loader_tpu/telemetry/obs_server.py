@@ -275,7 +275,7 @@ def _healthz_body() -> dict:
     providers = _provider_snapshots()
     shuffle_snap = providers.get("shuffle") or {}
     queue_snap = providers.get("batch_queue") or {}
-    return {
+    body = {
         "ok": True,
         "pid": os.getpid(),
         "uptime_s": round(time.time() - (_started_ts or time.time()), 1),
@@ -288,6 +288,22 @@ def _healthz_body() -> dict:
         },
         "producer_alive": queue_snap.get("producer_alive"),
     }
+    # Federation freshness (ISSUE 19): with the relay plane armed, the
+    # sink reports each source host's last-shipped age so a dead remote
+    # relay is visible live (its sources above would otherwise just
+    # quietly stop refreshing). sys.modules only — a session that never
+    # relayed must not import the plane to report its absence.
+    import sys as _sys
+
+    relay_mod = _sys.modules.get(
+        "ray_shuffling_data_loader_tpu.telemetry.relay"
+    )
+    if relay_mod is not None:
+        try:
+            body["relay"] = relay_mod.status_section()
+        except Exception as exc:  # degraded, never a dead endpoint
+            body["relay"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return body
 
 
 def _status_body() -> dict:

@@ -1,0 +1,930 @@
+"""Cross-host telemetry federation: ship spools over the transport.
+
+Every observability plane in this repo aggregates by folding *file
+spools* under the session runtime dir — metrics snapshots
+(:mod:`.export`), the event log (:mod:`.events`), audit records
+(:mod:`.audit`), straggler task records (:mod:`.stragglers`), the
+capacity ledger (:mod:`.capacity`) and profile spools
+(:mod:`.profiler`). That fold is driver-local: a remote host that joins
+over TCP runs with its **own** runtime dir, so on a real pod without a
+shared filesystem the driver silently loses every remote worker's
+records. This module closes that gap without touching a single
+consumer: it federates the *files*, so ``export.aggregate()``, audit
+reconcile, the straggler/critical analyzers, the capacity fold and
+profile merges work unchanged — by construction — on split filesystems.
+
+Two halves, both owned by the session-owner process of their host:
+
+* **Sink** (cluster head / driver): a :class:`RelaySink` served as a
+  runtime actor on the existing authed TCP transport (the transport
+  layer runs its HMAC challenge for every inbound connection — the
+  relay inherits cluster auth for free). It materializes shipped
+  deltas under the driver's own spool tree, *namespaced by host*
+  (``events-<host>-<pid>.ndjson`` still matches every consumer's
+  prefix/suffix filter), restamps metrics snapshots with the
+  **receiver** clock (see :func:`_restamp` — producer wall clocks
+  cannot be trusted for ``max_age_s`` stale-source expiry), and
+  registers itself cluster-wide as the named actor
+  :data:`SINK_ACTOR_NAME`.
+
+* **Shipper** (every non-head host): a daemon thread that tails the
+  local spool trees and ships framed, CRC-checksummed deltas.
+  Append-only spools (NDJSON) ship as byte-offset deltas with
+  idempotent reconnect (the sink's ``hello`` reply reports how many
+  bytes of each namespaced file already landed; gaps and overlaps are
+  reconciled per ship); atomic-replace spools (metrics/profile JSON)
+  ship whole on content change. Buffering is bounded: past
+  ``RSDL_RELAY_MAX_LAG_BYTES`` the shipper drops forward to a line
+  boundary and counts ``relay.dropped_bytes_total`` — degraded, never
+  wrong. A shared filesystem is detected (``hello`` compares dev/ino
+  of the spool dirs) and those kinds are skipped rather than
+  double-counted, so the loopback two-host bench stays honest.
+
+Failure semantics are degraded-not-wrong: if the relay dies, remote
+sources go stale in ``/healthz`` (their last-shipped age grows), audit
+reconcile reports *incomplete* via the existing unshared-spool
+detection — never a false mismatch — and the shipper re-resolves the
+sink and resumes from the sink's byte cursors on reconnect.
+
+Zero-overhead off, like every gated plane: every wiring site checks
+``RSDL_RELAY`` *before* importing this module, so an unset env means no
+import, no shipper thread, no sink socket (proven by a fresh-interpreter
+test). Flush barriers (``runtime.tasks`` / ``runtime.actor``) extend to
+flush-then-ship through :func:`kick`: any process on the host touches
+the kick file after its spool flush and the shipper ships within its
+fast-poll interval, so remote records are durable at the driver at the
+same points local ones are.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+ENV_RELAY = "RSDL_RELAY"
+ENV_PERIOD = "RSDL_RELAY_PERIOD_S"
+ENV_MAX_BATCH = "RSDL_RELAY_MAX_BATCH_BYTES"
+ENV_MAX_LAG = "RSDL_RELAY_MAX_LAG_BYTES"
+
+# Cluster-wide name the sink registers under; shippers resolve it via
+# the cluster registry (re-resolved on every reconnect, so a restarted
+# driver picks up where the cursors say).
+SINK_ACTOR_NAME = "rsdl-relay-sink"
+
+_DEFAULT_PERIOD_S = 0.5
+_DEFAULT_MAX_BATCH = 4 * 1024 * 1024
+_DEFAULT_MAX_LAG = 64 * 1024 * 1024
+
+# A source host whose last ship is older than this is flagged stale in
+# /healthz (same spirit as obs_server._STALE_FLAG_S, but relays ship on
+# a sub-second period — silence means the shipper or its host is gone).
+_STALE_AFTER_S = 15.0
+
+# Spool kinds the relay federates: filename prefix/suffix (the filters
+# every consumer already applies) and the ship mode. Append-only kinds
+# ship byte deltas; replace kinds (atomic os.replace JSON snapshots)
+# ship whole files on content change.
+_KINDS: Dict[str, Tuple[str, str, str]] = {
+    "metrics": ("metrics-", ".json", "replace"),
+    "events": ("events-", ".ndjson", "append"),
+    "audit": ("audit-", ".jsonl", "append"),
+    "tasks": ("tasks-", ".ndjson", "append"),
+    "capacity": ("ledger-", ".ndjson", "append"),
+    "profiles": ("profile-", ".json", "replace"),
+}
+
+
+def enabled() -> bool:
+    """Is the federation plane armed in this process? ``RSDL_RELAY``
+    set to anything but off/0/false (``auto`` is the documented
+    value). Not cached — bring-up reads it once per session."""
+    mode = os.environ.get(ENV_RELAY, "").strip().lower()
+    return bool(mode) and mode not in ("off", "0", "false")
+
+
+def _period_s() -> float:
+    try:
+        return max(0.05, float(os.environ.get(ENV_PERIOD, "")))
+    except (TypeError, ValueError):
+        return _DEFAULT_PERIOD_S
+
+
+def _max_batch_bytes() -> int:
+    try:
+        return max(4096, int(os.environ.get(ENV_MAX_BATCH, "")))
+    except (TypeError, ValueError):
+        return _DEFAULT_MAX_BATCH
+
+
+def _max_lag_bytes() -> int:
+    try:
+        return max(4096, int(os.environ.get(ENV_MAX_LAG, "")))
+    except (TypeError, ValueError):
+        return _DEFAULT_MAX_LAG
+
+
+def _safe_host(host_id: str) -> str:
+    """Host id as a filename component (host ids look like
+    ``advertise:session`` — ``:`` is not filename-safe everywhere)."""
+    return re.sub(r"[^A-Za-z0-9._-]", "_", str(host_id)) or "host"
+
+
+def _spool_dirs() -> Dict[str, Optional[str]]:
+    """Each kind's spool dir as THIS process resolves it (sibling-plane
+    imports stay inside the gated module — the relay is itself a gated
+    plane, so importing the others here costs nothing when off)."""
+    out: Dict[str, Optional[str]] = {}
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import export
+
+        out["metrics"] = export.spool_dir()
+    except Exception:
+        out["metrics"] = None
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import events
+
+        out["events"] = events.spool_dir()
+    except Exception:
+        out["events"] = None
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import audit
+
+        out["audit"] = audit.spool_dir()
+    except Exception:
+        out["audit"] = None
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import stragglers
+
+        out["tasks"] = stragglers.spool_dir()
+    except Exception:
+        out["tasks"] = None
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import capacity
+
+        out["capacity"] = capacity.spool_dir()
+    except Exception:
+        out["capacity"] = None
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import profiler
+
+        out["profiles"] = profiler.spool_dir()
+    except Exception:
+        out["profiles"] = None
+    return out
+
+
+def _dir_fingerprints(
+    dirs: Optional[Dict[str, Optional[str]]] = None,
+) -> Dict[str, Tuple[int, int]]:
+    """(st_dev, st_ino) per existing spool dir — the shared-filesystem
+    detector: if a shipper's dir IS the sink's dir, shipping it would
+    double-count every record."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for kind, d in (dirs if dirs is not None else _spool_dirs()).items():
+        if d and os.path.isdir(d):
+            try:
+                st = os.stat(d)
+                out[kind] = (st.st_dev, st.st_ino)
+            except OSError:
+                pass
+    return out
+
+
+def _restamp(
+    data: bytes, host_id: str, now: float
+) -> Tuple[bytes, Optional[float]]:
+    """Receiver-restamp a relayed metrics snapshot.
+
+    ``export.load_records(max_age_s=...)`` expires stale sources by
+    comparing the record's ``ts`` to the *reader's* clock — correct
+    only while producer and reader share a clock. A relayed snapshot
+    crosses hosts, so the sink rewrites ``ts`` with its own clock at
+    arrival (the producer's goes to ``producer_ts`` for forensics): a
+    skewed-clock source is neither falsely expired (clock behind) nor
+    kept alive forever (clock ahead) — once ships stop, the file's
+    ``ts`` freezes at the last arrival and ages out naturally. The
+    source host is rewritten to the cluster host id, which both yields
+    a distinct ``host=`` label per host (even on loopback, where
+    ``socket.gethostname()`` collides) and keeps the aggregator's
+    skip-own-pid guard from eating a remote record on the same machine.
+    Returns ``(blob, skew_seconds)``; non-JSON payloads pass through.
+    """
+    try:
+        rec = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return data, None
+    if not isinstance(rec, dict):
+        return data, None
+    try:
+        producer_ts = float(rec.get("ts", 0.0))
+    except (TypeError, ValueError):
+        producer_ts = 0.0
+    rec["producer_ts"] = producer_ts
+    rec["ts"] = now
+    skew = (now - producer_ts) if producer_ts else None
+    src = rec.get("source")
+    if isinstance(src, dict):
+        src = dict(src)
+        src["host"] = host_id
+        src["relayed"] = True
+        rec["source"] = src
+    return json.dumps(rec).encode("utf-8"), skew
+
+
+class RelaySink:
+    """Driver-side half: materialize shipped spool deltas under the
+    driver's own spool tree. Served as a runtime actor (methods run on
+    the actor host's event loop; state is lock-guarded because
+    :func:`status_section` reads it from HTTP handler threads).
+    ``dirs`` overrides the env-resolved spool-dir map (tests run both
+    halves in one process, so they cannot share the process env)."""
+
+    def __init__(self, dirs: Optional[Dict[str, Optional[str]]] = None):
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, Dict[str, Any]] = {}
+        self._dirs_override = dirs
+
+    def _dirs(self) -> Dict[str, Optional[str]]:
+        if self._dirs_override is not None:
+            return self._dirs_override
+        return _spool_dirs()
+
+    def hello(
+        self, host_id: str, dir_ids: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Handshake: decide which kinds to skip (shared filesystem)
+        and report byte cursors for this host's already-landed append
+        files, so a reconnecting shipper resumes idempotently."""
+        dirs = self._dirs()
+        local = _dir_fingerprints(dirs)
+        skip = [
+            kind
+            for kind, did in (dir_ids or {}).items()
+            if did is not None and tuple(did) == local.get(kind)
+        ]
+        safe = _safe_host(host_id)
+        cursors: Dict[str, int] = {}
+        for kind, (pre, suf, mode) in _KINDS.items():
+            if mode != "append" or kind in skip:
+                continue
+            d = dirs.get(kind)
+            if not d or not os.path.isdir(d):
+                continue
+            marker = f"{pre}{safe}-"
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for fname in names:
+                if not (fname.startswith(marker) and fname.endswith(suf)):
+                    continue
+                orig = pre + fname[len(marker):]
+                try:
+                    cursors[f"{kind}/{orig}"] = os.path.getsize(
+                        os.path.join(d, fname)
+                    )
+                except OSError:
+                    pass
+        now = time.time()
+        with self._lock:
+            rec = self._hosts.setdefault(host_id, {})
+            rec.setdefault("ships", 0)
+            rec.setdefault("bytes", 0)
+            rec["hello_ts"] = now
+            rec["last_ship_ts"] = now
+            rec["skip"] = list(skip)
+        return {"skip": skip, "cursors": cursors}
+
+    def ship(
+        self, host_id: str, items: Optional[list]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Land a batch of deltas. Per item: verify the CRC, then
+        append at the sink's current size (``want`` bounces a gap back
+        to the shipper; an overlap after reconnect is trimmed — byte-
+        exact concatenation keeps NDJSON intact across mid-line ships)
+        or atomically replace (metrics snapshots restamped, see
+        :func:`_restamp`). An empty batch is the shipper's heartbeat —
+        it still refreshes the host's freshness clock."""
+        now = time.time()
+        dirs = self._dirs()
+        safe = _safe_host(host_id)
+        out: Dict[str, Dict[str, Any]] = {}
+        shipped = 0
+        skew: Optional[float] = None
+        for item in items or []:
+            kind = item.get("kind")
+            name = item.get("name")
+            key = f"{kind}/{name}"
+            data = item.get("data") or b""
+            if (zlib.crc32(data) & 0xFFFFFFFF) != item.get("crc"):
+                out[key] = {"error": "crc"}
+                self._count("relay.crc_errors_total")
+                continue
+            spec = _KINDS.get(kind)
+            d = dirs.get(kind)
+            if (
+                spec is None
+                or not d
+                or not isinstance(name, str)
+                or os.path.basename(name) != name
+                or not name.startswith(spec[0])
+                or not name.endswith(spec[1])
+            ):
+                # No local home (e.g. audit off at the driver) or a
+                # malformed name: ack so the shipper advances instead
+                # of wedging on an unroutable file — degraded, counted.
+                out[key] = {
+                    "acked": int(item.get("offset", 0) or 0) + len(data)
+                }
+                self._count("relay.unrouted_bytes_total", len(data))
+                continue
+            pre, _suf, mode = spec
+            try:
+                os.makedirs(d, exist_ok=True)
+                target = os.path.join(d, f"{pre}{safe}-{name[len(pre):]}")
+                if mode == "replace":
+                    blob = data
+                    if kind == "metrics":
+                        blob, skew = _restamp(data, host_id, now)
+                    tmp = f"{target}.tmp{os.getpid()}"
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                    os.replace(tmp, target)
+                    out[key] = {"acked": len(data)}
+                    shipped += len(data)
+                else:
+                    offset = int(item.get("offset", 0) or 0)
+                    try:
+                        cur = os.path.getsize(target)
+                    except OSError:
+                        cur = 0
+                    if offset > cur:
+                        out[key] = {"want": cur}
+                        continue
+                    if offset < cur:
+                        data = data[cur - offset:]
+                    if data:
+                        with open(target, "ab") as f:
+                            f.write(data)
+                        shipped += len(data)
+                    out[key] = {"acked": cur + len(data)}
+            except OSError as exc:
+                out[key] = {"error": str(exc)}
+        with self._lock:
+            rec = self._hosts.setdefault(host_id, {})
+            rec["last_ship_ts"] = now
+            rec["ships"] = rec.get("ships", 0) + 1
+            rec["bytes"] = rec.get("bytes", 0) + shipped
+            if skew is not None:
+                rec["skew_s"] = skew
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+            if metrics.enabled():
+                reg = metrics.registry
+                reg.counter("relay.ships_total", host=host_id).inc()
+                reg.counter(
+                    "relay.shipped_bytes_total", host=host_id
+                ).inc(shipped)
+                if skew is not None:
+                    reg.gauge("relay.skew_seconds", host=host_id).set(
+                        round(skew, 3)
+                    )
+        except Exception:
+            pass
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {h: dict(rec) for h, rec in self._hosts.items()}
+
+    @staticmethod
+    def _count(name: str, value: float = 1.0) -> None:
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+            if metrics.enabled():
+                metrics.registry.counter(name).inc(value)
+        except Exception:
+            pass
+
+
+class _SinkServer:
+    """Serve a :class:`RelaySink` as a runtime actor on a daemon thread
+    running its own event loop — the transport layer authenticates every
+    inbound connection with the cluster token, same as any actor."""
+
+    def __init__(
+        self,
+        bind_host: str,
+        dirs: Optional[Dict[str, Optional[str]]] = None,
+    ):
+        self.sink = RelaySink(dirs)
+        self.address: Optional[tuple] = None
+        self._bind_host = bind_host
+        self._loop = None
+        self._host = None
+        self._error: Optional[BaseException] = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rsdl-relay-sink", daemon=True
+        )
+
+    def _run(self) -> None:
+        import asyncio
+
+        from ray_shuffling_data_loader_tpu.runtime.actor import _ActorHost
+
+        async def _main():
+            host = _ActorHost(self.sink, ("tcp", self._bind_host, 0))
+            try:
+                await host.start()
+            except BaseException as exc:
+                self._error = exc
+                self._ready.set()
+                return
+            self._host = host
+            self._loop = asyncio.get_running_loop()
+            self.address = tuple(host.address)
+            self._ready.set()
+            await host.wait_shutdown()
+
+        asyncio.run(_main())
+
+    def start(self, timeout: float = 10.0) -> None:
+        self._thread.start()
+        if not self._ready.wait(timeout) or self.address is None:
+            raise RuntimeError(
+                f"relay sink failed to start: {self._error!r}"
+            )
+
+    def stop(self, timeout: float = 5.0) -> None:
+        loop, host = self._loop, self._host
+        if loop is not None and host is not None:
+            try:
+                loop.call_soon_threadsafe(host._shutdown.set)
+            except RuntimeError:
+                pass
+        self._thread.join(timeout)
+
+
+class _Shipper(threading.Thread):
+    """Worker-host half: tail the local spool trees, ship deltas.
+
+    ``resolve_sink`` is injected (the cluster's named-actor lookup in
+    production, a direct handle in tests) and re-invoked whenever the
+    sink connection is lost — reconnect replays the ``hello`` handshake
+    and resumes from the sink's cursors, so a driver-side restart or a
+    transient partition costs staleness, never duplication."""
+
+    def __init__(
+        self,
+        host_id: str,
+        runtime_dir: str,
+        resolve_sink,
+        dirs: Optional[Dict[str, Optional[str]]] = None,
+    ):
+        super().__init__(name="rsdl-relay-shipper", daemon=True)
+        self._host_id = host_id
+        self._runtime_dir = runtime_dir
+        self._resolve_sink = resolve_sink
+        self._dirs_override = dirs
+        self._stop = threading.Event()
+        self._sink = None
+        self._skip: set = set()
+        self._cursors: Dict[Tuple[str, str], int] = {}
+        # Ship offsets are SINK-space (the sink only ever appends at its
+        # file size; the offset is the gap/duplicate detector). Normally
+        # sink-space == producer-space; a drop-ahead breaks that, so the
+        # total dropped bytes per file are kept here and every
+        # offset/ack/cursor translates through it. The shift dies with
+        # this process — which is exactly the lifetime of the producer
+        # spool tree and the host's namespace, so nothing outlives it.
+        self._shift: Dict[Tuple[str, str], int] = {}
+        self._replace_sig: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._last_kick_ns = 0
+        self._last_own_flush = 0.0
+        # Introspection for /healthz (read cross-thread, plain floats).
+        self.lag_bytes = 0
+        self.dropped_bytes = 0
+        self.ship_errors = 0
+        self.ships = 0
+        self.shipped_bytes = 0
+        self.last_ship_ts = 0.0
+
+    def stop_and_join(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self.join(timeout)
+
+    def _local_dirs(self) -> Dict[str, Optional[str]]:
+        if self._dirs_override is not None:
+            return self._dirs_override
+        return _spool_dirs()
+
+    def run(self) -> None:
+        period = _period_s()
+        kick_path = os.path.join(self._runtime_dir, "relay", "kick")
+        last_ship = 0.0
+        while not self._stop.wait(0.05):
+            kicked = False
+            try:
+                ns = os.stat(kick_path).st_mtime_ns
+                if ns != self._last_kick_ns:
+                    self._last_kick_ns = ns
+                    kicked = True
+            except OSError:
+                pass
+            now = time.monotonic()
+            if kicked or now - last_ship >= period:
+                last_ship = now
+                self._cycle_guarded()
+        # Final flush-then-ship barrier: records written up to shutdown
+        # reach the driver before the session's dirs are torn down.
+        self._cycle_guarded()
+
+    def _cycle_guarded(self) -> None:
+        try:
+            self._ship_cycle()
+        except Exception:
+            # Sink gone or call failed: drop the handle, re-resolve and
+            # re-handshake next cycle (degraded — the driver sees this
+            # host's last-shipped age grow, never wrong data).
+            self._sink = None
+            self.ship_errors += 1
+            self._count("relay.ship_errors_total")
+
+    def _ensure_sink(self) -> bool:
+        if self._sink is not None:
+            return True
+        try:
+            handle = self._resolve_sink()
+        except Exception:
+            handle = None
+        if handle is None:
+            return False
+        reply = handle.call_with_timeout(
+            "hello",
+            self._host_id,
+            _dir_fingerprints(self._local_dirs()),
+            timeout=10.0,
+        )
+        self._skip = set(reply.get("skip") or ())
+        for key, size in (reply.get("cursors") or {}).items():
+            kind, _, name = key.partition("/")
+            k = (kind, name)
+            self._cursors[k] = int(size) + self._shift.get(k, 0)
+        self._sink = handle
+        return True
+
+    def _ship_cycle(self) -> None:
+        if not self._ensure_sink():
+            return
+        budget = _max_batch_bytes()
+        max_lag = _max_lag_bytes()
+        dirs = self._local_dirs()
+        items = []
+        sigs: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        lag_total = 0
+        for kind, (pre, suf, mode) in _KINDS.items():
+            if kind in self._skip:
+                continue
+            d = dirs.get(kind)
+            if not d or not os.path.isdir(d):
+                continue
+            try:
+                names = sorted(os.listdir(d))
+            except OSError:
+                continue
+            for fname in names:
+                if not (fname.startswith(pre) and fname.endswith(suf)):
+                    continue
+                path = os.path.join(d, fname)
+                key = (kind, fname)
+                if mode == "append":
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    cur = self._cursors.get(key, 0)
+                    if size < cur:
+                        cur = 0  # truncated behind us: restart
+                        self._shift.pop(key, None)
+                    if size - cur > max_lag:
+                        # Bounded buffering: drop forward to a line
+                        # boundary and say so, loudly. The dropped
+                        # bytes widen this file's sink-space shift —
+                        # the sink keeps appending contiguously.
+                        newcur = _line_boundary(path, size - max_lag)
+                        if newcur > cur:
+                            dropped = newcur - cur
+                            self.dropped_bytes += dropped
+                            self._shift[key] = (
+                                self._shift.get(key, 0) + dropped
+                            )
+                            self._count(
+                                "relay.dropped_bytes_total", dropped
+                            )
+                            self._emit_dropped(kind, fname, dropped)
+                            cur = newcur
+                    self._cursors[key] = cur
+                    take = min(size - cur, budget)
+                    if take <= 0:
+                        lag_total += max(0, size - cur)
+                        continue
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(cur)
+                            data = f.read(take)
+                    except OSError:
+                        continue
+                    if not data:
+                        continue
+                    budget -= len(data)
+                    lag_total += max(0, size - cur - len(data))
+                    items.append(
+                        {
+                            "kind": kind,
+                            "name": fname,
+                            "mode": "append",
+                            "offset": cur - self._shift.get(key, 0),
+                            "data": data,
+                            "crc": zlib.crc32(data) & 0xFFFFFFFF,
+                        }
+                    )
+                else:
+                    if budget <= 0:
+                        continue
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        continue
+                    sig = (st.st_mtime_ns, st.st_size)
+                    if self._replace_sig.get(key) == sig:
+                        continue
+                    try:
+                        with open(path, "rb") as f:
+                            data = f.read()
+                    except OSError:
+                        continue
+                    budget -= len(data)
+                    sigs[key] = sig
+                    items.append(
+                        {
+                            "kind": kind,
+                            "name": fname,
+                            "mode": "replace",
+                            "offset": 0,
+                            "data": data,
+                            "crc": zlib.crc32(data) & 0xFFFFFFFF,
+                        }
+                    )
+        self.lag_bytes = lag_total
+        self._set_gauge("relay.lag_bytes", float(lag_total))
+        reply = self._sink.call_with_timeout(
+            "ship", self._host_id, items, timeout=30.0
+        )
+        self.last_ship_ts = time.time()
+        self.ships += 1
+        for item in items:
+            key = (item["kind"], item["name"])
+            res = (reply or {}).get(f"{item['kind']}/{item['name']}") or {}
+            if item["mode"] == "append":
+                shift = self._shift.get(key, 0)
+                if "acked" in res:
+                    self._cursors[key] = int(res["acked"]) + shift
+                    self.shipped_bytes += len(item["data"])
+                elif "want" in res:
+                    self._cursors[key] = int(res["want"]) + shift
+            elif "acked" in res and key in sigs:
+                self._replace_sig[key] = sigs[key]
+                self.shipped_bytes += len(item["data"])
+        # Spool our own relay.* instruments (rate-limited) so the
+        # shipper's health federates through the very channel it runs.
+        now = time.monotonic()
+        if items and now - self._last_own_flush > 1.0:
+            self._last_own_flush = now
+            try:
+                from ray_shuffling_data_loader_tpu.telemetry import export
+
+                export.maybe_flush()
+            except Exception:
+                pass
+
+    def _emit_dropped(self, kind: str, fname: str, nbytes: int) -> None:
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import (
+                events,
+                metrics,
+            )
+
+            if metrics.enabled():
+                events.emit(
+                    "relay.dropped", spool=kind, file=fname, bytes=nbytes
+                )
+        except Exception:
+            pass
+
+    @staticmethod
+    def _count(name: str, value: float = 1.0) -> None:
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+            if metrics.enabled():
+                metrics.registry.counter(name).inc(value)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _set_gauge(name: str, value: float) -> None:
+        try:
+            from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+            if metrics.enabled():
+                metrics.registry.gauge(name).set(value)
+        except Exception:
+            pass
+
+
+def _line_boundary(path: str, target: int) -> int:
+    """First offset at/after ``target`` that starts a fresh NDJSON line
+    (drop-ahead must not leave a torn half-record at the cut)."""
+    target = max(0, target)
+    try:
+        with open(path, "rb") as f:
+            f.seek(target)
+            chunk = f.read(1 << 16)
+    except OSError:
+        return target
+    nl = chunk.find(b"\n")
+    return target + nl + 1 if nl >= 0 else target
+
+
+# ---------------------------------------------------------------------------
+# Session lifecycle (wired from runtime bring-up / shutdown)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_sink_server: Optional[_SinkServer] = None
+_shipper: Optional[_Shipper] = None
+
+_KICK_MIN_INTERVAL_S = 0.05
+_last_kick = 0.0
+
+
+def maybe_start(ctx) -> None:
+    """Bring up this host's half of the federation plane (idempotent;
+    session-owner processes only — pool workers on the same host write
+    spools under the same runtime dir and the one shipper tails them
+    all). Head session → sink + cluster-wide name; non-head session →
+    shipper. A standalone session (no cluster) has nothing to federate.
+    """
+    global _sink_server, _shipper
+    if not enabled() or not getattr(ctx, "owner", False):
+        return
+    cluster = getattr(ctx, "cluster", None)
+    if cluster is None:
+        return
+    with _lock:
+        if cluster.is_head:
+            if _sink_server is not None:
+                return
+            server = _SinkServer(cluster.advertise_host)
+            server.start()
+            from ray_shuffling_data_loader_tpu.runtime.actor import (
+                ActorHandle,
+            )
+
+            try:
+                cluster.register_named_actor(
+                    SINK_ACTOR_NAME,
+                    ActorHandle(server.address, pid=os.getpid()),
+                )
+            except Exception:
+                server.stop()
+                raise
+            try:
+                ctx._owned_names.append(SINK_ACTOR_NAME)
+            except Exception:
+                pass
+            _sink_server = server
+        else:
+            if _shipper is not None:
+                return
+            shipper = _Shipper(
+                cluster.host_id,
+                ctx.runtime_dir,
+                lambda: cluster.lookup_named_actor(SINK_ACTOR_NAME),
+            )
+            shipper.start()
+            _shipper = shipper
+
+
+def stop() -> None:
+    """Tear down whichever half runs here. The shipper performs one
+    final flush-then-ship cycle on its way out (the actor/task barriers
+    already flushed the spools), so shutdown-time records reach the
+    driver before the session dirs are removed. Idempotent."""
+    global _sink_server, _shipper
+    with _lock:
+        shipper, _shipper = _shipper, None
+        server, _sink_server = _sink_server, None
+    if shipper is not None:
+        shipper.stop_and_join()
+    if server is not None:
+        server.stop()
+
+
+def kick() -> None:
+    """Flush-then-ship barrier hook: touch the shipper's wake file.
+
+    Called (env-gated BEFORE the import, see the barriers in
+    ``runtime.tasks`` / ``runtime.actor``) right after a local spool
+    flush at task-done and actor quiesce/exit, from ANY process on the
+    host — the shipper fast-polls the file's mtime, so a remote
+    worker's records are durable at the driver at the same points local
+    ones are. Rate-limited, never raises, no-op off-cluster (the file
+    sits unwatched)."""
+    global _last_kick
+    now = time.monotonic()
+    if now - _last_kick < _KICK_MIN_INTERVAL_S:
+        return
+    _last_kick = now
+    runtime_dir = os.environ.get("RSDL_RUNTIME_DIR")
+    if not runtime_dir:
+        return
+    path = os.path.join(runtime_dir, "relay", "kick")
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "ab"):
+            pass
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
+def status_section() -> Dict[str, Any]:
+    """The ``/healthz`` ``relay`` section: which half runs here and, on
+    the sink, per-source-host freshness (last-shipped age — a dead
+    relay is visible live, not just post-hoc)."""
+    now = time.time()
+    out: Dict[str, Any] = {"role": None, "hosts": {}}
+    server = _sink_server
+    if server is not None:
+        out["role"] = "sink"
+        out["address"] = list(server.address) if server.address else None
+        for host_id, rec in server.sink.snapshot().items():
+            age = now - float(rec.get("last_ship_ts", 0.0) or 0.0)
+            out["hosts"][host_id] = {
+                "age_s": round(age, 1),
+                "stale": age > _STALE_AFTER_S,
+                "ships": rec.get("ships", 0),
+                "bytes": rec.get("bytes", 0),
+                "skew_s": round(float(rec.get("skew_s", 0.0)), 3),
+                "skipped_kinds": rec.get("skip", []),
+            }
+    shipper = _shipper
+    if shipper is not None:
+        out["role"] = "shipper"
+        out["shipper"] = {
+            "connected": shipper._sink is not None,
+            "ships": shipper.ships,
+            "shipped_bytes": shipper.shipped_bytes,
+            "lag_bytes": shipper.lag_bytes,
+            "dropped_bytes": shipper.dropped_bytes,
+            "ship_errors": shipper.ship_errors,
+            "last_ship_age_s": (
+                round(now - shipper.last_ship_ts, 1)
+                if shipper.last_ship_ts
+                else None
+            ),
+        }
+    return out
+
+
+def publish_metrics() -> None:
+    """Refresh the sink's per-host freshness gauges (driven from the
+    timeseries sampler tick, like the other derived-gauge planes)."""
+    server = _sink_server
+    if server is None:
+        return
+    try:
+        from ray_shuffling_data_loader_tpu.telemetry import metrics
+
+        if not metrics.enabled():
+            return
+        now = time.time()
+        hosts = server.sink.snapshot()
+        reg = metrics.registry
+        reg.gauge("relay.sources").set(float(len(hosts)))
+        for host_id, rec in hosts.items():
+            age = now - float(rec.get("last_ship_ts", now) or now)
+            reg.gauge(
+                "relay.last_ship_age_seconds", host=host_id
+            ).set(round(age, 1))
+    except Exception:
+        pass

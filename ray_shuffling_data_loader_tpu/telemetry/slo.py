@@ -233,6 +233,20 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "only_in_flight": True,
         "severity": "warn",
     },
+    {
+        # A relay shipper is falling behind its local spools by a
+        # sustained margin (telemetry.relay publishes its backlog as
+        # relay.lag_bytes): the driver's federated view is going stale
+        # — and past RSDL_RELAY_MAX_LAG_BYTES records start being
+        # dropped. Threshold rules never fire on a missing metric, so
+        # relay-off sessions are untouched.
+        "name": "relay_lagging",
+        "kind": "threshold",
+        "metric": "relay.lag_bytes",
+        "op": ">", "value": 8.0 * 1024 * 1024,
+        "for_s": 10.0,
+        "severity": "warn",
+    },
 ]
 
 _HISTORY_CAP = 64

@@ -379,6 +379,19 @@ def start(period: Optional[float] = None) -> None:
                 except Exception:
                     pass
                 try:
+                    # Relay freshness gauges (ISSUE 19): sys.modules
+                    # only — the sampler must not import the federation
+                    # plane on sessions that never relayed.
+                    import sys as _sys
+
+                    _relay = _sys.modules.get(
+                        "ray_shuffling_data_loader_tpu.telemetry.relay"
+                    )
+                    if _relay is not None:
+                        _relay.publish_metrics()
+                except Exception:
+                    pass
+                try:
                     sample_now()
                 except Exception:
                     pass  # telemetry must never sink anything

@@ -206,6 +206,17 @@ if [ "$tier" != "slow" ]; then
   # job=-filtered /events must return the tenant's stamped events (and
   # nothing for a bogus id).
   RSDL_METRICS=1 python tools/obs_smoke.py
+  # Relay lane (ISSUE 19): cross-host telemetry federation. The unit
+  # suite proves the protocol (receiver restamping for clock-skew
+  # safety, CRC/gap/overlap idempotency, shared-filesystem skip,
+  # bounded drop-ahead, sink-death degradation) and the federation
+  # smoke is the live gate: a second host process joins over TCP with
+  # NO shared spool tree and the driver's /metrics must show >= 2
+  # distinct host= labels MID-FLIGHT with a fresh relay source on
+  # /healthz (exit-code gated; the two-host no-shared-spool chaos
+  # acceptance test runs in the slow tier).
+  RSDL_METRICS=1 python -m pytest tests/test_relay.py -m "not slow" -q -x
+  RSDL_METRICS=1 python tools/obs_smoke.py --federation > /dev/null
   # Profile lane (ISSUE 17): the continuous sampling profiler armed
   # across the core data-path + profiler suites — every process (driver,
   # task workers, actor hosts) runs the sampler daemon and spools, and

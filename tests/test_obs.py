@@ -151,10 +151,15 @@ def test_per_source_breakdown_labels(metrics_spool):
             "q.depth{epoch=0,rank=1}": {"kind": "gauge", "value": 4.0},
         },
     )
+    host = socket.gethostname()
     flat = export.aggregate(per_source=True)
-    assert flat["work.rows{source=task-111111}"] == 3.0
-    # Labeled keys keep canonical sorted label order with source added.
-    assert flat["q.depth{epoch=0,rank=1,source=task-111111}"] == 4.0
+    assert flat[f"work.rows{{host={host},source=task-111111}}"] == 3.0
+    # Labeled keys keep canonical sorted label order with the source's
+    # identity (source= and, since the federation plane, host=) added.
+    assert (
+        flat[f"q.depth{{epoch=0,host={host},rank=1,source=task-111111}}"]
+        == 4.0
+    )
 
 
 def test_flush_writes_identity_stamped_record(metrics_spool):
@@ -515,9 +520,11 @@ def test_endpoint_smoke_mid_flight_shuffle(metrics_spool, tmp_path):
         assert merged["rsdl_shuffle_map_rows"] == total_rows
         assert merged["rsdl_shuffle_reduce_rows"] == total_rows
         assert "# TYPE rsdl_shuffle_map_rows counter" in text
-        # Per-source breakdown preserved as labels.
+        # Per-source breakdown preserved as labels (host= rides along
+        # since the federation plane — ISSUE 19).
         assert any(
-            name.startswith("rsdl_shuffle_map_rows{source=")
+            name.startswith("rsdl_shuffle_map_rows{")
+            and "source=" in name
             for name in merged
         )
 

@@ -1,0 +1,760 @@
+"""Cross-host telemetry federation tests (ISSUE 19).
+
+Fast tier: the relay's pure mechanics — receiver restamping of relayed
+metrics snapshots (clock-skew safety both directions), sink append
+idempotency (CRC, gaps, overlap trim after reconnect), the shared-
+filesystem skip handshake, cursor resume, bounded buffering
+(drop-ahead to a line boundary), and a full shipper→sink round trip
+over the real authed TCP transport inside one process (both halves take
+an explicit spool-dir map exactly so this test can split them without
+splitting the process env).
+
+Slow tier: the zero-overhead-off proof (fresh interpreter, RSDL_ off:
+no relay import, no thread, no socket) and the ISSUE's headline
+scenario — two real host processes on localhost with DISJOINT spool
+trees (no shared filesystem) running a faulty shuffle, asserting the
+driver's observability plane sees the remote host: federated metrics
+sources, remote straggler records, a complete audit (ok=True, the
+strict gate — not the unshared-spool "incomplete" verdict), remote
+profile frames, and a live /healthz relay section.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+slow = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ray_shuffling_data_loader_tpu.telemetry import relay
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _item(kind, name, data, offset=0, mode="append", crc=None):
+    return {
+        "kind": kind,
+        "name": name,
+        "mode": mode,
+        "offset": offset,
+        "data": data,
+        "crc": _crc(data) if crc is None else crc,
+    }
+
+
+def _mkdirs(root, kinds=("metrics", "events", "audit", "tasks",
+                         "capacity", "profiles")):
+    out = {}
+    for kind in kinds:
+        d = os.path.join(str(root), kind)
+        os.makedirs(d, exist_ok=True)
+        out[kind] = d
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Receiver restamping (clock-skew safety — the satellite-5 regression)
+# ---------------------------------------------------------------------------
+
+
+def test_restamp_behind_clock_source_not_falsely_expired(
+    tmp_path, monkeypatch
+):
+    """A live remote source whose wall clock runs far BEHIND the
+    driver's writes snapshots that look ancient. Restamping with the
+    receiver clock at arrival keeps it inside any sane ``max_age_s``
+    window — the source is live, so it must contribute."""
+    from ray_shuffling_data_loader_tpu.telemetry import export
+
+    now = time.time()
+    rec = {
+        "source": {"role": "actor", "host": "wk", "pid": 7},
+        "ts": now - 3600.0,  # producer clock an hour behind
+        "metrics": {"x{}": {"kind": "counter", "value": 1.0}},
+    }
+    blob, skew = relay._restamp(
+        json.dumps(rec).encode(), "10.0.0.2:abcd", now
+    )
+    out = json.loads(blob.decode())
+    assert out["ts"] == pytest.approx(now)
+    assert out["producer_ts"] == pytest.approx(now - 3600.0)
+    assert skew == pytest.approx(3600.0)
+    assert out["source"]["host"] == "10.0.0.2:abcd"
+    assert out["source"]["relayed"] is True
+    assert out["metrics"] == rec["metrics"]
+
+    spool = tmp_path / "metrics"
+    spool.mkdir()
+    (spool / "metrics-actor-7.json").write_bytes(blob)
+    monkeypatch.setenv("RSDL_METRICS_DIR", str(spool))
+    assert len(export.load_records(max_age_s=60.0)) == 1
+
+
+def test_restamp_ahead_clock_source_still_ages_out(tmp_path, monkeypatch):
+    """A DEAD source whose clock ran AHEAD would, unstamped, stay under
+    ``max_age_s`` forever. Restamped at arrival, the file's ts freezes
+    at the last ship and ages out like any local source."""
+    from ray_shuffling_data_loader_tpu.telemetry import export
+
+    arrival = time.time() - 120.0  # last ship landed two minutes ago
+    rec = {
+        "source": {"role": "task", "host": "wk", "pid": 9},
+        "ts": time.time() + 3600.0,  # producer clock an hour ahead
+        "metrics": {"y{}": {"kind": "gauge", "value": 2.0}},
+    }
+    blob, _ = relay._restamp(
+        json.dumps(rec).encode(), "10.0.0.3:beef", arrival
+    )
+    spool = tmp_path / "metrics"
+    spool.mkdir()
+    (spool / "metrics-task-9.json").write_bytes(blob)
+    monkeypatch.setenv("RSDL_METRICS_DIR", str(spool))
+    assert export.load_records(max_age_s=60.0) == []
+    # Forensics survive: the producer's own clock is kept.
+    kept = json.loads((spool / "metrics-task-9.json").read_bytes())
+    assert kept["producer_ts"] > time.time()
+
+
+def test_restamp_non_json_passes_through():
+    blob, skew = relay._restamp(b"\x00not-json", "h:1", time.time())
+    assert blob == b"\x00not-json"
+    assert skew is None
+
+
+# ---------------------------------------------------------------------------
+# Sink mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_sink_append_idempotent_overlap_and_gap(tmp_path):
+    """Byte-exact concatenation under re-ships and reconnects: a
+    duplicate delta is trimmed (no double records), a gap is bounced
+    back with the sink's cursor (``want``), and the landed file is
+    namespaced by source host so consumers' prefix filters match."""
+    dirs = _mkdirs(tmp_path)
+    sink = relay.RelaySink(dirs=dirs)
+    host = "10.0.0.2:abcd"
+    l1 = b'{"n":1}\n'
+    l2 = b'{"n":2}\n'
+
+    res = sink.ship(host, [_item("events", "events-42.ndjson", l1)])
+    assert res["events/events-42.ndjson"] == {"acked": len(l1)}
+    target = os.path.join(
+        dirs["events"], "events-10.0.0.2_abcd-42.ndjson"
+    )
+    assert open(target, "rb").read() == l1
+
+    # Exact duplicate (shipper retried before seeing the ack): trimmed.
+    res = sink.ship(host, [_item("events", "events-42.ndjson", l1)])
+    assert res["events/events-42.ndjson"] == {"acked": len(l1)}
+    assert open(target, "rb").read() == l1
+
+    # Gap (sink lost the file, shipper is ahead): bounced, not landed.
+    res = sink.ship(
+        host, [_item("events", "events-42.ndjson", l2, offset=100)]
+    )
+    assert res["events/events-42.ndjson"] == {"want": len(l1)}
+    assert open(target, "rb").read() == l1
+
+    # Partial overlap: ship [0, l1+l2) again — only the tail appends.
+    res = sink.ship(
+        host, [_item("events", "events-42.ndjson", l1 + l2, offset=0)]
+    )
+    assert res["events/events-42.ndjson"] == {"acked": len(l1 + l2)}
+    assert open(target, "rb").read() == l1 + l2
+
+    snap = sink.snapshot()
+    assert snap[host]["ships"] == 4
+    assert snap[host]["bytes"] == len(l1 + l2)
+
+
+def test_sink_rejects_bad_crc_and_contains_bad_names(tmp_path):
+    dirs = _mkdirs(tmp_path)
+    sink = relay.RelaySink(dirs=dirs)
+    res = sink.ship(
+        "h:1",
+        [_item("events", "events-1.ndjson", b'{"a":1}\n', crc=123)],
+    )
+    assert res["events/events-1.ndjson"] == {"error": "crc"}
+    assert os.listdir(dirs["events"]) == []
+
+    # A name trying to escape the spool dir (or not matching the kind's
+    # prefix/suffix) is acked-and-dropped, never written.
+    evil = "../events-1.ndjson"
+    res = sink.ship("h:1", [_item("events", evil, b"x\n")])
+    assert res[f"events/{evil}"] == {"acked": 2}
+    assert os.listdir(dirs["events"]) == []
+    assert not os.path.exists(os.path.join(str(tmp_path), "events-1.ndjson"))
+
+    # A kind with no local home (audit off at the driver): acked so the
+    # shipper advances — degraded, not wedged.
+    dirs2 = dict(dirs, audit=None)
+    sink2 = relay.RelaySink(dirs=dirs2)
+    res = sink2.ship("h:1", [_item("audit", "audit-1.jsonl", b"y\n")])
+    assert res["audit/audit-1.jsonl"] == {"acked": 2}
+
+
+def test_sink_replace_restamps_metrics_snapshot(tmp_path):
+    dirs = _mkdirs(tmp_path)
+    sink = relay.RelaySink(dirs=dirs)
+    rec = {
+        "source": {"role": "task", "host": "wk", "pid": 5},
+        "ts": 17.0,
+        "metrics": {"m{}": {"kind": "counter", "value": 3.0}},
+    }
+    blob = json.dumps(rec).encode()
+    res = sink.ship(
+        "10.0.0.9:cafe",
+        [_item("metrics", "metrics-task-5.json", blob, mode="replace")],
+    )
+    assert res["metrics/metrics-task-5.json"] == {"acked": len(blob)}
+    target = os.path.join(
+        dirs["metrics"], "metrics-10.0.0.9_cafe-task-5.json"
+    )
+    landed = json.loads(open(target).read())
+    assert landed["ts"] == pytest.approx(time.time(), abs=30)
+    assert landed["producer_ts"] == 17.0
+    assert landed["source"]["host"] == "10.0.0.9:cafe"
+    assert landed["source"]["relayed"] is True
+    assert sink.snapshot()["10.0.0.9:cafe"]["skew_s"] > 0
+
+
+def test_hello_skips_shared_dirs_and_reports_cursors(tmp_path):
+    """The handshake: kinds whose spool dir IS the sink's dir (shared
+    filesystem — dev/ino match) are skipped so nothing double-counts,
+    and already-landed append files come back as byte cursors so a
+    reconnecting shipper resumes instead of re-shipping."""
+    sink_dirs = _mkdirs(tmp_path / "driver")
+    worker_dirs = _mkdirs(tmp_path / "worker")
+    sink = relay.RelaySink(dirs=sink_dirs)
+    host = "10.0.0.2:abcd"
+
+    # Pre-land 2 lines, as a prior connection would have.
+    sink.ship(host, [_item("tasks", "tasks-77.ndjson", b"a\nb\n")])
+
+    shared = dict(worker_dirs, events=sink_dirs["events"])
+    reply = sink.hello(host, relay._dir_fingerprints(shared))
+    assert reply["skip"] == ["events"]
+    assert reply["cursors"] == {"tasks/tasks-77.ndjson": 4}
+
+    # Fully disjoint dirs: nothing skipped.
+    reply = sink.hello(host, relay._dir_fingerprints(worker_dirs))
+    assert reply["skip"] == []
+
+
+# ---------------------------------------------------------------------------
+# Shipper → sink over the real transport (one process, split dirs)
+# ---------------------------------------------------------------------------
+
+
+def test_shipper_end_to_end_over_tcp(tmp_path):
+    """Full round trip on the real actor transport: append deltas land
+    byte-exact and namespaced, replace snapshots land restamped,
+    incremental ships append only the tail, and a fresh shipper
+    (reconnect) resumes from the hello cursors without duplicating a
+    byte."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
+
+    sink_dirs = _mkdirs(tmp_path / "driver")
+    worker_dirs = _mkdirs(tmp_path / "worker")
+    host_id = "127.0.0.1:e2e0"
+
+    ev = os.path.join(worker_dirs["events"], "events-11.ndjson")
+    with open(ev, "w") as f:
+        f.write('{"e":1}\n{"e":2}\n')
+    mt = os.path.join(worker_dirs["metrics"], "metrics-task-11.json")
+    with open(mt, "w") as f:
+        json.dump({"source": {"host": "wk", "pid": 11}, "ts": 1.0,
+                   "metrics": {}}, f)
+
+    server = relay._SinkServer("127.0.0.1", dirs=sink_dirs)
+    server.start()
+    try:
+        def mk_shipper():
+            return relay._Shipper(
+                host_id,
+                str(tmp_path / "rt"),
+                lambda: ActorHandle(server.address),
+                dirs=worker_dirs,
+            )
+
+        shipper = mk_shipper()
+        shipper._ship_cycle()  # direct drive: no thread, no timing
+        landed_ev = os.path.join(
+            sink_dirs["events"], "events-127.0.0.1_e2e0-11.ndjson"
+        )
+        assert open(landed_ev).read() == '{"e":1}\n{"e":2}\n'
+        landed_mt = os.path.join(
+            sink_dirs["metrics"], "metrics-127.0.0.1_e2e0-task-11.json"
+        )
+        assert json.load(open(landed_mt))["source"]["host"] == host_id
+        assert shipper.ships == 1
+        assert shipper.shipped_bytes > 0
+        assert shipper.lag_bytes == 0
+
+        # Incremental: one more line, one unchanged snapshot → only the
+        # delta ships (the replace signature suppresses the re-send).
+        with open(ev, "a") as f:
+            f.write('{"e":3}\n')
+        before = shipper.shipped_bytes
+        shipper._ship_cycle()
+        assert open(landed_ev).read() == '{"e":1}\n{"e":2}\n{"e":3}\n'
+        assert shipper.shipped_bytes - before == len('{"e":3}\n')
+
+        # Reconnect: a brand-new shipper (driver restart symmetric case
+        # — all cursors lost) hellos, resumes, and duplicates nothing.
+        shipper2 = mk_shipper()
+        shipper2._ship_cycle()
+        assert open(landed_ev).read() == '{"e":1}\n{"e":2}\n{"e":3}\n'
+        assert shipper2.ship_errors == 0
+
+        # The sink saw exactly one source host, fresh.
+        snap = server.sink.snapshot()
+        assert list(snap) == [host_id]
+    finally:
+        server.stop()
+
+
+def test_shipper_drop_ahead_is_bounded_and_line_aligned(
+    tmp_path, monkeypatch
+):
+    """Bounded buffering: a spool far beyond ``RSDL_RELAY_MAX_LAG_BYTES``
+    is dropped forward to a line boundary (no torn records at the
+    driver), the drop is counted, and repeated cycles drain the rest."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
+
+    monkeypatch.setenv("RSDL_RELAY_MAX_LAG_BYTES", "8192")
+    monkeypatch.setenv("RSDL_RELAY_MAX_BATCH_BYTES", "4096")
+
+    sink_dirs = _mkdirs(tmp_path / "driver")
+    worker_dirs = _mkdirs(tmp_path / "worker")
+    src = os.path.join(worker_dirs["tasks"], "tasks-5.ndjson")
+    with open(src, "w") as f:
+        for i in range(1500):
+            f.write(json.dumps({"i": i, "pad": "x" * 20}) + "\n")
+    src_bytes = open(src, "rb").read()
+    assert len(src_bytes) > 3 * 8192
+
+    server = relay._SinkServer("127.0.0.1", dirs=sink_dirs)
+    server.start()
+    try:
+        shipper = relay._Shipper(
+            "127.0.0.1:lag0",
+            str(tmp_path / "rt"),
+            lambda: ActorHandle(server.address),
+            dirs=worker_dirs,
+        )
+        for _ in range(40):
+            shipper._ship_cycle()
+            if shipper.lag_bytes == 0 and shipper.ships > 1:
+                break
+        assert shipper.lag_bytes == 0
+        assert shipper.dropped_bytes > 0
+        landed = open(
+            os.path.join(
+                sink_dirs["tasks"], "tasks-127.0.0.1_lag0-5.ndjson"
+            ),
+            "rb",
+        ).read()
+        # Exactly the source's tail, starting on a fresh line.
+        dropped = len(src_bytes) - len(landed)
+        assert dropped == shipper.dropped_bytes
+        assert src_bytes[dropped:] == landed
+        assert src_bytes[dropped - 1:dropped] == b"\n"
+        for line in landed.splitlines():
+            json.loads(line)  # every landed record parses
+    finally:
+        server.stop()
+
+
+def test_shipper_survives_sink_death_and_reresolves(tmp_path):
+    """Relay death is degraded-not-wrong: cycles against a dead sink
+    count ship_errors (→ /healthz, relay.ship_errors_total) and the
+    shipper re-resolves; a new sink at a new address picks the stream
+    back up from its hello cursors."""
+    from ray_shuffling_data_loader_tpu.runtime.actor import ActorHandle
+
+    sink_dirs = _mkdirs(tmp_path / "driver")
+    worker_dirs = _mkdirs(tmp_path / "worker")
+    ev = os.path.join(worker_dirs["events"], "events-3.ndjson")
+    with open(ev, "w") as f:
+        f.write("a\n")
+
+    current = {"server": relay._SinkServer("127.0.0.1", dirs=sink_dirs)}
+    current["server"].start()
+    shipper = relay._Shipper(
+        "127.0.0.1:die0",
+        str(tmp_path / "rt"),
+        lambda: ActorHandle(current["server"].address),
+        dirs=worker_dirs,
+    )
+    shipper._ship_cycle()
+    assert shipper.ships == 1
+
+    current["server"].stop()
+    with open(ev, "a") as f:
+        f.write("b\n")
+    shipper._cycle_guarded()  # dead sink: guarded, counted, no raise
+    assert shipper.ship_errors == 1
+    assert shipper._sink is None
+
+    current["server"] = relay._SinkServer("127.0.0.1", dirs=sink_dirs)
+    current["server"].start()
+    try:
+        shipper._ship_cycle()
+        landed = os.path.join(
+            sink_dirs["events"], "events-127.0.0.1_die0-3.ndjson"
+        )
+        assert open(landed).read() == "a\nb\n"
+    finally:
+        current["server"].stop()
+
+
+# ---------------------------------------------------------------------------
+# Zero overhead off (fresh interpreter)
+# ---------------------------------------------------------------------------
+
+
+@slow
+def test_relay_off_never_imports_plane(tmp_path):
+    """RSDL_RELAY unset: a fresh interpreter running a whole shuffle
+    never imports the relay module, starts no shipper/sink thread, and
+    leaves no kick file — the zero-overhead contract every gated plane
+    in this repo proves the same way."""
+    code = """
+import os, sys, threading
+for k in list(os.environ):
+    if k.startswith("RSDL_"):
+        del os.environ[k]
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_file
+from ray_shuffling_data_loader_tpu.shuffle import BatchConsumer, shuffle
+
+class C(BatchConsumer):
+    def consume(self, rank, epoch, batches): pass
+    def producer_done(self, rank, epoch): pass
+    def wait_until_ready(self, epoch): pass
+    def wait_until_all_epochs_done(self): pass
+
+files = [generate_file(0, 0, 128, 1, os.getcwd())[0]]
+runtime.init(num_workers=1)
+shuffle(files, C(), num_epochs=1, num_reducers=1, num_trainers=1, seed=1)
+assert not any(
+    t.name.startswith("rsdl-relay") for t in threading.enumerate()
+), "relay thread running while off"
+runtime.shutdown()
+assert (
+    "ray_shuffling_data_loader_tpu.telemetry.relay" not in sys.modules
+), "relay imported on a relay-off run"
+kicks = [
+    os.path.join(d, f)
+    for d, _, fs in os.walk(os.getcwd())
+    for f in fs
+    if f == "kick"
+]
+assert not kicks, kicks
+print("RELAY_ZERO_OVERHEAD_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": _REPO},
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "RELAY_ZERO_OVERHEAD_OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Two hosts, no shared spool (the ISSUE headline scenario)
+# ---------------------------------------------------------------------------
+
+FED_HEAD_SCRIPT = r"""
+import json, os, sys, time, urllib.request
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime, ShufflingDataset
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+
+ctx = runtime.init_cluster(advertise_host="127.0.0.1", num_workers=2)
+with open({addr_file!r} + ".tmp", "w") as f:
+    f.write(ctx.cluster.address)
+os.rename({addr_file!r} + ".tmp", {addr_file!r})
+
+deadline = time.time() + 60
+while len(ctx.cluster.registry.call("hosts")) < 2:
+    if time.time() > deadline:
+        print("VERDICT: FAIL worker never joined", flush=True)
+        sys.exit(1)
+    time.sleep(0.2)
+
+filenames, _ = generate_data(
+    num_rows=2000, num_files=4, num_row_groups_per_file=1,
+    max_row_group_skew=0.0, data_dir={data_dir!r},
+)
+ds = ShufflingDataset(
+    filenames, num_epochs=2, num_trainers=1, batch_size=250, rank=0,
+    num_reducers=4, seed=11, queue_name="q-fed",
+)
+ok = True
+for epoch in range(2):
+    ds.set_epoch(epoch)
+    keys = sorted(k for b in ds for k in b["key"].tolist())
+    if keys != list(range(2000)):
+        ok = False
+        print(f"VERDICT: FAIL epoch {{epoch}} keys wrong", flush=True)
+
+from ray_shuffling_data_loader_tpu.telemetry import audit as _audit
+from ray_shuffling_data_loader_tpu.telemetry import export, stragglers
+
+spool = os.environ["RSDL_RUNTIME_DIR"]
+
+def _remote(dirpath, prefix):
+    try:
+        return sorted(
+            f for f in os.listdir(dirpath)
+            if f.startswith(prefix + "127.0.0.1_")
+        )
+    except OSError:
+        return []
+
+# Wait for the worker's final flush-then-ship barriers to land: remote
+# host-namespaced files under the DRIVER's spool tree, and a complete
+# (strict-gate) audit: ok must be True — not the unshared-spool
+# "incomplete" None verdict.
+audit_ok = False
+deadline = time.time() + 45
+while time.time() < deadline:
+    have = (
+        _remote(os.path.join(spool, "metrics"), "metrics-")
+        and _remote(os.path.join(spool, "metrics", "tasks"), "tasks-")
+        and _remote(os.path.join(spool, "profiles"), "profile-")
+        and _remote(os.environ["RSDL_AUDIT_DIR"], "audit-")
+    )
+    if have:
+        verdicts = _audit.reconcile(range(2))
+        audit_ok = bool(verdicts) and all(
+            v.get("ok") is True for v in verdicts
+        )
+        if audit_ok:
+            break
+    time.sleep(0.5)
+
+if not audit_ok:
+    ok = False
+    print(
+        "VERDICT: FAIL audit not complete-ok: "
+        + json.dumps(_audit.summary()), flush=True,
+    )
+if _audit.summary().get("ok") is not True:
+    ok = False
+    print("VERDICT: FAIL audit summary ok is not True", flush=True)
+
+# Federated metrics: the aggregate must see >= 2 distinct source hosts
+# (driver's hostname + the worker's cluster host id).
+hosts = set()
+for rec in export.load_records():
+    src = rec.get("source") or {{}}
+    hosts.add(str(src.get("host")))
+if len(hosts) < 2:
+    ok = False
+    print(f"VERDICT: FAIL metric sources not federated: {{hosts}}",
+          flush=True)
+relayed = [
+    rec for rec in export.load_records()
+    if (rec.get("source") or {{}}).get("relayed")
+]
+if not relayed:
+    ok = False
+    print("VERDICT: FAIL no relayed metric records", flush=True)
+
+# Remote straggler records fold into the driver-side analyzer.
+task_dir = os.path.join(spool, "metrics", "tasks")
+remote_task_files = _remote(task_dir, "tasks-")
+remote_lines = 0
+for f in remote_task_files:
+    with open(os.path.join(task_dir, f)) as fh:
+        remote_lines += sum(1 for ln in fh if ln.strip())
+if remote_lines <= 0:
+    ok = False
+    print("VERDICT: FAIL no remote task records", flush=True)
+analysis = stragglers.analyze()
+if analysis["tasks_total"] < remote_lines:
+    ok = False
+    print("VERDICT: FAIL analyzer missing remote tasks", flush=True)
+
+# Live endpoints: /healthz shows a fresh remote source on the sink;
+# /stragglers serves the federated fold.
+port = int(os.environ["RSDL_OBS_PORT"])
+def _get(path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{{port}}{{path}}", timeout=10
+    ) as r:
+        return json.loads(r.read().decode())
+hz = _get("/healthz")
+rl = hz.get("relay") or {{}}
+if rl.get("role") != "sink" or not rl.get("hosts"):
+    ok = False
+    print(f"VERDICT: FAIL /healthz relay section: {{rl}}", flush=True)
+elif any(rec.get("stale") for rec in rl["hosts"].values()):
+    ok = False
+    print(f"VERDICT: FAIL relay source stale: {{rl}}", flush=True)
+sg = _get("/stragglers")
+if sg.get("tasks_total", 0) < remote_lines:
+    ok = False
+    print("VERDICT: FAIL /stragglers missing remote tasks", flush=True)
+cr = _get("/critical")
+if cr.get("tasks_total", 0) < remote_lines:
+    ok = False
+    print("VERDICT: FAIL /critical missing remote tasks", flush=True)
+
+# Keep the federated spool for the post-hoc epoch report (the session
+# owner removes the runtime dir on shutdown).
+import shutil
+shutil.copytree(task_dir, os.path.join({keep_dir!r}, "tasks"))
+with open(os.path.join({keep_dir!r}, "meta.json"), "w") as f:
+    json.dump({{"remote_lines": remote_lines}}, f)
+
+print("VERDICT: " + ("PASS" if ok else "FAIL"), flush=True)
+runtime.shutdown()
+"""
+
+FED_WORKER_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import cluster
+
+deadline = time.time() + 60
+while not os.path.exists({addr_file!r}):
+    if time.time() > deadline:
+        sys.exit(2)
+    time.sleep(0.1)
+with open({addr_file!r}) as f:
+    address = f.read().strip()
+ctx = runtime.init(address=address, num_workers=2)
+print(f"joined {{ctx.cluster.host_id}}", flush=True)
+cluster.serve_forever()
+runtime.shutdown()
+"""
+
+
+@slow
+def test_two_host_federation_without_shared_spool(tmp_path):
+    """The ISSUE's headline: two real host processes on localhost with
+    fully DISJOINT spool trees (each session owner creates its own
+    runtime dir; audit dirs are explicitly split) run a 2-epoch shuffle
+    under low-probability capped fault injection. The driver's obs
+    plane must see the remote host exactly as if the filesystem were
+    shared: federated metric sources (>= 2 hosts), remote straggler
+    records in the live analyzer and /stragglers, remote profile
+    frames, a COMPLETE audit (ok=True — the strict gate; without the
+    relay this run yields the unshared-spool "incomplete" verdict), a
+    fresh /healthz relay section, and a post-hoc epoch report whose
+    straggler table folds the remote records."""
+    addr_file = str(tmp_path / "head_address")
+    data_dir = str(tmp_path / "data")
+    keep_dir = tmp_path / "keep"
+    keep_dir.mkdir()
+    head_audit = tmp_path / "audit-head"
+    worker_audit = tmp_path / "audit-worker"
+    head_audit.mkdir()
+    worker_audit.mkdir()
+
+    base = {
+        k: v for k, v in os.environ.items() if not k.startswith("RSDL_")
+    }
+    base["JAX_PLATFORMS"] = "cpu"
+    common = dict(
+        base,
+        RSDL_ADVERTISE_HOST="127.0.0.1",
+        RSDL_METRICS="1",
+        RSDL_RELAY="auto",
+        RSDL_AUDIT="1",
+        RSDL_PROFILE="1",
+        # Low-probability, attempt-capped chaos on both hosts: the run
+        # must recover (retries) AND the audit must still reconcile
+        # complete across the relay.
+        RSDL_FAULTS="task.map:crash-entry:0.2x2,task.reduce:crash-exit:0.2x2",
+        RSDL_FAULTS_SEED="1119",
+    )
+    import socket as _socket
+
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    obs_port = probe.getsockname()[1]
+    probe.close()
+    head_env = dict(
+        common,
+        RSDL_AUDIT_DIR=str(head_audit),
+        RSDL_OBS_PORT=str(obs_port),
+    )
+    worker_env = dict(common, RSDL_AUDIT_DIR=str(worker_audit))
+
+    head_log = tmp_path / "head.log"
+    worker_log = tmp_path / "worker.log"
+    with open(head_log, "w") as hf, open(worker_log, "w") as wf:
+        head = subprocess.Popen(
+            [sys.executable, "-c", FED_HEAD_SCRIPT.format(
+                repo=_REPO,
+                addr_file=addr_file,
+                data_dir=data_dir,
+                keep_dir=str(keep_dir),
+            )],
+            stdout=hf,
+            stderr=subprocess.STDOUT,
+            env=head_env,
+        )
+        worker = subprocess.Popen(
+            [sys.executable, "-c", FED_WORKER_SCRIPT.format(
+                repo=_REPO, addr_file=addr_file
+            )],
+            stdout=wf,
+            stderr=subprocess.STDOUT,
+            env=worker_env,
+        )
+        try:
+            head.wait(timeout=420)
+        finally:
+            head.kill()
+            worker.kill()
+            head.wait()
+            worker.wait()
+
+    head_out = head_log.read_text()
+    assert "VERDICT: PASS" in head_out, (
+        f"head output:\n{head_out}\n--- worker output:\n"
+        f"{worker_log.read_text()}"
+    )
+
+    # Post-hoc epoch report over the federated task spool: the
+    # straggler table must fold the remote host's records too.
+    meta = json.loads((keep_dir / "meta.json").read_text())
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "tools", "epoch_report.py"),
+            "--task-records", str(keep_dir / "tasks"),
+            "--json",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=dict(base),
+    )
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    rows = report.get("stragglers") or []
+    assert rows, report
+    assert sum(int(r.get("tasks", 0)) for r in rows) >= meta["remote_lines"]

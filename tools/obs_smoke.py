@@ -30,6 +30,16 @@ Run from the repo root (``run_ci_tests.sh`` obs lane)::
 
     RSDL_METRICS=1 python tools/obs_smoke.py
 
+``--federation`` (ISSUE 19) runs the cross-host gate instead: a second
+host process joins over TCP with NO shared spool tree (its
+``RSDL_RUNTIME_DIR`` is its own), ``RSDL_RELAY=auto`` ships its spools
+to the driver, and MID-FLIGHT the driver's ``/metrics`` must show
+metric series from >= 2 distinct ``host=`` label values while
+``/healthz`` shows the relay sink with a fresh (non-stale) source —
+the relay lane in ``run_ci_tests.sh``::
+
+    RSDL_METRICS=1 python tools/obs_smoke.py --federation
+
 Exits non-zero on any miss — the exit code IS the gate.
 """
 
@@ -248,6 +258,156 @@ def main() -> int:
     return 0
 
 
+_FED_WORKER_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, sys.argv[1])
+addr_file = sys.argv[2]
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.runtime import cluster
+
+deadline = time.time() + 60
+while not os.path.exists(addr_file):
+    if time.time() > deadline:
+        sys.exit(2)
+    time.sleep(0.1)
+with open(addr_file) as f:
+    address = f.read().strip()
+runtime.init(address=address, num_workers=2)
+cluster.serve_forever()
+runtime.shutdown()
+"""
+
+
+def federation_main() -> int:
+    """The ISSUE 19 gate: with a remote host on a DISJOINT spool tree,
+    the driver's /metrics shows >= 2 distinct host= labels mid-flight
+    (its own records plus the worker's relayed ones) and /healthz shows
+    the relay sink feeding from a fresh source."""
+    import re
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    os.environ.setdefault("RSDL_METRICS", "1")
+    os.environ["RSDL_RELAY"] = "auto"
+    os.environ["RSDL_OBS_PORT"] = str(port)
+    os.environ.setdefault("RSDL_TS_PERIOD_S", "0.2")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import generate_file
+    from ray_shuffling_data_loader_tpu.shuffle import (
+        BatchConsumer,
+        shuffle,
+    )
+
+    ctx = runtime.init_cluster(advertise_host="127.0.0.1", num_workers=2)
+    tmp = tempfile.mkdtemp(prefix="rsdl-fed-smoke-")
+    addr_file = os.path.join(tmp, "head_address")
+    with open(addr_file + ".tmp", "w") as f:
+        f.write(ctx.cluster.address)
+    os.rename(addr_file + ".tmp", addr_file)
+
+    # The worker host must NOT inherit this session's spool tree — a
+    # shared RSDL_RUNTIME_DIR would let the files federate by
+    # filesystem and the relay would (correctly) skip them all.
+    worker_env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("RSDL_RUNTIME_DIR", "RSDL_OBS_PORT")
+    }
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _FED_WORKER_SCRIPT, repo, addr_file],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=worker_env,
+    )
+    try:
+        deadline = time.time() + 60
+        while len(ctx.cluster.registry.call("hosts")) < 2:
+            assert time.time() < deadline, "worker host never joined"
+            assert worker.poll() is None, "worker died while joining"
+            time.sleep(0.2)
+
+        data_dir = tempfile.mkdtemp(prefix="rsdl-fed-data-")
+        files = [
+            generate_file(i, i * 2048, 2048, 1, data_dir)[0]
+            for i in range(2)
+        ]
+
+        class _Consumer(BatchConsumer):
+            def consume(self, rank, epoch, batches):
+                time.sleep(0.2)  # keep the run observably mid-flight
+
+            def producer_done(self, rank, epoch):
+                pass
+
+            def wait_until_ready(self, epoch):
+                pass
+
+            def wait_until_all_epochs_done(self):
+                pass
+
+        errors = []
+
+        def _run():
+            try:
+                shuffle(
+                    files, _Consumer(), num_epochs=3, num_reducers=2,
+                    num_trainers=1, seed=7,
+                )
+            except BaseException as exc:
+                errors.append(exc)
+
+        thread = threading.Thread(target=_run, daemon=True)
+        thread.start()
+
+        base = f"http://127.0.0.1:{port}"
+
+        def get_text(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.read().decode()
+
+        # MID-FLIGHT: >= 2 distinct host= label values on /metrics.
+        deadline = time.time() + 120
+        hosts_seen = set()
+        while time.time() < deadline and thread.is_alive():
+            hosts_seen = set(
+                re.findall(r'host="([^"]+)"', get_text("/metrics"))
+            )
+            if len(hosts_seen) >= 2:
+                break
+            time.sleep(0.3)
+        assert len(hosts_seen) >= 2, (
+            f"/metrics never federated >=2 hosts mid-flight: "
+            f"{sorted(hosts_seen)}"
+        )
+
+        # The sink is live and its source is fresh.
+        hz = json.loads(get_text("/healthz"))
+        rl = hz.get("relay") or {}
+        assert rl.get("role") == "sink", rl
+        assert rl.get("hosts"), "relay sink has no sources"
+        assert not any(
+            rec.get("stale") for rec in rl["hosts"].values()
+        ), rl
+
+        thread.join(timeout=180)
+        assert not thread.is_alive() and not errors, errors
+        print(
+            "federation smoke ok: hosts=%s, relay=%s"
+            % (sorted(hosts_seen), rl["hosts"])
+        )
+        runtime.shutdown()
+        return 0
+    finally:
+        worker.kill()
+        worker.wait()
+
+
 def _wait_alert_state(get, rule, active, timeout_s=60.0):
     """Poll /alerts until ``rule`` reaches the wanted active state
     (the sampler tick drives evaluation); True on success."""
@@ -261,4 +421,6 @@ def _wait_alert_state(get, rule, active, timeout_s=60.0):
 
 
 if __name__ == "__main__":
+    if "--federation" in sys.argv[1:]:
+        sys.exit(federation_main())
     sys.exit(main())

@@ -210,6 +210,30 @@ def render(frame: Dict[str, Any]) -> str:
         + ("  " + " ".join(parts) if parts else "")
     )
 
+    # Federation freshness (ISSUE 19): per-source-host last-shipped age
+    # from the relay sink — a dead remote relay reads STALE here live.
+    relay = healthz.get("relay") or {}
+    relay_hosts = relay.get("hosts") or {}
+    if relay.get("role") or relay_hosts:
+        parts = []
+        for host_id in sorted(relay_hosts):
+            rec = relay_hosts[host_id] or {}
+            mark = (
+                "STALE"
+                if rec.get("stale")
+                else f"{_fmt(rec.get('age_s'))}s"
+            )
+            parts.append(
+                f"{host_id}:{mark}"
+                f"/{_fmt_bytes(rec.get('bytes', 0))}"
+            )
+        lines.append(
+            (
+                f"relay    role={relay.get('role') or '-'}  "
+                + ("  ".join(parts) if parts else "(no remote hosts)")
+            )[:115]
+        )
+
     # Throughput sparklines from /timeseries rate series.
     lines.append("")
     lines.append("throughput (rate over the window)")
