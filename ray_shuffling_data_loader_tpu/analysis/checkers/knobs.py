@@ -22,7 +22,13 @@ Checks, both directions ("registry and TUNING.md agree exactly"):
   3. every ``RSDL_*`` token in ``docs/TUNING.md`` is a registry entry —
      else *documented but undeclared* (doc drift in the other
      direction);
-  4. duplicate registry declarations.
+  4. duplicate registry declarations;
+  5. planner/registry agreement (ISSUE 20): every knob the plan
+     compiler's ``TERM_KNOBS`` names is a registry entry flagged
+     ``planned=True``, and every ``planned=True`` entry appears in
+     ``TERM_KNOBS`` — the cost model and the registry cannot drift.
+     Skipped when the project has no ``analysis/planner.py`` (fixture
+     mini-repos share the global registry).
 """
 
 from __future__ import annotations
@@ -189,6 +195,36 @@ def _registry_relpath(project: Project) -> str:
     return "ray_shuffling_data_loader_tpu/analysis/knob_registry.py"
 
 
+PLANNER_RELPATH = "ray_shuffling_data_loader_tpu/analysis/planner.py"
+
+
+def _harvest_term_knobs(project: Project) -> Optional[Dict[str, Tuple[str, int]]]:
+    """The planner's module-level ``TERM_KNOBS`` dict literal as
+    {term: (knob, lineno)}, or None when the project carries no
+    planner source (fixture mini-repos)."""
+    src = project.sources.get(PLANNER_RELPATH)
+    if src is None or src.tree is None:
+        return None
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "TERM_KNOBS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return {}
+        out: Dict[str, Tuple[str, int]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            term = const_str(k)
+            knob = const_str(v)
+            if term is not None and knob is not None:
+                out[term] = (knob, v.lineno)
+        return out
+    return None
+
+
 def check(project: Project) -> List[Finding]:
     registry = knob_registry.registry_for(project)
     findings: List[Finding] = []
@@ -261,6 +297,50 @@ def check(project: Project) -> List[Finding]:
                     ),
                 )
             )
+
+    # 5. planner <-> registry agreement (ISSUE 20)
+    term_knobs = _harvest_term_knobs(project)
+    if term_knobs is not None:
+        emitted = {knob for knob, _ in term_knobs.values()}
+        for term, (knob, line) in sorted(term_knobs.items()):
+            entry = registry.lookup(knob)
+            if entry is None:
+                findings.append(
+                    Finding(
+                        check="knob-registry",
+                        path=PLANNER_RELPATH,
+                        line=line,
+                        message=(
+                            f"planner term {term!r} names {knob}, which "
+                            "is not a registry entry"
+                        ),
+                    )
+                )
+            elif not entry.planned:
+                findings.append(
+                    Finding(
+                        check="knob-registry",
+                        path=PLANNER_RELPATH,
+                        line=line,
+                        message=(
+                            f"planner term {term!r} names {knob}, which "
+                            "is not flagged planned=True in the registry"
+                        ),
+                    )
+                )
+        for knob in registry.knobs:
+            if knob.planned and knob.name not in emitted:
+                findings.append(
+                    Finding(
+                        check="knob-registry",
+                        path=reg_path,
+                        line=reg_lines.get(knob.name, 1),
+                        message=(
+                            f"registry flags {knob.name} planned=True but "
+                            "the planner's TERM_KNOBS emits no such term"
+                        ),
+                    )
+                )
 
     # 3. documented-but-undeclared tokens
     if doc is not None:

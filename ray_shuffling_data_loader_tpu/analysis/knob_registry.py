@@ -18,6 +18,12 @@ Scope semantics:
 ``prefix=True`` declares a family: any name starting with ``name``
 matches (used for the multiprocess/pod-harness plumbing families whose
 suffixes are dynamic).
+
+``planned=True`` marks a knob the plan compiler owns (ISSUE 20): with
+``RSDL_PLAN=auto`` and the knob unset, the cost model picks its
+effective value; setting the env var pins it. The ``knob-registry``
+checker cross-checks this flag against the planner's ``TERM_KNOBS``
+mapping in both directions, so cost model and registry cannot drift.
 """
 
 from __future__ import annotations
@@ -34,6 +40,7 @@ class Knob:
     scope: str  # public | internal
     help: str = ""
     prefix: bool = False
+    planned: bool = False  # owned by the plan compiler (RSDL_PLAN)
 
 
 KNOBS: Tuple[Knob, ...] = (
@@ -61,7 +68,7 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("RSDL_TCP_STREAMS", "int", "1", "public",
          "striped connections per peer (zero-copy plane)"),
     Knob("RSDL_FETCH_WINDOW_DEPTH", "int", "4/8", "public",
-         "window-pipelining depth"),
+         "window-pipelining depth", planned=True),
     Knob("RSDL_REDUCE_FETCH_OVERLAP", "enum", "auto", "public",
          "overlap reduce-side fetch with the gather"),
     # -- recovery / retry ---------------------------------------------------
@@ -92,21 +99,25 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("RSDL_DECODE_THREADS", "enum", "auto", "public",
          "Arrow per-read threads inside decode tasks"),
     Knob("RSDL_DECODE_ROWGROUPS", "enum", "off", "public",
-         "row-group decode execution plan"),
+         "row-group decode execution plan", planned=True),
     Knob("RSDL_DECODE_PUSHDOWN", "enum", "auto", "public",
-         "column pushdown for decode"),
+         "column pushdown for decode", planned=True),
     Knob("RSDL_DECODE_CACHE_SHARED", "flag", "off", "public",
          "cross-epoch shared decode-cache tier"),
     Knob("RSDL_SHUFFLE_PLAN", "enum", "rowwise", "public",
-         "seeded plan family (rowwise | block[:G])"),
+         "seeded plan family (rowwise | block[:G])", planned=True),
     Knob("RSDL_SELECTIVE_READS", "enum", "off", "public",
-         "RINAS-style selective schedule"),
+         "RINAS-style selective schedule", planned=True),
     Knob("RSDL_DISABLE_NATIVE", "flag", "off", "public",
          "skip the C++ kernels"),
     Knob("RSDL_NATIVE_CACHE", "path", "repo dir", "public",
          "compiled kernel .so cache dir"),
     Knob("RSDL_NATIVE_THREADS", "int", "min(8, cores)", "public",
-         "kernel thread count"),
+         "kernel thread count", planned=True),
+    # -- self-tuning plan compiler (ISSUE 20) -------------------------------
+    Knob("RSDL_PLAN", "enum", "off", "public",
+         "cost-based plan compiler (auto | off): plans the planned=True "
+         "knobs from footer stats; env-set knobs stay pinned"),
     # -- staging / resident -------------------------------------------------
     Knob("RSDL_DEVICE_DIRECT", "enum", "auto", "public",
          "device-direct delivery kill switch"),

@@ -52,7 +52,10 @@ GATED_PLANES = {
     )
 } | {
     f"{PACKAGE}.runtime.{m}"
-    for m in ("journal", "faults", "elastic", "service")
+    for m in ("journal", "faults", "elastic", "service", "plan")
+} | {
+    # Self-tuning plan compiler (ISSUE 20): RSDL_PLAN=auto|on.
+    f"{PACKAGE}.analysis.planner",
 }
 
 # Core data-path modules: the zero-overhead-off contract is theirs.

@@ -73,6 +73,16 @@ def refresh_threads_from_env() -> None:
     _NUM_THREADS = _threads_from_env()
 
 
+def set_num_threads(n: Optional[int]) -> None:
+    """Set the process default kernel thread count. The planner's
+    delivery path for its ``native_threads`` term: stage tasks apply
+    the planned value on entry (env snapshots date from pool spawn, so
+    the env-read default can't carry it). None is a no-op."""
+    global _NUM_THREADS
+    if n is not None:
+        _NUM_THREADS = max(1, int(n))
+
+
 def _resolve_threads(n_threads: Optional[int]) -> int:
     return _NUM_THREADS if n_threads is None else max(1, int(n_threads))
 

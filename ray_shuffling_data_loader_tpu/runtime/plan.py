@@ -1,0 +1,173 @@
+"""Resolved shuffle plan: the cost-based planner's output (ISSUE 20).
+
+``analysis/planner.py`` is the cost model; this module is the *shape*
+of what it produces and the driver-side registry the run ledger
+harvests. A :class:`ResolvedPlan` carries one :class:`PlanTerm` per
+planner-owned knob (``TERM_KNOBS`` in the planner names the mapping,
+cross-checked against ``analysis/knob_registry.py`` by ``rsdl_lint``):
+the effective value, where it came from (``env`` beats ``planned`` —
+an operator-set knob is a pin the planner must never override), and
+the one-line cost-model justification that lands in the
+``plan.chosen`` event and the run-ledger record.
+
+Gate: ``RSDL_PLAN=auto|on`` (checked by ``shuffle.py`` *before* any
+import of this plane — zero-overhead off, fresh-interpreter-proven in
+``tests/test_planner.py``; both this module and ``analysis.planner``
+are ``GATED_PLANES`` entries).
+
+Split from the planner so the ledger side (``telemetry/runledger.py``
+reads :func:`current_terms` / :func:`effective_env` through
+``sys.modules``) never has to touch the cost model or its footer-stats
+imports.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_PLAN = "RSDL_PLAN"
+
+# Sources, in override order: an env-set knob pins its term for the
+# whole run ("env"); otherwise the compile-time cost model decides
+# ("planned") and the epoch-boundary re-planner may adjust the
+# mutable subset ("replanned").
+SOURCE_ENV = "env"
+SOURCE_PLANNED = "planned"
+SOURCE_REPLANNED = "replanned"
+
+# Terms the between-epoch re-planner may adjust mid-run: all are
+# delivered-stream-invariant (window depth and thread counts change
+# scheduling only; selective changes the *schedule*, and the stream is
+# bit-identical across schedules — tested since ISSUE 11/12).
+MUTABLE_TERMS = (
+    "fetch_window_depth",
+    "decode_rowgroup_threads",
+    "selective",
+)
+
+
+@dataclass
+class PlanTerm:
+    """One planner decision: a knob's effective value + provenance."""
+
+    name: str
+    knob: str
+    value: Any
+    source: str
+    why: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        value = self.value
+        if isinstance(value, tuple):
+            value = list(value)
+        return {
+            "value": value,
+            "source": self.source,
+            "knob": self.knob,
+            "why": self.why,
+        }
+
+
+@dataclass
+class ResolvedPlan:
+    """Every knob the planner owns, resolved once driver-side.
+
+    ``plan`` is the ``(family, granularity)`` spec threaded through
+    ``_file_assignment`` (the seeded-assignment seam every schedule
+    shares); ``projection`` feeds ``_pushdown_columns``; the rest ride
+    the ``knobs`` task argument into stage tasks — explicit arguments,
+    not env, because workers' env snapshots date from pool spawn (the
+    PR 12 lesson).
+    """
+
+    plan: Tuple[str, int]
+    projection: Optional[List[str]]
+    terms: Dict[str, PlanTerm]
+    model: Dict[str, Any] = field(default_factory=dict)
+    replans: int = 0
+
+    def term_value(self, name: str) -> Any:
+        t = self.terms.get(name)
+        return t.value if t is not None else None
+
+    def task_knobs(self) -> Dict[str, Any]:
+        """The plain-dict subset stage tasks consume (picklable, no
+        import of this module on workers): effective decode/fetch/
+        kernel-thread values plus the selective decision."""
+        out: Dict[str, Any] = {}
+        for name in (
+            "decode_rowgroup_threads",
+            "fetch_window_depth",
+            "native_threads",
+            "selective",
+        ):
+            value = self.term_value(name)
+            if value is not None:
+                out[name] = value
+        return out
+
+    def terms_dict(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-ready view of every term — the ``plan.chosen`` event
+        payload and the run ledger's ``plan_terms`` section."""
+        return {name: t.as_dict() for name, t in sorted(self.terms.items())}
+
+    def effective_env(self) -> Dict[str, str]:
+        """{knob name: effective value} for terms with a concrete
+        scalar/label value — what the ledger knob snapshot overlays so
+        two runs with identical env but different planner decisions
+        stop looking identical (ISSUE 20 bugfix)."""
+        out: Dict[str, str] = {}
+        for t in self.terms.values():
+            if t.value is None:
+                continue
+            if t.name == "plan":
+                family, granularity = self.plan
+                out[t.knob] = (
+                    family if family == "rowwise"
+                    else f"block:{granularity}"
+                )
+            elif t.name == "selective":
+                out[t.knob] = "on" if t.value else "off"
+            elif t.name == "columns":
+                out[t.knob] = "planned:" + ",".join(map(str, t.value))
+            else:
+                out[t.knob] = str(t.value)
+        return out
+
+
+# -- driver-side current-plan state ------------------------------------------
+# One plan per driver run; the run ledger harvests it through
+# sys.modules (never importing this plane itself), and _shuffle_impl
+# clears it at run end so a later planner-off run in the same process
+# cannot inherit stale terms.
+
+_lock = threading.Lock()
+_current: Optional[ResolvedPlan] = None
+
+
+def set_current(rplan: Optional[ResolvedPlan]) -> None:
+    global _current
+    with _lock:
+        _current = rplan
+
+
+def current() -> Optional[ResolvedPlan]:
+    with _lock:
+        return _current
+
+
+def current_terms() -> Optional[Dict[str, Dict[str, Any]]]:
+    rplan = current()
+    if rplan is None:
+        return None
+    terms = rplan.terms_dict()
+    if rplan.replans:
+        terms["_replans"] = {"value": rplan.replans}
+    return terms
+
+
+def effective_env() -> Dict[str, str]:
+    rplan = current()
+    return rplan.effective_env() if rplan is not None else {}

@@ -784,6 +784,53 @@ def plan_is_prunable(plan: Optional[Tuple[str, int]] = None) -> bool:
     return family == "block"
 
 
+def _plan_enabled() -> bool:
+    """Is the self-tuning plan compiler on? Env checked *before* any
+    import of the planner plane (``analysis.planner`` /
+    ``runtime.plan`` stay dark — GATED_PLANES — when off)."""
+    mode = (os.environ.get("RSDL_PLAN") or "").strip().lower()
+    return mode in ("auto", "on", "1", "true")
+
+
+def _clear_plan_state() -> None:
+    """Drop the driver's current-plan registry entry at run end (after
+    the ledger record that harvests it) so a later planner-off run in
+    this process cannot inherit stale terms. sys.modules only — never
+    the reason the plane loads."""
+    import sys
+
+    mod = sys.modules.get("ray_shuffling_data_loader_tpu.runtime.plan")
+    if mod is not None:
+        mod.set_current(None)
+
+
+def _apply_task_knobs(knobs: Optional[dict]) -> None:
+    """Apply driver-planned per-task knobs on stage-task entry.
+
+    Only ``native_threads`` needs process-level application (the
+    kernel wrappers read the process default); decode threads and
+    window depth are consumed at their call sites from the same dict.
+    Plain dict, not a ResolvedPlan — workers never import the planner
+    plane."""
+    if not knobs:
+        return
+    n = knobs.get("native_threads")
+    if n is not None:
+        from ray_shuffling_data_loader_tpu import native as _native
+
+        _native.set_num_threads(int(n))
+
+
+def _knob_decode_threads(knobs: Optional[dict], stage_tasks: int) -> int:
+    """Decode row-group threads for this task: the driver-planned
+    value when present, else the env fair-share rule
+    (``decode_rowgroup_threads``). Planned values are threaded as
+    arguments because worker env snapshots date from pool spawn."""
+    if knobs and knobs.get("decode_rowgroup_threads") is not None:
+        return max(1, int(knobs["decode_rowgroup_threads"]))
+    return decode_rowgroup_threads(stage_tasks)
+
+
 def shuffle_map(
     filename: str,
     file_index: int,
@@ -797,6 +844,7 @@ def shuffle_map(
     stage_tasks: int = 0,
     columns: Optional[Sequence[str]] = None,
     plan: Optional[Tuple[str, int]] = None,
+    knobs: Optional[dict] = None,
 ):
     """Map stage: load one file, randomly partition its rows across reducers.
 
@@ -832,6 +880,7 @@ def shuffle_map(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    _apply_task_knobs(knobs)
     prof = _phases.stage_profiler("map", epoch=epoch, file=file_index)
     if plan is None:
         plan = shuffle_plan_spec()
@@ -846,8 +895,9 @@ def shuffle_map(
         # otherwise Arrow's per-read pool under the same fair-share rule
         # (utils.arrow_decode_threads; stage_tasks == files this epoch).
         # The two never stack — a row-group plan reads each range with
-        # use_threads=False.
-        rg_threads = decode_rowgroup_threads(stage_tasks or 1)
+        # use_threads=False. The planner's value arrives via ``knobs``
+        # (worker env snapshots date from pool spawn).
+        rg_threads = _knob_decode_threads(knobs, stage_tasks or 1)
         use_threads = (
             rg_threads <= 1
             and stage_tasks > 0
@@ -1074,6 +1124,7 @@ def shuffle_plan(
 
 def selective_reads_decision(
     plan: Optional[Tuple[str, int]] = None,
+    planned: Optional[bool] = None,
 ) -> Tuple[bool, str]:
     """The ONE parser of ``RSDL_SELECTIVE_READS`` (default off):
     ``(engage, reason)`` for the RINAS-style selective schedule —
@@ -1094,12 +1145,27 @@ def selective_reads_decision(
     the driver threads through the stage tasks, so the engage decision
     can never key on a different plan family than the assignment and
     the metric labels; None = parse this process's env (driver-side
-    summaries/tools)."""
+    summaries/tools).
+
+    ``planned``: the plan compiler's decision (ISSUE 20). Honored only
+    when the env knob is *unset* — a set ``RSDL_SELECTIVE_READS`` is
+    an operator pin that outranks the planner — and an engage still
+    requires a prunable plan (the planner cannot force the ~R×
+    amplification ``on`` accepts)."""
     plan = plan if plan is not None else shuffle_plan_spec()
     label = _label_of_plan(plan)
     mode = os.environ.get(
         "RSDL_SELECTIVE_READS", ""
     ).strip().lower()
+    if mode == "" and planned is not None:
+        if planned and plan_is_prunable(plan):
+            return True, f"planned: engaged (plan={label})"
+        if planned:
+            return False, (
+                "planned engage declined: plan "
+                f"{label} is not prunable"
+            )
+        return False, "planned: off"
     if mode in ("1", "on", "true"):
         return True, f"forced on (plan={label})"
     if mode == "auto":
@@ -1251,6 +1317,7 @@ def shuffle_selective_reduce(
     stats_collector=None,
     pack=None,
     plan: Optional[Tuple[str, int]] = None,
+    knobs: Optional[dict] = None,
 ):
     """Reduce stage for the selective schedule: decode ONLY the row
     groups holding this reducer's rows (per-file selections derived
@@ -1278,6 +1345,7 @@ def shuffle_selective_reduce(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    _apply_task_knobs(knobs)
     prof = _phases.stage_profiler(
         "selective-reduce", epoch=epoch, reducer=reduce_index
     )
@@ -1309,7 +1377,7 @@ def shuffle_selective_reduce(
     # Pass 1: per-file selective decode + near-sequential take into the
     # compact buffer (the same locality two-pass as the index schedule's
     # gather-reduce; pass 2 below permutes the dense result).
-    rg_threads = decode_rowgroup_threads(num_reducers)
+    rg_threads = _knob_decode_threads(knobs, num_reducers)
     compact: Optional[Dict[str, np.ndarray]] = None
     for i, fname in enumerate(filenames):
         batch = read_parquet_columns(
@@ -1666,6 +1734,7 @@ def shuffle_gather_reduce(
     cache_refs: Sequence[ObjectRef],
     stats_collector=None,
     pack=None,
+    knobs: Optional[dict] = None,
 ) -> ObjectRef:
     """Reduce stage for the index schedule: ONE sparse gather straight out
     of the cached decoded file segments, replacing the materialized path's
@@ -1683,6 +1752,7 @@ def shuffle_gather_reduce(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    _apply_task_knobs(knobs)
     prof = _phases.stage_profiler(
         "gather-reduce", epoch=epoch, reducer=reduce_index
     )
@@ -1797,11 +1867,15 @@ def _ref_window_rows(ref) -> Optional[int]:
     return int(rows[1]) - int(rows[0])
 
 
-def _fetch_window_depth() -> int:
+def _fetch_window_depth(knobs: Optional[dict] = None) -> int:
     """How many mapper-partition windows the overlapped reduce keeps in
     flight ahead of the gather (``RSDL_FETCH_WINDOW_DEPTH``, default 4 —
     measured flat from 2..8 on loopback, so the default leans small to
-    bound peak cache residency at ``depth`` windows)."""
+    bound peak cache residency at ``depth`` windows). A driver-planned
+    depth arrives via ``knobs`` and wins (the env read would see the
+    pool-spawn snapshot, not the plan)."""
+    if knobs and knobs.get("fetch_window_depth") is not None:
+        return max(1, int(knobs["fetch_window_depth"]))
     from ray_shuffling_data_loader_tpu.runtime.store import (
         fetch_window_depth,
     )
@@ -1810,7 +1884,8 @@ def _fetch_window_depth() -> int:
 
 
 def _overlapped_reduce(
-    store, part_refs, counts, reduce_index, epoch, seed, prof, pack=None
+    store, part_refs, counts, reduce_index, epoch, seed, prof, pack=None,
+    knobs=None,
 ):
     """Reduce-side fetch/gather overlap: prefetch mapper-partition
     windows N+1..N+depth over DCN while scattering window N into the
@@ -1834,7 +1909,7 @@ def _overlapped_reduce(
     """
     from ray_shuffling_data_loader_tpu import native
 
-    depth = _fetch_window_depth()
+    depth = _fetch_window_depth(knobs)
     store.prefetch(part_refs[:depth], max_parallel=depth)
     dst_off = np.zeros(len(counts) + 1, dtype=np.int64)
     np.cumsum(counts, out=dst_off[1:])
@@ -1921,6 +1996,7 @@ def shuffle_reduce(
     part_refs: Sequence[ObjectRef],
     stats_collector=None,
     pack=None,
+    knobs: Optional[dict] = None,
 ) -> ObjectRef:
     """Reduce stage: concat this reducer's partition from every mapper and
     fully permute it (reference ``shuffle_reduce``, ``shuffle.py:171-200``).
@@ -1946,6 +2022,7 @@ def shuffle_reduce(
     start = timeit.default_timer()
     wall0 = time.time()
     ctx = runtime.ensure_initialized()
+    _apply_task_knobs(knobs)
     prof = _phases.stage_profiler(
         "reduce", epoch=epoch, reducer=reduce_index
     )
@@ -1971,7 +2048,7 @@ def shuffle_reduce(
         if overlap:
             out_ref, total_rows = _overlapped_reduce(
                 store, part_refs, counts, reduce_index, epoch, seed, prof,
-                pack=pack,
+                pack=pack, knobs=knobs,
             )
         else:
             with prof.phase("window-fetch") as ph:
@@ -2802,8 +2879,14 @@ def shuffle_epoch(
     journal=None,
     est=None,
     job=None,
+    knobs: Optional[dict] = None,
 ) -> threading.Thread:
     """Kick off one epoch's shuffle; returns the delivery thread.
+
+    ``knobs`` (ISSUE 20): the plan compiler's effective task knobs
+    (``ResolvedPlan.task_knobs()`` — decode threads, fetch-window
+    depth, native threads, selective engagement), threaded into every
+    stage task as a plain dict for the same reason as ``plan``.
 
     ``job`` (ISSUE 15): the service-plane tenant this epoch belongs to.
     Its id rides the telemetry context into every stage task (so
@@ -2877,7 +2960,9 @@ def shuffle_epoch(
     )
     if cache_refs is not None:
         schedule = "index"
-    elif selective_reads_decision(plan)[0]:
+    elif selective_reads_decision(
+        plan, planned=(knobs or {}).get("selective")
+    )[0]:
         # RINAS-style selective schedule (ISSUE 11): no map
         # materialization at all — per-file plans return counts only,
         # reducers decode just the row groups their windows need.
@@ -3085,6 +3170,7 @@ def shuffle_epoch(
                     len(filenames),
                     columns,
                     plan,
+                    knobs,
                 )
                 if cache_ref is not None:
                     # Locality: run the map on the host that owns the
@@ -3166,6 +3252,7 @@ def shuffle_epoch(
             len(filenames),
             columns,
             plan,
+            knobs,
         )
 
     def _regenerate_cache(j):
@@ -3193,6 +3280,7 @@ def shuffle_epoch(
             len(filenames),
             columns,
             plan,
+            knobs,
         )
         try:
             part_refs, new_cache = fut.result()
@@ -3403,6 +3491,7 @@ def shuffle_epoch(
                             stats_collector,
                             pack_for[r],
                             plan,
+                            knobs,
                         )
                     return pool.submit_local_to(
                         refs_r,
@@ -3414,6 +3503,7 @@ def shuffle_epoch(
                         *extra,
                         stats_collector,
                         pack_for[r],
+                        knobs,
                     )
 
                 def _refs_for(r):
@@ -4061,6 +4151,44 @@ def _shuffle_impl(
         except Exception:
             pass
     device_layout = _device_layout_allowed(device_layout)
+    # -- self-tuning plan compiler (ISSUE 20) -------------------------------
+    # Gate checked before any planner import (zero-overhead off). The
+    # compiler resolves every planner-owned knob once, driver-side; an
+    # env-set knob pins its term (env beats planned — see
+    # analysis/planner.py). Effective task knobs then ride stage-task
+    # ARGUMENTS (the PR 12 lesson: worker env snapshots date from pool
+    # spawn), and the resolved plan replaces the env-parsed one.
+    rplan = None
+    task_knobs: Optional[dict] = None
+    _planner = None
+    if _plan_enabled():
+        from ray_shuffling_data_loader_tpu.analysis import planner as _planner
+        from ray_shuffling_data_loader_tpu.runtime import plan as _plan_state
+
+        rplan = _planner.compile_plan(
+            filenames,
+            num_reducers=num_reducers,
+            num_trainers=num_trainers,
+            num_epochs=num_epochs,
+            start_epoch=start_epoch,
+            columns=columns,
+            device_layout=device_layout,
+            narrow_to_32=narrow_to_32,
+            cache_decoded=cache_decoded,
+        )
+        plan = rplan.plan
+        if columns is None and rplan.projection is not None:
+            # The planned projection enters the SAME seam caller
+            # columns do, upstream of _pushdown_columns (audit-key
+            # append and dedup stay in one place).
+            columns = list(rplan.projection)
+        task_knobs = rplan.task_knobs()
+        _plan_state.set_current(rplan)
+        telemetry.emit_event(
+            "plan.chosen", plan=_label_of_plan(plan),
+            terms=rplan.terms_dict(),
+        )
+        _metrics.safe_inc("plan.compiled", plan=_label_of_plan(plan))
     columns = _pushdown_columns(device_layout, columns)
     # -- durable epoch-state plane (ISSUE 13) -------------------------------
     # Lazy import: with RSDL_JOURNAL unset and no explicit resume the
@@ -4274,6 +4402,16 @@ def _shuffle_impl(
             )
             if est is not None:
                 _metrics.safe_inc("recovery.resumed_epochs")
+            if rplan is not None and epoch > start_epoch:
+                # Epoch-boundary re-plan (ISSUE 20): live /critical +
+                # /capacity signals adjust the mutable-mid-run terms
+                # before this epoch's tasks are submitted. Best-effort
+                # — a telemetry hiccup must never fail the run.
+                try:
+                    if _planner.replan(rplan, epoch=epoch):
+                        task_knobs = rplan.task_knobs()
+                except Exception:
+                    pass
             threads.append(
                 shuffle_epoch(
                     epoch,
@@ -4292,6 +4430,7 @@ def _shuffle_impl(
                     journal=journal,
                     est=est,
                     job=job,
+                    knobs=task_knobs,
                 )
             )
         for t in threads:
@@ -4320,6 +4459,7 @@ def _shuffle_impl(
                 duration_s=timeit.default_timer() - start,
                 plan=plan, job_id=jid,
             )
+            _clear_plan_state()
             # No resume is in progress once the run is suspended: a
             # stuck gauge would page resume_stalled forever in an
             # embedding driver that catches RunSuspended and lives on.
@@ -4385,6 +4525,7 @@ def _shuffle_impl(
             plan=plan, job_id=jid,
             audit_verdicts=audit_verdicts,
         )
+        _clear_plan_state()
         raise
     _status_end_trial(job=jid)
     duration = timeit.default_timer() - start
@@ -4395,6 +4536,7 @@ def _shuffle_impl(
         "done", duration_s=duration, plan=plan, job_id=jid,
         audit_verdicts=audit_verdicts,
     )
+    _clear_plan_state()
     if stats_collector is not None:
         stats_collector.call_oneway("trial_done", duration)
     return duration

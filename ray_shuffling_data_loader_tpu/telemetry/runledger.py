@@ -102,7 +102,11 @@ def _job_identity(job_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
 
 def _knob_snapshot() -> Dict[str, str]:
     """Every registry-declared RSDL_* knob present in the environment
-    (prefix families included). Values are clipped — the ledger is a
+    (prefix families included), overlaid with the plan compiler's
+    effective resolved values for knobs the env left unset (ISSUE 20
+    bugfix: env-only snapshots made two runs with identical env but
+    different planner decisions look identical). Env-set values win —
+    they are the operator's pins. Values are clipped — the ledger is a
     record, not a config store."""
     out: Dict[str, str] = {}
     try:
@@ -122,6 +126,13 @@ def _knob_snapshot() -> Dict[str, str]:
     # Honesty about the gate itself even though it is what got us here.
     if ENV_LEDGER in env and ENV_LEDGER not in out:
         out[ENV_LEDGER] = str(env[ENV_LEDGER])[:200]
+    planmod = _module("runtime.plan")
+    if planmod is not None:
+        try:
+            for knob_name, value in planmod.effective_env().items():
+                out.setdefault(knob_name, str(value)[:200])
+        except Exception:
+            pass
     return dict(sorted(out.items()))
 
 
@@ -338,6 +349,17 @@ def build_record(
     knobs = _knob_snapshot()
     if knobs:
         rec["knobs"] = knobs
+    planmod = _module("runtime.plan")
+    if planmod is not None:
+        # The plan compiler's per-term decisions (ISSUE 20): value,
+        # env-vs-planned-vs-replanned source, and the cost-model why —
+        # what --regress diffs when BASE and HEAD disagree.
+        try:
+            plan_terms = planmod.current_terms()
+            if plan_terms:
+                rec["plan_terms"] = plan_terms
+        except Exception:
+            pass
     throughput = _throughput(flat, duration_s)
     if throughput:
         rec["throughput"] = throughput

@@ -159,6 +159,23 @@ if [ "$tier" != "slow" ]; then
     RSDL_AUDIT=1 RSDL_AUDIT_DIR="$(mktemp -d)" RSDL_METRICS=1 \
     python -m pytest tests/test_shuffle.py tests/test_dataset.py \
       tests/test_jax_dataset.py -m "not slow" -q -x
+  # Planner lane (ISSUE 20): the cost-based plan compiler FORCED ON over
+  # the shuffle/decode/device-direct suites under strict audit + the
+  # same low-prob xN-capped fault schedule — planned runs must stay
+  # exactly-once and bit-identical for fixed seed + fixed plan, with
+  # every planner-chosen knob (plan family, selective engagement,
+  # decode threads, window depth, native threads) riding the stage-task
+  # knob channel instead of the workers' stale env snapshots. The
+  # planner suite itself owns the cost-model units, override precedence,
+  # replan recording, and the zero-overhead-off fresh-interpreter proof.
+  RSDL_PLAN=auto \
+    RSDL_AUDIT=1 RSDL_AUDIT_STRICT=1 RSDL_AUDIT_DIR="$(mktemp -d)" \
+    RSDL_METRICS=1 \
+    RSDL_FAULTS="task.map/task:crash-entry:0.03x1,task.reduce/task:crash-exit:0.03x1" \
+    RSDL_FAULTS_SEED=2020 \
+    python -m pytest tests/test_planner.py tests/test_shuffle.py \
+      tests/test_decode_plane.py tests/test_device_direct.py \
+      -m "not slow" -k "not shared_cache" -q -x
   # Resume lane (ISSUE 13): the durable epoch-state plane under chaos.
   # Journal fold/identity units, graceful suspend (programmatic +
   # SIGTERM), the SIGKILL-the-driver kill-and-resume legs (per-rank

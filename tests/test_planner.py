@@ -1,0 +1,451 @@
+"""Self-tuning plan compiler tests (ISSUE 20).
+
+Covers: the cost-model units (block granularity solved from the
+blocks/file >= 2R quality bound, selective declining on non-prunable
+rowwise plans, fetch-window depth respecting the store budget),
+env-override-beats-planned precedence (compile time AND replan time),
+delivered-stream bit-identity between a planner-on run and the same
+knobs hand-set, the between-epoch re-planner firing on injected live
+signals with before/after recorded, and the fresh-interpreter
+zero-overhead proof for ``RSDL_PLAN=off``/unset.
+"""
+
+import importlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu import runtime
+from ray_shuffling_data_loader_tpu.data_generation import generate_data
+from ray_shuffling_data_loader_tpu.runtime import plan as plan_state
+from ray_shuffling_data_loader_tpu.analysis import planner
+
+sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+
+
+@pytest.fixture(scope="module")
+def wide_dataset(local_runtime, tmp_path_factory):
+    """8 row groups per file: satisfies blocks/file >= 2R at R=2."""
+    data_dir = tmp_path_factory.mktemp("planner-wide")
+    filenames, num_bytes = generate_data(
+        num_rows=3200,
+        num_files=2,
+        num_row_groups_per_file=8,
+        max_row_group_skew=0.3,
+        data_dir=str(data_dir),
+    )
+    assert num_bytes > 0
+    return filenames
+
+
+@pytest.fixture(scope="module")
+def narrow_dataset(local_runtime, tmp_path_factory):
+    """2 row groups per file: cannot meet the bound at any G for R=2."""
+    data_dir = tmp_path_factory.mktemp("planner-narrow")
+    filenames, _ = generate_data(
+        num_rows=800,
+        num_files=2,
+        num_row_groups_per_file=2,
+        max_row_group_skew=0.0,
+        data_dir=str(data_dir),
+    )
+    return filenames
+
+
+@pytest.fixture
+def clean_knobs(monkeypatch):
+    """Every planner-owned knob (and the gate) unset."""
+    for knob in list(planner.TERM_KNOBS.values()) + ["RSDL_PLAN"]:
+        monkeypatch.delenv(knob, raising=False)
+
+
+class _Collecting(sh.BatchConsumer):
+    def __init__(self):
+        import collections
+
+        self.keys = collections.defaultdict(list)
+        self.live_terms = None
+
+    def consume(self, rank, epoch, batches):
+        from ray_shuffling_data_loader_tpu.runtime.store import (
+            logical_columns,
+        )
+
+        if self.live_terms is None:
+            self.live_terms = plan_state.current_terms()
+        store = runtime.get_context().store
+        for ref in batches:
+            cb = store.get_columns(ref)
+            self.keys[(epoch, rank)].extend(
+                np.asarray(logical_columns(cb)["key"]).tolist()
+            )
+            store.free(ref)
+
+    def producer_done(self, rank, epoch):
+        pass
+
+    def wait_until_ready(self, epoch):
+        pass
+
+    def wait_until_all_epochs_done(self):
+        pass
+
+
+# -- cost-model units --------------------------------------------------------
+
+
+def test_block_granularity_meets_quality_bound(wide_dataset, clean_knobs):
+    """G is solved from blocks/file >= 2R: 8 groups/file at R=2 ->
+    bound 4 -> G=2, and ceil(8/2)=4 blocks/file meets the bound."""
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert rplan.plan == ("block", 2)
+    t = rplan.terms["plan"]
+    assert t.source == "planned"
+    assert "2R=4" in t.why
+
+
+def test_rowwise_when_bound_unsatisfiable(narrow_dataset, clean_knobs):
+    """2 groups/file cannot yield blocks/file >= 2R=4 at any G."""
+    rplan = planner.compile_plan(list(narrow_dataset), num_reducers=2)
+    assert rplan.plan == ("rowwise", 0)
+    assert "cannot meet" in rplan.terms["plan"].why
+
+
+def test_selective_declines_on_rowwise(narrow_dataset, clean_knobs):
+    """A non-prunable plan never engages selective: it would re-read
+    every group ~R times for zero pruning."""
+    rplan = planner.compile_plan(list(narrow_dataset), num_reducers=2)
+    t = rplan.terms["selective"]
+    assert t.value is False
+    assert t.source == "planned"
+    assert "not prunable" in t.why
+
+
+def test_selective_engages_on_uncached_block(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    """Block plan + decoded set too big for the decode cache ->
+    selective engages (the r12 regime)."""
+    monkeypatch.setattr(sh, "_decode_cache_auto", lambda *a, **k: False)
+    rplan = planner.compile_plan(
+        list(wide_dataset), num_reducers=2, num_epochs=2
+    )
+    assert rplan.terms["selective"].value is True
+    assert "engaged" in rplan.terms["selective"].why
+
+
+def test_window_depth_respects_store_budget(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    """Depth scales with the budget and clamps to the measured [1, 8]
+    range: a starved budget pins 1, an abundant one caps at 8."""
+    stats = planner.footer_stats(list(wide_dataset))
+    assert stats["est_decoded_bytes"]
+    monkeypatch.setattr(planner, "_store_budget", lambda: 1)
+    starved = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert starved.terms["fetch_window_depth"].value == 1
+    monkeypatch.setattr(planner, "_store_budget", lambda: 1 << 50)
+    rich = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert rich.terms["fetch_window_depth"].value == 8
+    monkeypatch.setattr(planner, "_store_budget", lambda: None)
+    unknown = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    t = unknown.terms["fetch_window_depth"]
+    assert t.value == planner.WINDOW_DEPTH_DEFAULT
+    assert "unknown" in t.why
+
+
+def test_footer_stats_no_data_read(wide_dataset):
+    """The stats pass sees the real shape from footers alone."""
+    stats = planner.footer_stats(list(wide_dataset))
+    assert stats["files"] == 2
+    assert stats["groups_min"] == 8
+    assert stats["rows"] == 3200
+    assert stats["bytes_per_row"] and stats["bytes_per_row"] > 0
+
+
+# -- override precedence -----------------------------------------------------
+
+
+def test_env_override_beats_planned(wide_dataset, clean_knobs, monkeypatch):
+    """An env-set knob pins its term: the planner records the env value
+    with source=env and never substitutes its own choice."""
+    monkeypatch.setenv("RSDL_SHUFFLE_PLAN", "rowwise")
+    monkeypatch.setenv("RSDL_FETCH_WINDOW_DEPTH", "7")
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert rplan.plan == ("rowwise", 0)  # planner wanted block:2
+    assert rplan.terms["plan"].source == "env"
+    t = rplan.terms["fetch_window_depth"]
+    assert t.value == 7 and t.source == "env"
+
+
+def test_replan_never_touches_env_pinned(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    """The operator's pin outranks the re-planner too."""
+    monkeypatch.setenv("RSDL_FETCH_WINDOW_DEPTH", "2")
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    monkeypatch.setattr(
+        planner,
+        "_live_signals",
+        lambda: {"shm_used_frac": 0.1, "critical_path": "reduce"},
+    )
+    changes = planner.replan(rplan, epoch=1)
+    assert all(c["term"] != "fetch_window_depth" for c in changes)
+    assert rplan.terms["fetch_window_depth"].value == 2
+    assert rplan.terms["fetch_window_depth"].source == "env"
+
+
+# -- between-epoch re-planning -----------------------------------------------
+
+
+def test_replan_deepens_on_reduce_stall(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    """Injected reduce-dominant signals with shm headroom -> the window
+    depth doubles, recorded with before/after and source=replanned."""
+    monkeypatch.setattr(planner, "_store_budget", lambda: None)
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    before = rplan.term_value("fetch_window_depth")
+    monkeypatch.setattr(
+        planner,
+        "_live_signals",
+        lambda: {"shm_used_frac": 0.2, "critical_path": "reduce"},
+    )
+    changes = planner.replan(rplan, epoch=1)
+    assert len(changes) == 1
+    assert changes[0]["term"] == "fetch_window_depth"
+    assert changes[0]["before"] == before
+    assert changes[0]["after"] == before * 2
+    t = rplan.terms["fetch_window_depth"]
+    assert t.value == before * 2
+    assert t.source == "replanned"
+    assert rplan.replans == 1
+    # The run-ledger surface carries the adjustment.
+    plan_state.set_current(rplan)
+    try:
+        terms = plan_state.current_terms()
+        assert terms["_replans"]["value"] == 1
+        assert terms["fetch_window_depth"]["source"] == "replanned"
+    finally:
+        plan_state.set_current(None)
+
+
+def test_replan_sheds_windows_over_watermark(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    """shm over the high watermark -> depth halves (and selective
+    engages when the plan is prunable and was off)."""
+    monkeypatch.setattr(planner, "_store_budget", lambda: None)
+    monkeypatch.setattr(sh, "_decode_cache_auto", lambda *a, **k: True)
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert rplan.term_value("selective") is False  # cache-friendly
+    monkeypatch.setattr(
+        planner, "_live_signals", lambda: {"shm_used_frac": 0.95}
+    )
+    changes = planner.replan(rplan, epoch=1)
+    by_term = {c["term"]: c for c in changes}
+    assert by_term["fetch_window_depth"]["after"] == 2  # 4 -> 2
+    assert by_term["selective"]["after"] is True
+    assert rplan.replans == 2
+
+
+def test_replan_grants_decode_cores_on_map_stall(
+    wide_dataset, clean_knobs, monkeypatch
+):
+    monkeypatch.setattr(planner, "_cores", lambda: 8)
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    threads = rplan.term_value("decode_rowgroup_threads")
+    monkeypatch.setattr(
+        planner, "_live_signals", lambda: {"critical_path": "map"}
+    )
+    changes = planner.replan(rplan, epoch=1)
+    assert any(
+        c["term"] == "decode_rowgroup_threads"
+        and c["after"] == min(8, threads * 2)
+        for c in changes
+    )
+
+
+def test_replan_holds_without_signals(wide_dataset, clean_knobs, monkeypatch):
+    """No telemetry planes armed -> the re-planner holds (and never
+    imports one)."""
+    monkeypatch.setattr(planner, "_live_signals", lambda: {})
+    rplan = planner.compile_plan(list(wide_dataset), num_reducers=2)
+    assert planner.replan(rplan, epoch=1) == []
+    assert rplan.replans == 0
+
+
+# -- planner-on == hand-set stream identity ----------------------------------
+
+
+def test_stream_bit_identical_planner_vs_hand_set(
+    local_runtime, wide_dataset, clean_knobs, monkeypatch
+):
+    """A planner-on run and a planner-off run with the SAME terms
+    hand-set via env must deliver bit-identical streams: the planned
+    values ride stage-task arguments, so there is no third behavior."""
+    monkeypatch.setenv("RSDL_PLAN", "auto")
+    auto = _Collecting()
+    sh.shuffle(
+        list(wide_dataset), auto, num_epochs=2, num_reducers=2,
+        num_trainers=1, seed=11, cache_decoded=False,
+    )
+    assert auto.live_terms, "planner run recorded no live plan terms"
+    assert plan_state.current() is None  # cleared at run end
+    # Re-derive the same plan driver-side and pin every term by env.
+    rplan = planner.compile_plan(
+        list(wide_dataset), num_reducers=2, num_epochs=2,
+        cache_decoded=False,
+    )
+    monkeypatch.delenv("RSDL_PLAN", raising=False)
+    for knob, value in rplan.effective_env().items():
+        monkeypatch.setenv(knob, value)
+    hand = _Collecting()
+    sh.shuffle(
+        list(wide_dataset), hand, num_epochs=2, num_reducers=2,
+        num_trainers=1, seed=11, cache_decoded=False,
+    )
+    assert hand.live_terms is None  # planner plane stayed dark
+    assert dict(auto.keys) == dict(hand.keys)
+
+
+def test_planner_run_delivers_all_rows(
+    local_runtime, narrow_dataset, clean_knobs, monkeypatch
+):
+    """Planner-on on a rowwise-shaped dataset: full delivery, terms
+    recorded, state cleared."""
+    monkeypatch.setenv("RSDL_PLAN", "auto")
+    consumer = _Collecting()
+    sh.shuffle(
+        list(narrow_dataset), consumer, num_epochs=2, num_reducers=2,
+        num_trainers=1, seed=3, cache_decoded=False,
+    )
+    for epoch in (0, 1):
+        delivered = sorted(
+            k for r in (0, 1) for k in consumer.keys[(epoch, r)]
+        )
+        assert delivered == list(range(800))
+    assert consumer.live_terms["plan"]["value"] == ["rowwise", 0] or (
+        consumer.live_terms["plan"]["value"] == ("rowwise", 0)
+    )
+    assert plan_state.current() is None
+
+
+def test_runledger_snapshot_records_effective_values(clean_knobs):
+    """The ledger-record bugfix (ISSUE 20): a planned run's knob
+    snapshot must carry the effective RESOLVED values, not just env —
+    two records with identical env but different planner decisions
+    must stay distinguishable."""
+    from ray_shuffling_data_loader_tpu.runtime.plan import (
+        PlanTerm,
+        ResolvedPlan,
+    )
+    from ray_shuffling_data_loader_tpu.telemetry import runledger
+
+    terms = {
+        "plan": PlanTerm(
+            "plan", "RSDL_SHUFFLE_PLAN", ("block", 2), "planned", "bound"
+        ),
+        "fetch_window_depth": PlanTerm(
+            "fetch_window_depth", "RSDL_FETCH_WINDOW_DEPTH", 6,
+            "planned", "budget",
+        ),
+    }
+    plan_state.set_current(
+        ResolvedPlan(plan=("block", 2), projection=None, terms=terms)
+    )
+    try:
+        rec = runledger.build_record("done", duration_s=1.0)
+    finally:
+        plan_state.set_current(None)
+    assert rec["knobs"]["RSDL_SHUFFLE_PLAN"] == "block:2"
+    assert rec["knobs"]["RSDL_FETCH_WINDOW_DEPTH"] == "6"
+    assert rec["plan_terms"]["plan"]["source"] == "planned"
+    assert rec["plan_terms"]["fetch_window_depth"]["value"] == 6
+
+
+def test_env_wins_in_runledger_snapshot(clean_knobs, monkeypatch):
+    """An env-set knob stays the snapshot's value even when a plan term
+    names the same knob (env wins at resolve time, so it must win in
+    the record too)."""
+    from ray_shuffling_data_loader_tpu.runtime.plan import (
+        PlanTerm,
+        ResolvedPlan,
+    )
+    from ray_shuffling_data_loader_tpu.telemetry import runledger
+
+    monkeypatch.setenv("RSDL_FETCH_WINDOW_DEPTH", "2")
+    terms = {
+        "fetch_window_depth": PlanTerm(
+            "fetch_window_depth", "RSDL_FETCH_WINDOW_DEPTH", 2, "env",
+            "pinned",
+        ),
+    }
+    plan_state.set_current(
+        ResolvedPlan(plan=("rowwise", 0), projection=None, terms=terms)
+    )
+    try:
+        rec = runledger.build_record("done", duration_s=1.0)
+    finally:
+        plan_state.set_current(None)
+    assert rec["knobs"]["RSDL_FETCH_WINDOW_DEPTH"] == "2"
+
+
+# -- zero-overhead off -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_zero_overhead_when_plan_off(tmp_path):
+    """Fresh interpreter, RSDL_PLAN=off (the explicit disable — unset
+    is covered by the decode plane's gate test, which the planner
+    modules would fail too): a real shuffle run must never import the
+    planner or the plan-state module."""
+    code = """
+import os, sys
+for k in list(os.environ):
+    if k.startswith("RSDL_"):
+        del os.environ[k]
+os.environ["RSDL_PLAN"] = "off"
+os.environ["RSDL_SHM_DIR"] = r"%(shm)s"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+def main():
+    import importlib
+    from ray_shuffling_data_loader_tpu import runtime
+    from ray_shuffling_data_loader_tpu.data_generation import generate_data
+    sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+    runtime.init(num_workers=2)
+    files, _ = generate_data(600, 2, 3, 0.0, r"%(data)s")
+    class C(sh.BatchConsumer):
+        def consume(self, rank, epoch, batches):
+            runtime.get_context().store.free(list(batches))
+        def producer_done(self, rank, epoch): pass
+        def wait_until_ready(self, epoch): pass
+        def wait_until_all_epochs_done(self): pass
+    sh.shuffle(files, C(), num_epochs=2, num_reducers=2,
+               num_trainers=1, seed=1, cache_decoded=False)
+    for mod in (
+        "ray_shuffling_data_loader_tpu.analysis.planner",
+        "ray_shuffling_data_loader_tpu.runtime.plan",
+    ):
+        assert mod not in sys.modules, mod + " imported with RSDL_PLAN=off"
+    runtime.shutdown()
+    print("PLAN-OFF-OK")
+
+if __name__ == "__main__":
+    main()
+""" % {"shm": str(tmp_path / "shm"), "data": str(tmp_path / "data")}
+    script = tmp_path / "plan_off.py"
+    script.write_text(code)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "PLAN-OFF-OK" in out.stdout

@@ -143,6 +143,14 @@ def _diff_rows(base: dict, head: dict) -> List[str]:
     hknobs: Dict[str, str] = head.get("knobs") or {}
     for knob in sorted(set(bknobs) | set(hknobs)):
         row(f"knob {knob}", bknobs.get(knob), hknobs.get(knob))
+    bterms: Dict[str, dict] = base.get("plan_terms") or {}
+    hterms: Dict[str, dict] = head.get("plan_terms") or {}
+    for term in sorted(set(bterms) | set(hterms)):
+        row(
+            f"plan_term {term}",
+            (bterms.get(term) or {}).get("value"),
+            (hterms.get(term) or {}).get("value"),
+        )
     return out
 
 
@@ -197,6 +205,40 @@ def _profile_shift_lines(base: dict, head: dict) -> List[str]:
     return out
 
 
+def _plan_term_lines(base: dict, head: dict) -> List[str]:
+    """Plan-compiler decision shifts between two records (empty when
+    either lacks a ``plan_terms`` section — ISSUE 20): names the term
+    whose effective value or provenance changed, so a throughput
+    verdict can be attributed to the planner decision that moved."""
+    bterms, hterms = base.get("plan_terms"), head.get("plan_terms")
+    if not bterms or not hterms:
+        return []
+    out: List[str] = []
+    for term in sorted(set(bterms) | set(hterms)):
+        if term.startswith("_"):
+            continue  # bookkeeping entries (_replans)
+        b, h = bterms.get(term) or {}, hterms.get(term) or {}
+        bval, hval = b.get("value"), h.get("value")
+        bsrc, hsrc = b.get("source"), h.get("source")
+        if bval != hval:
+            out.append(
+                f"plan: term {term} changed {bval!r} ({bsrc}) -> "
+                f"{hval!r} ({hsrc})"
+            )
+        elif bsrc != hsrc:
+            out.append(
+                f"plan: term {term} kept value {hval!r} but its source "
+                f"changed {bsrc} -> {hsrc}"
+            )
+    breplans = (bterms.get("_replans") or {}).get("value", 0)
+    hreplans = (hterms.get("_replans") or {}).get("value", 0)
+    if breplans != hreplans:
+        out.append(
+            f"plan: mid-run re-plans {breplans} -> {hreplans}"
+        )
+    return out
+
+
 def cmd_regress(records: List[dict], args) -> int:
     spec = args.regress
     if ".." not in spec:
@@ -239,16 +281,22 @@ def cmd_regress(records: List[dict], args) -> int:
         failures.append("head run failed where base succeeded")
     print(f"base: {base.get('id')}  head: {head.get('id')}")
     profile_lines = _profile_shift_lines(base, head)
+    plan_lines = _plan_term_lines(base, head)
     if failures:
         for f in failures:
             print(f"REGRESSION: {f}")
         # The profiling plane's whole point (ISSUE 17): when the gate
         # trips, NAME the frame the time moved into, not just that it
-        # moved.
+        # moved. Same for the planner (ISSUE 20): name the plan term
+        # that changed alongside the throughput verdict.
         for line in profile_lines:
+            print(line)
+        for line in plan_lines:
             print(line)
         return 1
     for line in profile_lines:
+        print(line)
+    for line in plan_lines:
         print(line)
     print(
         f"ok: throughput {btp if btp is not None else '-'} -> "
